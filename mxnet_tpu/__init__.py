@@ -1,0 +1,44 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of MXNet
+(reference: ptrendx/mxnet). Conventionally imported as `mx`:
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+
+Compute path: jax/XLA (imperative ops ride async dispatch; hybridized Gluon
+blocks compile to single XLA executables; Pallas kernels for hot ops).
+Distribution: jax.sharding Mesh + collectives (KVStore 'tpu_sync').
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .context import Context, cpu, tpu, gpu, current_context, num_tpus, \
+    num_gpus
+from . import autograd
+from . import random
+from .ndarray import NDArray, waitall
+from . import nd
+from . import sparse
+from . import initializer
+from . import init  # alias namespace
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import lr_scheduler as _lr_sched_alias  # noqa: F401
+from . import metric
+from . import kvstore
+from .kvstore import create as _kv_create  # noqa: F401
+from . import gluon
+from . import models
+from . import amp
+from . import profiler
+from . import parallel
+
+# reference-style module aliases
+sym = None  # symbolic API is subsumed by hybridize/jit (SURVEY §1)
+
+
+def test_utils():
+    from . import test_utils as t
+    return t

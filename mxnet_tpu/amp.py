@@ -1,0 +1,119 @@
+"""Automatic mixed precision (reference: mxnet/contrib/amp — which
+originated in the ptrendx fork).
+
+TPU-first: bf16 is the native MXU dtype and needs no loss scaling; fp16
+policy keeps the reference's DynamicLossScaler semantics. `init()` installs
+a casting policy; `convert_block` casts a Gluon block's parameters with
+fp32 master copies handled by the multi-precision optimizers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["init", "init_trainer", "convert_block", "scale_loss",
+           "DynamicLossScaler", "unscale"]
+
+# ops that must stay fp32 (reference: amp lists.py deny-list)
+FP32_OPS = {"softmax", "log_softmax", "LayerNorm", "BatchNorm", "RMSNorm",
+            "norm", "mean", "sum", "exp", "log", "erf", "softmax_cross_entropy"}
+
+_STATE = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP process-wide (reference: amp.init())."""
+    _STATE["enabled"] = True
+    _STATE["dtype"] = jnp.bfloat16 if target_dtype in ("bfloat16", "bf16") \
+        else jnp.float16
+    if _STATE["dtype"] == jnp.float16:
+        _STATE["scaler"] = DynamicLossScaler()
+    return _STATE["dtype"]
+
+
+def is_enabled():
+    return _STATE["enabled"]
+
+
+def target_dtype():
+    return _STATE["dtype"]
+
+
+def convert_block(block, target_dtype=None):
+    """Cast a block's float params to the AMP dtype; norm/scale params stay
+    fp32 (reference: amp.convert_hybrid_block)."""
+    dt = target_dtype or _STATE["dtype"]
+    for name, p in block.collect_params().items():
+        if p.dtype not in (jnp.float32, jnp.float16, jnp.bfloat16):
+            continue
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("gamma", "beta", "running_mean", "running_var"):
+            continue
+        p.cast(dt)
+    return block
+
+
+def init_trainer(trainer):
+    """Attach loss scaling to a Trainer (fp16 path)."""
+    trainer._amp_scaler = _STATE["scaler"]
+    if _STATE["scaler"] is not None:
+        trainer._scale = 1.0 / _STATE["scaler"].loss_scale
+    return trainer
+
+
+class DynamicLossScaler:
+    """reference: amp/loss_scaler.py — grow scale on stable steps, back off
+    on overflow (the failure-detection hook for fp16)."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = float(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, grads) -> bool:
+        from .nd import contrib
+        for g in grads:
+            if contrib.has_inf_or_nan(g):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """reference: with amp.scale_loss(loss, trainer) as scaled: ..."""
+    scaler: Optional[DynamicLossScaler] = getattr(trainer, "_amp_scaler",
+                                                  None)
+    if scaler is None:
+        yield loss
+        return
+    trainer._scale = 1.0 / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_scaler", None)
+    if scaler is None:
+        return
+    grads = [p.grad() for p in trainer._params if p.grad_req != "null"]
+    overflow = scaler.has_overflow(grads)
+    scaler.update_scale(overflow)
+    return overflow

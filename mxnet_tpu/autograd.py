@@ -1,0 +1,273 @@
+"""Imperative autograd: record()/backward() over a tape of jax.vjp closures.
+
+Reference parity: mxnet/autograd.py + the C++ imperative tape
+(src/imperative/imperative.cc in the reference). TPU-first design: while
+recording, every imperative op captures `out, vjp = jax.vjp(fn, *inputs)` at
+dispatch time, so forward executes once on-device and backward replays the
+stored XLA vjp closures in reverse topological order. Hybridized blocks
+record a single tape node for the whole compiled graph, which is the
+CachedOp-backward equivalent.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _state().recording
+
+
+def is_training() -> bool:
+    return _state().training
+
+
+@contextlib.contextmanager
+def _mode(recording: Optional[bool], training: Optional[bool]):
+    s = _state()
+    prev = (s.recording, s.training)
+    if recording is not None:
+        s.recording = recording
+    if training is not None:
+        s.training = training
+    try:
+        yield
+    finally:
+        s.recording, s.training = prev
+
+
+def record(train_mode: bool = True):
+    """with autograd.record(): ops are taped; also flips train mode."""
+    return _mode(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _mode(False, train_mode)
+
+
+def train_mode():
+    return _mode(None, True)
+
+
+def predict_mode():
+    return _mode(None, False)
+
+
+class Node:
+    """One tape entry: the vjp closure of a dispatched op.
+
+    parents: NDArray inputs that are part of the graph (order matches the
+    cotangent tuple returned by vjp_fn). outputs: the NDArrays produced
+    (positional; cotangents assembled in the same structure).
+    """
+
+    __slots__ = ("vjp_fn", "parents", "outputs", "out_avals", "n_out", "_topo")
+
+    def __init__(self, vjp_fn, parents, n_out):
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list[NDArray]
+        self.outputs: List[Any] = []  # filled by dispatcher (weak refs not
+        # needed: tape is freed after backward)
+        self.out_avals: List[Any] = []
+        self.n_out = n_out
+
+
+def _toposort(root: Node) -> List[Node]:
+    order: List[Node] = []
+    seen = set()
+    stack: List[tuple] = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p._node is not None and id(p._node) not in seen:
+                stack.append((p._node, False))
+    return order  # children before parents reversed later
+
+
+def _zeros_like_aval(aval):
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False):
+    """Run reverse-mode over the tape from `heads`.
+
+    Writes gradients into each leaf's .grad buffer according to grad_req.
+    """
+    from .ndarray import NDArray  # late import (cycle)
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # Seed cotangents keyed by producing (node, position).
+    cotangents: dict = {}
+
+    def _add_cot(arr, cot):
+        key = id(arr)
+        if key in cotangents:
+            cotangents[key] = cotangents[key] + cot
+        else:
+            cotangents[key] = cot
+
+    roots: List[Node] = []
+    for h, hg in zip(heads, head_grads):
+        if h._node is None and h._grad is None:
+            raise ValueError("cannot differentiate a head that is not on the "
+                             "tape; did you forget autograd.record()?")
+        g = hg._data if isinstance(hg, NDArray) else (
+            jnp.ones(h.shape, h._data.dtype) if hg is None else jnp.asarray(hg))
+        _add_cot(h, g)
+        if h._node is not None:
+            roots.append(h._node)
+
+    # Global topological order across all heads.
+    order: List[Node] = []
+    seen = set()
+    for r in roots:
+        for n in _toposort(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    # order currently parents-after-children? _toposort appends post-order
+    # (children of DAG = parents of op). Reverse to get outputs-first.
+    order = list(reversed(order))
+
+    leaves = []
+    for node in order:
+        outs = node.outputs
+        cots = []
+        any_nonzero = False
+        for arr, aval in zip(outs, node.out_avals):
+            c = cotangents.pop(id(arr), None)
+            if c is None:
+                c = _zeros_like_aval(aval)
+            else:
+                any_nonzero = True
+            cots.append(c)
+        if not any_nonzero:
+            continue
+        cot_in = tuple(cots) if node.n_out > 1 else cots[0]
+        grads = node.vjp_fn(cot_in)
+        for parent, g in zip(node.parents, grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                continue
+            _add_cot(parent, g)
+            if parent._node is None and parent._grad is not None:
+                leaves.append(parent)
+
+    # Write leaf grads per grad_req.
+    done = set()
+    for leaf in leaves:
+        if id(leaf) in done:
+            continue
+        done.add(id(leaf))
+        g = cotangents.get(id(leaf))
+        if g is None:
+            continue
+        if leaf._grad_req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        elif leaf._grad_req != "null":
+            leaf._grad._data = g.astype(leaf._grad._data.dtype) \
+                if g.dtype != leaf._grad._data.dtype else g
+
+    if not retain_graph:
+        for node in order:
+            node.vjp_fn = None
+            node.parents = []
+            node.outputs = []
+        for h in heads:
+            h._node = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (mx.autograd.grad): returns grads w.r.t.
+    `variables` without touching .grad buffers."""
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph: use jax.grad on a pure fn "
+                                  "(hybridize) for higher-order gradients")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    # Temporarily give each variable a grad buffer, run backward, collect.
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v._grad = NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v.ctx)
+        v._grad_req = "add"
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph))
+        out = [NDArray(v._grad._data, ctx=v.ctx) for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return out[0] if single else out
+
+
+class Function:
+    """Custom differentiable op (reference: mx.autograd.Function).
+
+    Subclass and define forward(self, *inputs) and backward(self, *out_grads),
+    both operating on NDArrays with raw jax math.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _wrap_outputs
+
+        raw = [x._data if isinstance(x, NDArray) else x for x in inputs]
+        out = self.forward(*[NDArray(r) for r in raw])
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        if not is_recording():
+            return out
+
+        self_ref = self
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if multi else [cots]
+            gin = self_ref.backward(*[NDArray(c) for c in cot_list])
+            if isinstance(gin, NDArray):
+                gin = (gin,)
+            return tuple(g._data if isinstance(g, NDArray) else g for g in gin)
+
+        parents = [x for x in inputs if isinstance(x, NDArray) and x._in_graph]
+        if not parents:
+            return out
+        node = Node(vjp_fn, [x for x in inputs if isinstance(x, NDArray)],
+                    len(outs))
+        return _wrap_outputs(node, [o._data for o in outs], multi)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *out_grads):
+        raise NotImplementedError
+
+
+def get_symbol(*a, **k):  # legacy API stub for parity
+    raise NotImplementedError("symbolic extraction: use HybridBlock.export()")

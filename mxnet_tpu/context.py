@@ -1,0 +1,96 @@
+"""Device contexts: mx.cpu() / mx.tpu().
+
+Reference parity: mxnet/context.py (Context class, with-stack semantics,
+mx.gpu()). TPU-first: a Context resolves to a jax.Device; `gpu` is an alias
+for `tpu` so reference scripts run with only the context string changed
+(BASELINE.json north star). When the session runs on a CPU-only platform
+(tests force JAX_PLATFORMS=cpu), tpu(i) transparently resolves to the i-th
+host device so code is portable.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_CTX_STACK = threading.local()
+
+
+class Context:
+    """A device context. devtype: 'cpu' | 'tpu' ('gpu' aliases 'tpu')."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type == "gpu":  # reference scripts use mx.gpu(); map to tpu
+            device_type = "tpu"
+        if device_type not in ("cpu", "tpu"):
+            raise ValueError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = device_id
+
+    # -- jax resolution -----------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        if self.device_type == "tpu":
+            devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+            if not devs:  # CPU test platform: emulate tpu ids on host devices
+                devs = jax.devices()
+            return devs[self.device_id % len(devs)]
+        return jax.devices("cpu")[self.device_id % len(jax.devices("cpu"))] \
+            if any(d.platform == "cpu" for d in jax.devices()) else jax.devices()[0]
+
+    # -- context-manager stack ---------------------------------------------
+    def __enter__(self):
+        stack = getattr(_CTX_STACK, "stack", None)
+        if stack is None:
+            stack = _CTX_STACK.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CTX_STACK.stack.pop()
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias so unmodified reference scripts map onto TPU chips."""
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    stack = getattr(_CTX_STACK, "stack", None)
+    if stack:
+        return stack[-1]
+    return _default_context()
+
+
+def _default_context() -> Context:
+    if any(d.platform in ("tpu", "axon") for d in jax.devices()):
+        return Context("tpu", 0)
+    return Context("cpu", 0)
+
+
+def num_tpus() -> int:
+    return len([d for d in jax.devices() if d.platform in ("tpu", "axon")])
+
+
+def num_gpus() -> int:  # reference API parity (mx.context.num_gpus)
+    return num_tpus()

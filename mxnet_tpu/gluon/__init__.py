@@ -1,0 +1,13 @@
+"""Gluon — the imperative/hybrid NN API (reference: mxnet/gluon)."""
+from .parameter import Parameter, ParameterDict, Constant, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, Sequential, HybridSequential, \
+    SymbolBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+from ..utils import utils  # gluon.utils parity

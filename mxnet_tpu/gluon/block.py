@@ -1,0 +1,426 @@
+"""Block / HybridBlock (reference: mxnet/gluon/block.py).
+
+TPU-first core: `hybridize()` does what the reference's CachedOp + NNVM
+graph passes do, but through XLA — the block's imperative `forward` is traced
+once per (input-signature, train-mode) into a pure function
+`fn(trainable_params, aux_params, rng_key, *inputs) -> (outputs, new_aux)`
+and jit-compiled. Parameter binding happens by temporarily swapping each
+Parameter's backing jax array for a tracer, so user code is identical in
+eager and compiled mode (BatchNorm's running-stat mutation surfaces as the
+functional `new_aux` output). Under autograd.record the whole compiled graph
+becomes ONE tape node via jax.vjp — the CachedOp-backward analogue.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "Sequential", "HybridSequential",
+           "SymbolBlock", "Lambda", "HybridLambda", "Identity"]
+
+
+def _flatten_nd(obj):
+    """Flatten a nested structure of NDArrays -> (leaves, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        obj, is_leaf=lambda x: isinstance(x, NDArray))
+    return leaves, treedef
+
+
+class Block:
+    """Imperative building block (reference: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._prefix = prefix or ""
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    # -- attribute registration (reference: Block.__setattr__) -------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_") or type(self).__name__.lower()
+
+    @contextlib.contextmanager
+    def name_scope(self):
+        """Reference-API compat; naming is attribute-path based here
+        (matching the reference's save_parameters convention)."""
+        yield self
+
+    @property
+    def params(self) -> ParameterDict:
+        d = ParameterDict()
+        for n, p in self._reg_params.items():
+            d._params[n] = p
+        return d
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """Attribute-path-keyed parameters (reference:
+        _collect_params_with_prefix, the save_parameters naming)."""
+        import re
+        out = ParameterDict()
+
+        def walk(block, path):
+            for n, p in block._reg_params.items():
+                key = f"{path}{n}" if not path else f"{path}.{n}"
+                if key not in out._params:
+                    p.name = p.name if p.name and p.name != "param" else key
+                    out._params[key] = p
+            for cn, c in block._children.items():
+                walk(c, f"{path}.{cn}" if path else cn)
+
+        walk(self, "")
+        if select:
+            pat = re.compile(select)
+            filtered = ParameterDict()
+            for k, v in out.items():
+                if pat.match(k):
+                    filtered._params[k] = v
+            return filtered
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for c in self._children.values():
+            pass  # params already covered by collect_params
+        return self
+
+    def apply(self, fn):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- io ------------------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """Flat .params file keyed by attribute path (reference format
+        semantics; container is npz)."""
+        self.collect_params().save(filename)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        self.collect_params().load(filename, ctx=ctx,
+                                   allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- execution -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        params = self.collect_params()
+        total = 0
+        lines = [f"{'Parameter':<60}{'Shape':<24}{'#':>12}"]
+        for k, p in params.items():
+            n = int(_np.prod(p.shape)) if p.shape else 0
+            total += n
+            lines.append(f"{k:<60}{str(p.shape):<24}{n:>12}")
+        lines.append(f"{'TOTAL':<84}{total:>12}")
+        print("\n".join(lines))
+        return total
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({n}): {type(c).__name__}"
+                         for n, c in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)"
+
+
+class _CacheEntry:
+    __slots__ = ("jit_fn", "tr_names", "aux_names", "tensor_pos",
+                 "out_treedef", "n_out")
+
+    def __init__(self, jit_fn, tr_names, aux_names, tensor_pos):
+        self.jit_fn = jit_fn
+        self.tr_names = tr_names
+        self.aux_names = aux_names
+        self.tensor_pos = tensor_pos
+        self.out_treedef = None
+        self.n_out = None
+
+
+class HybridBlock(Block):
+    """Block that can compile to a single XLA executable via hybridize()."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.__dict__["_active"] = False
+        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_cached_params"] = None
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._jit_cache = {}
+        self._cached_params = None
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                # children stay eager; the top-level trace subsumes them,
+                # but mark for API parity
+                c._active = False
+        return self
+
+    def infer_shape(self, *args):
+        """Run a shape-inference forward (completes deferred params)."""
+        with autograd.pause():
+            self.forward(*args)
+
+    def optimize_for(self, *args, backend=None, **kwargs):
+        self.hybridize(True)
+        if args:
+            self(*args)
+        return self
+
+    def export(self, path, epoch=0):
+        """Dump the compiled graph (StableHLO text) + params — the
+        tracing/EXPORT subsystem (reference: HybridBlock.export to
+        symbol.json/params)."""
+        if not self._jit_cache:
+            raise RuntimeError("call the hybridized block once before "
+                               "export()")
+        entry = next(iter(self._jit_cache.values()))
+        lowered = getattr(entry, "_last_lowered", None)
+        text = lowered if lowered else "<compiled; rerun with dump enabled>"
+        with open(f"{path}-symbol.txt", "w") as f:
+            f.write(text)
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        return f"{path}-symbol.txt"
+
+    # -- compiled call path --------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not self._active or kwargs:
+            return super().__call__(*args, **kwargs)
+        return self._call_cached(*args)
+
+    def _get_params(self):
+        if self._cached_params is None:
+            self._cached_params = self.collect_params()
+        return self._cached_params
+
+    def _call_cached(self, *args):
+        params = self._get_params()
+        # deferred init → one eager forward infers shapes
+        for p in params.values():
+            if p._data is None:
+                if p._deferred is None:
+                    raise RuntimeError(f"{p.name} not initialized")
+                return super().__call__(*args)
+        training = autograd.is_training()
+        key_parts = [training]
+        tensor_pos = []
+        for i, a in enumerate(args):
+            if isinstance(a, NDArray):
+                tensor_pos.append(i)
+                key_parts.append((a.shape, str(a._data.dtype)))
+            else:
+                key_parts.append(("static", repr(a)))
+        cache_key = tuple(key_parts)
+        entry = self._jit_cache.get(cache_key)
+        if entry is None:
+            entry = self._build(tuple(tensor_pos), args, training, params)
+            self._jit_cache[cache_key] = entry
+
+        tr = {n: params[n].data()._data for n in entry.tr_names}
+        aux = {n: params[n].data()._data for n in entry.aux_names}
+        rng = _random.next_key()
+        tensor_raw = [args[i]._data for i in entry.tensor_pos]
+
+        if autograd.is_recording():
+            f = lambda tr_, *ins: entry.jit_fn(tr_, aux, rng, *ins)
+            out_flat, vjp_fn, new_aux = jax.vjp(f, tr, *tensor_raw,
+                                                has_aux=True)
+            parents = [params[n].data() for n in entry.tr_names] + \
+                [args[i] for i in entry.tensor_pos]
+            tr_names = entry.tr_names
+
+            def node_vjp(cots):
+                cot_in = cots if entry.n_out > 1 else (cots,)
+                g_tr, *g_inputs = vjp_fn(tuple(cot_in))
+                return tuple(g_tr[n] for n in tr_names) + tuple(g_inputs)
+
+            node = autograd.Node(node_vjp, parents, entry.n_out)
+        else:
+            out_flat, new_aux = entry.jit_fn(tr, aux, rng, *tensor_raw)
+            node = None
+
+        for n in entry.aux_names:
+            params[n].data()._data = new_aux[n]
+
+        outs = []
+        for r in out_flat:
+            o = NDArray(r)
+            o._node = node
+            outs.append(o)
+        if node is not None:
+            node.outputs = outs
+            node.out_avals = [jax.typeof(r) for r in out_flat]
+        return jax.tree_util.tree_unflatten(entry.out_treedef, outs)
+
+    def _build(self, tensor_pos, proto_args, training, params):
+        tr_names = [n for n, p in params.items() if p.grad_req != "null"]
+        aux_names = [n for n, p in params.items() if p.grad_req == "null"]
+        static_args = {i: a for i, a in enumerate(proto_args)
+                       if i not in tensor_pos}
+        n_args = len(proto_args)
+        block = self
+        entry = _CacheEntry(None, tr_names, aux_names, list(tensor_pos))
+
+        def fn(tr, aux, rng_key, *tensor_args):
+            saved = {n: params[n]._data._data for n in tr_names + aux_names}
+            try:
+                for n in tr_names:
+                    params[n]._data._data = tr[n]
+                for n in aux_names:
+                    params[n]._data._data = aux[n]
+                call_args = []
+                ti = 0
+                for i in range(n_args):
+                    if i in static_args:
+                        call_args.append(static_args[i])
+                    else:
+                        call_args.append(NDArray(tensor_args[ti]))
+                        ti += 1
+                with autograd._mode(False, training), \
+                        _random.trace_key(rng_key):
+                    out = Block.__call__(block, *call_args)
+                leaves, treedef = _flatten_nd(out)
+                entry.out_treedef = treedef
+                entry.n_out = len(leaves)
+                new_aux = {n: params[n]._data._data for n in aux_names}
+                return tuple(l._data if isinstance(l, NDArray) else l
+                             for l in leaves), new_aux
+            finally:
+                for n, v in saved.items():
+                    params[n]._data._data = v
+
+        entry.jit_fn = jax.jit(fn)
+        return entry
+
+
+class Sequential(Block):
+    """reference: gluon.nn.Sequential."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            s = type(self)()
+            for b in list(self._children.values())[idx]:
+                s.add(b)
+            return s
+        return list(self._children.values())[idx]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock, Sequential):
+    """reference: gluon.nn.HybridSequential."""
+
+    def __init__(self, prefix=None, params=None):
+        HybridBlock.__init__(self, prefix, params)
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        self._fn = function
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        self._fn = function
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class SymbolBlock(HybridBlock):
+    """Reference: gluon.SymbolBlock (wrap an exported symbol). Here graphs
+    are jaxpr-backed; re-importing an exported module is done by
+    reconstructing the Python Block and loading parameters, so this class
+    only provides the constructor signature for compatibility."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise NotImplementedError(
+            "exported graphs are StableHLO text; rebuild the Block and "
+            "load_parameters(param_file) instead")

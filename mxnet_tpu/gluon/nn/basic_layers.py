@@ -1,0 +1,286 @@
+"""Core layers (reference: mxnet/gluon/nn/basic_layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nd
+from ...base import resolve_dtype
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Dense", "Dropout", "BatchNorm", "LayerNorm", "GroupNorm",
+           "InstanceNorm", "RMSNorm", "Embedding", "Flatten", "Activation",
+           "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "SiLU", "Swish"]
+
+
+class Dense(HybridBlock):
+    """Fully connected (reference: nn.Dense). Weight (units, in_units)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def forward(self, x):
+        if self.weight.shape[1] == 0:
+            in_units = x.size // x.shape[0] if self._flatten \
+                else x.shape[-1]
+            self.weight.shape = (self._units, in_units)
+            self.weight._finish_deferred_init()
+            if self.bias is not None:
+                self.bias._finish_deferred_init()
+        out = nd.FullyConnected(
+            x, self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return nd.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class Embedding(HybridBlock):
+    """reference: nn.Embedding (sparse_grad routes through the lazy
+    row-sparse optimizer path)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer,
+                                grad_stype="row_sparse" if sparse_grad
+                                else "default")
+
+    def forward(self, x):
+        return nd.Embedding(x, self.weight.data(),
+                            input_dim=self._input_dim,
+                            output_dim=self._output_dim,
+                            sparse_grad=self._sparse_grad)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.flatten()
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act = activation
+
+    def forward(self, x):
+        return nd.Activation(x, act_type=self._act)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        from ...initializer import Constant
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer or Constant(0.25))
+
+    def forward(self, x):
+        return nd.LeakyReLU(x, self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.elu(x, self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return nd.selu(x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation=False, **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return nd.gelu(x, approximate=self._approx)
+
+
+class SiLU(HybridBlock):
+    def forward(self, x):
+        return nd.silu(x)
+
+
+Swish = SiLU
+
+
+class BatchNorm(HybridBlock):
+    """reference: nn.BatchNorm (axis=1 default, NCHW)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        sh = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+        self.running_mean = Parameter(
+            "running_mean", shape=sh, init=running_mean_initializer,
+            allow_deferred_init=True, differentiable=False)
+        self.running_var = Parameter(
+            "running_var", shape=sh, init=running_variance_initializer,
+            allow_deferred_init=True, differentiable=False)
+
+    def _materialize(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            if p.shape == (0,):
+                p._shape = (c,)
+            p._finish_deferred_init()
+
+    def forward(self, x):
+        self._materialize(x)
+        return nd.BatchNorm(x, self.gamma.data(), self.beta.data(),
+                            self.running_mean.data(),
+                            self.running_var.data(), eps=self._eps,
+                            momentum=self._momentum,
+                            fix_gamma=not self._scale,
+                            use_global_stats=self._use_global_stats,
+                            axis=self._axis)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        sh = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p.shape == (0,):
+                p._shape = (c,)
+            p._finish_deferred_init()
+        return nd.LayerNorm(x, self.gamma.data(), self.beta.data(),
+                            axis=self._axis, eps=self._eps)
+
+
+class RMSNorm(HybridBlock):
+    """TPU-era norm for Llama-family models (contrib extension)."""
+
+    def __init__(self, in_channels=0, epsilon=1e-6,
+                 gamma_initializer="ones", **kwargs):
+        super().__init__(**kwargs)
+        self._eps = epsilon
+        sh = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True)
+
+    def forward(self, x):
+        if self.gamma.shape == (0,):
+            self.gamma._shape = (x.shape[-1],)
+        self.gamma._finish_deferred_init()
+        return nd.RMSNorm(x, self.gamma.data(), eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._ng = num_groups
+        self._eps = epsilon
+        sh = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p.shape == (0,):
+                p._shape = (c,)
+            p._finish_deferred_init()
+        return nd.GroupNorm(x, self.gamma.data(), self.beta.data(),
+                            num_groups=self._ng, eps=self._eps)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = epsilon
+        sh = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p.shape == (0,):
+                p._shape = (c,)
+            p._finish_deferred_init()
+        return nd.InstanceNorm(x, self.gamma.data(), self.beta.data(),
+                               eps=self._eps)

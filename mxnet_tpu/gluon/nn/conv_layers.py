@@ -1,0 +1,209 @@
+"""Convolution & pooling layers (reference: mxnet/gluon/nn/conv_layers.py).
+
+TPU-first: layers accept layout NCHW (reference default, for script parity)
+or NHWC (TPU-native; models/ use it). Weights are stored in the layout the
+conv op expects, so no per-step transposes."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import nd
+from ...base import as_tuple
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+           "Conv2DTranspose", "Conv3DTranspose",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D"]
+
+
+def _weight_shape(layout, channels, in_ch_per_group, kernel):
+    rhs = {"NCW": "OIW", "NWC": "WIO", "NCHW": "OIHW", "NHWC": "HWIO",
+           "NCDHW": "OIDHW", "NDHWC": "DHWIO"}[layout]
+    dims = {"O": channels, "I": in_ch_per_group}
+    for i, k in enumerate(kernel):
+        dims["DHW"[3 - len(kernel) + i] if len(kernel) == 3 else
+             ("HW"[i] if len(kernel) == 2 else "W")] = k
+    return tuple(dims[c] for c in rhs)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", transpose=False,
+                 output_padding=None, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(layout) - 2
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = as_tuple(kernel_size, ndim)
+        self._strides = as_tuple(strides, ndim)
+        self._padding = as_tuple(padding, ndim)
+        self._dilation = as_tuple(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._transpose = transpose
+        self._output_padding = as_tuple(output_padding or 0, ndim)
+        wsh = None
+        if in_channels:
+            wsh = self._wshape(in_channels)
+        self.weight = Parameter("weight", shape=wsh,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=bias_initializer) if use_bias else None
+
+    def _wshape(self, in_channels):
+        if self._transpose:
+            # transposed conv stores (in, out//groups, *k) like reference
+            rhs = {"NCW": "OIW", "NCHW": "OIHW", "NCDHW": "OIDHW",
+                   "NWC": "WIO", "NHWC": "HWIO", "NDHWC": "DHWIO"}[
+                       self._layout]
+            dims = {"O": in_channels, "I": self._channels // self._groups}
+        else:
+            rhs = {"NCW": "OIW", "NCHW": "OIHW", "NCDHW": "OIDHW",
+                   "NWC": "WIO", "NHWC": "HWIO", "NDHWC": "DHWIO"}[
+                       self._layout]
+            dims = {"O": self._channels,
+                    "I": in_channels // self._groups}
+        k = list(self._kernel)
+        out = []
+        for c in rhs:
+            if c == "O":
+                out.append(dims["O"])
+            elif c == "I":
+                out.append(dims["I"])
+            else:
+                out.append(k.pop(0))
+        return tuple(out)
+
+    def forward(self, x):
+        if self.weight._data is None and self.weight._deferred is not None:
+            cax = self._layout.index("C")
+            in_ch = x.shape[cax]
+            self.weight.shape = self._wshape(in_ch)
+            self.weight._finish_deferred_init()
+        op = nd.Deconvolution if self._transpose else nd.Convolution
+        out = op(x, self.weight.data(),
+                 self.bias.data() if self.bias is not None else None,
+                 kernel=self._kernel, stride=self._strides,
+                 dilate=self._dilation, pad=self._padding,
+                 num_filter=self._channels, num_group=self._groups,
+                 no_bias=self.bias is None, layout=self._layout,
+                 adj=self._output_padding if self._transpose else None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kw)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kw)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kw)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, transpose=True,
+                         output_padding=output_padding, **kw)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), output_padding=(0, 0), dilation=(1, 1),
+                 groups=1, layout="NCHW", **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, transpose=True,
+                         output_padding=output_padding, **kw)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kw):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, transpose=True,
+                         output_padding=output_padding, **kw)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(layout) - 2
+        self._kernel = as_tuple(pool_size, ndim)
+        self._strides = as_tuple(strides if strides is not None
+                                 else pool_size, ndim)
+        self._padding = as_tuple(padding, ndim)
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._layout = layout
+        self._cip = count_include_pad
+
+    def forward(self, x):
+        return nd.Pooling(
+            x, kernel=self._kernel, pool_type=self._type,
+            global_pool=self._global, stride=self._strides,
+            pad=self._padding,
+            pooling_convention="full" if self._ceil else "valid",
+            count_include_pad=self._cip, layout=self._layout)
+
+
+def _mk_pool(name, ptype, ndim, global_pool):
+    layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+
+    if global_pool:
+        class P(_Pool):
+            def __init__(self, layout=layout, **kw):
+                super().__init__(1, 1, 0, False, True, ptype, layout, **kw)
+    else:
+        class P(_Pool):
+            def __init__(self, pool_size=2, strides=None, padding=0,
+                         ceil_mode=False, layout=layout,
+                         count_include_pad=True, **kw):
+                super().__init__(pool_size, strides, padding, ceil_mode,
+                                 False, ptype, layout,
+                                 count_include_pad, **kw)
+    P.__name__ = name
+    P.__qualname__ = name
+    return P
+
+
+MaxPool1D = _mk_pool("MaxPool1D", "max", 1, False)
+MaxPool2D = _mk_pool("MaxPool2D", "max", 2, False)
+MaxPool3D = _mk_pool("MaxPool3D", "max", 3, False)
+AvgPool1D = _mk_pool("AvgPool1D", "avg", 1, False)
+AvgPool2D = _mk_pool("AvgPool2D", "avg", 2, False)
+AvgPool3D = _mk_pool("AvgPool3D", "avg", 3, False)
+GlobalMaxPool1D = _mk_pool("GlobalMaxPool1D", "max", 1, True)
+GlobalMaxPool2D = _mk_pool("GlobalMaxPool2D", "max", 2, True)
+GlobalMaxPool3D = _mk_pool("GlobalMaxPool3D", "max", 3, True)
+GlobalAvgPool1D = _mk_pool("GlobalAvgPool1D", "avg", 1, True)
+GlobalAvgPool2D = _mk_pool("GlobalAvgPool2D", "avg", 2, True)
+GlobalAvgPool3D = _mk_pool("GlobalAvgPool3D", "avg", 3, True)

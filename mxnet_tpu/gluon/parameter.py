@@ -1,0 +1,314 @@
+"""Parameter / ParameterDict (reference: mxnet/gluon/parameter.py).
+
+TPU-first additions: a Parameter carries an optional `sharding` annotation
+(a jax.sharding PartitionSpec) consumed by parallel/ when building
+tensor/pipeline-parallel training steps.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as _init
+from ..base import resolve_dtype
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from ..sparse import RowSparseNDArray
+
+__all__ = ["Parameter", "ParameterDict", "Constant",
+           "DeferredInitializationError"]
+
+
+class DeferredInitializationError(RuntimeError):
+    pass
+
+
+def _shape_complete(shape):
+    return shape is not None and all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, name="param", grad_req="write", shape=None,
+                 dtype="float32", lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default", sharding=None):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = resolve_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self.sharding = sharding  # PartitionSpec for parallel/ (TPU-first)
+        self._data: Optional[NDArray] = None
+        self._deferred = None  # (init, ctx) when shape was unknown
+
+    # -- shape -------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new):
+        if self._shape is not None and _shape_complete(self._shape):
+            assert tuple(new) == self._shape, \
+                f"shape mismatch for {self.name}: {new} vs {self._shape}"
+        self._shape = tuple(new)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+            else:
+                self._data.attach_grad(req)
+
+    # -- init --------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # single logical device; sharding handles the rest
+        ctx = ctx or current_context()
+        init = init or self.init or default_init or _init.Uniform(0.07)
+        if not _shape_complete(self._shape):
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"{self.name}: shape {self._shape} incomplete and "
+                    "deferred init not allowed")
+            self._deferred = (init, ctx)
+            return
+        self._init_impl(init, ctx)
+
+    def _init_impl(self, init, ctx):
+        arr = NDArray(jnp.zeros(self._shape, self.dtype), ctx=ctx,
+                      _place=True)
+        if isinstance(init, str):
+            init = _init.create(init)
+        init(_init.InitDesc(self.name), arr)
+        self._data = arr
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+        self._deferred = None
+
+    def _finish_deferred_init(self):
+        if self._deferred is not None and _shape_complete(self._shape):
+            init, ctx = self._deferred
+            self._init_impl(init, ctx)
+
+    # -- access ------------------------------------------------------------
+    def _check_init(self):
+        if self._data is None:
+            if self._deferred is not None:
+                raise DeferredInitializationError(
+                    f"{self.name} deferred; run a forward to infer shape")
+            raise RuntimeError(f"parameter {self.name} not initialized; "
+                               "call .initialize()")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_init()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_init()
+        if self._data._grad is None:
+            raise RuntimeError(f"{self.name} has grad_req='null'")
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_init()
+        return [self._data.ctx]
+
+    def set_data(self, data):
+        if self._data is None:
+            if isinstance(data, NDArray):
+                self.shape = data.shape
+                self._finish_deferred_init()
+            if self._data is None:
+                raise RuntimeError(f"{self.name}: set_data before init")
+        req = self._grad_req
+        self._data._data = (data._data if isinstance(data, NDArray)
+                            else jnp.asarray(data)).astype(self.dtype)
+        if req != "null" and self._data._grad is not None \
+                and self._data._grad.shape != self._data.shape:
+            self._data.attach_grad(req)
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def cast(self, dtype):
+        self.dtype = resolve_dtype(dtype)
+        if self._data is not None:
+            self._data._data = self._data._data.astype(self.dtype)
+            if self._data._grad is not None:
+                self._data.attach_grad(self._grad_req)
+
+    def row_sparse_data(self, row_id) -> RowSparseNDArray:
+        """PS-path access for sparse embeddings (reference parity)."""
+        self._check_init()
+        rows = row_id.asnumpy().astype(_np.int64) \
+            if isinstance(row_id, NDArray) else _np.asarray(row_id)
+        return RowSparseNDArray(rows, self._data._data[rows],
+                                self._data.shape)
+
+    def var(self):
+        return self.data()
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={jnp.dtype(self.dtype).name})")
+
+
+class Constant(Parameter):
+    """Non-trainable constant (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        value = _np.asarray(value, dtype=_np.float32)
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         init=_init.Constant(0.0), differentiable=False)
+        self._value = value
+
+    def _init_impl(self, init, ctx):
+        self._data = NDArray(jnp.asarray(self._value), ctx=ctx, _place=True)
+        self._deferred = None
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Create-or-retrieve by suffix name (reference semantics)."""
+        full = self._prefix + name
+        if full in self._params:
+            p = self._params[full]
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    p.shape = tuple(v) if not isinstance(v, int) else (v,)
+            return p
+        if self._shared is not None and full in self._shared:
+            p = self._shared[full]
+        else:
+            p = Parameter(full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other, select=None):
+        import re
+        for k, v in other.items():
+            if select is None or re.match(select, k):
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def cast(self, dtype):
+        for p in self._params.values():
+            p.cast(dtype)
+
+    def reset_ctx(self, ctx):
+        pass  # single logical device; shardings govern placement
+
+    # -- serialization (flat .params format, reference-compatible keys) ----
+    def save(self, filename, strip_prefix=""):
+        data = {}
+        for name, p in self._params.items():
+            if p._data is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
+                else name
+            data[key] = _np.asarray(jax.device_get(p._data._data))
+        _np.savez(filename, **data)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = _np.load(filename if filename.endswith(".npz")
+                          else filename + ".npz", allow_pickle=False)
+        keys = {restore_prefix + k: k for k in loaded.files}
+        for name, p in self._params.items():
+            if name in keys:
+                arr = loaded[keys[name]]
+                if p._data is None:
+                    p.shape = arr.shape
+                    if p._deferred is not None:
+                        p._finish_deferred_init()
+                    else:
+                        p.initialize()
+                p.set_data(arr)
+            elif not allow_missing:
+                raise KeyError(f"missing parameter {name} in {filename}")
+        if not ignore_extra:
+            extra = set(keys) - set(self._params)
+            if extra:
+                raise KeyError(f"extra parameters in file: {sorted(extra)}")
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p}" for p in self._params.values())
+        return f"ParameterDict(\n{lines}\n)"

@@ -1,0 +1,458 @@
+"""Recurrent layers & cells (reference: mxnet/gluon/rnn/*).
+
+TPU-first: the fused multi-layer RNN/LSTM/GRU runs the whole time loop as a
+single `lax.scan` inside one traced op — XLA unrolls/pipelines it on-device,
+which is the analogue of the reference's cuDNN fused RNN kernels. Gate order
+is (i, f, g, o) for LSTM and (r, z, n) for GRU (cuDNN/reference convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nd
+from ..ndarray import NDArray, invoke
+from .block import HybridBlock
+from .parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "ResidualCell", "ZoneoutCell",
+           "DropoutCell", "BidirectionalCell", "HybridRecurrentCell"]
+
+
+def _step_rnn(x, h, wih, whh, bih, bhh, act):
+    pre = x @ wih.T + bih + h[0] @ whh.T + bhh
+    out = jnp.tanh(pre) if act == "tanh" else jax.nn.relu(pre)
+    return out, (out,)
+
+
+def _step_lstm(x, state, wih, whh, bih, bhh, act=None):
+    h, c = state
+    pre = x @ wih.T + bih + h @ whh.T + bhh
+    i, f, g, o = jnp.split(pre, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, (h2, c2)
+
+
+def _step_gru(x, state, wih, whh, bih, bhh, act=None):
+    h = state[0]
+    xi = x @ wih.T + bih
+    hi = h @ whh.T + bhh
+    xr, xz, xn = jnp.split(xi, 3, axis=-1)
+    hr, hz, hn = jnp.split(hi, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h2 = (1 - z) * n + z * h
+    return h2, (h2,)
+
+
+_MODES = {"rnn_tanh": (_step_rnn, 1, 1, "tanh"),
+          "rnn_relu": (_step_rnn, 1, 1, "relu"),
+          "lstm": (_step_lstm, 4, 2, None),
+          "gru": (_step_gru, 3, 1, None)}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._mode = mode
+        self._hidden = hidden_size
+        self._layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        step, gates, nstate, act = _MODES[mode]
+        self._gates = gates
+        self._nstate = nstate
+        ng = gates * hidden_size
+        for l in range(num_layers):
+            for d in range(self._dir):
+                sfx = "" if self._dir == 1 else ("_l", "_r")[d]
+                in_sz = input_size if l == 0 else hidden_size * self._dir
+                setattr(self, f"l{l}{sfx}_i2h_weight", Parameter(
+                    f"l{l}{sfx}_i2h_weight",
+                    shape=(ng, in_sz if in_sz else 0),
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f"l{l}{sfx}_h2h_weight", Parameter(
+                    f"l{l}{sfx}_h2h_weight", shape=(ng, hidden_size),
+                    init=h2h_weight_initializer))
+                setattr(self, f"l{l}{sfx}_i2h_bias", Parameter(
+                    f"l{l}{sfx}_i2h_bias", shape=(ng,),
+                    init=i2h_bias_initializer))
+                setattr(self, f"l{l}{sfx}_h2h_bias", Parameter(
+                    f"l{l}{sfx}_h2h_bias", shape=(ng,),
+                    init=h2h_bias_initializer))
+
+    def _p(self, l, d, name):
+        sfx = "" if self._dir == 1 else ("_l", "_r")[d]
+        return getattr(self, f"l{l}{sfx}_{name}")
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ..ndarray import zeros
+        shapes = [(self._layers * self._dir, batch_size, self._hidden)
+                  for _ in range(self._nstate)]
+        return [zeros(s) for s in shapes]
+
+    def forward(self, inputs, states=None):
+        tnc = inputs if self._layout == "TNC" else \
+            inputs.transpose((1, 0, 2))
+        T, N, _ = tnc.shape
+        if states is None:
+            states = self.begin_state(N)
+            ret_states = False
+        else:
+            ret_states = True
+        # finalize deferred input-size weights
+        in_sz = tnc.shape[2]
+        for l in range(self._layers):
+            for d in range(self._dir):
+                w = self._p(l, d, "i2h_weight")
+                if w.shape[1] == 0:
+                    w.shape = (w.shape[0], in_sz if l == 0
+                               else self._hidden * self._dir)
+                    w._finish_deferred_init()
+
+        step_fn, gates, nstate, act = _MODES[self._mode]
+        params = []
+        for l in range(self._layers):
+            for d in range(self._dir):
+                params.extend([self._p(l, d, "i2h_weight").data(),
+                               self._p(l, d, "h2h_weight").data(),
+                               self._p(l, d, "i2h_bias").data(),
+                               self._p(l, d, "h2h_bias").data()])
+        layers, ndir, hidden = self._layers, self._dir, self._hidden
+        dropout = self._dropout
+        training = False
+        from .. import autograd as _ag
+        training = _ag.is_training()
+        drop_keys = []
+        if dropout and training and layers > 1:
+            from .. import random as _random
+            drop_keys = [_random.next_key() for _ in range(layers - 1)]
+
+        def fused(x, *flat):
+            ps = flat[:4 * layers * ndir]
+            sts = flat[4 * layers * ndir:]
+            # states: nstate tensors of (layers*dir, N, H)
+            out = x
+            new_states = [[] for _ in range(nstate)]
+            for l in range(layers):
+                outs_dir = []
+                for d in range(ndir):
+                    k = (l * ndir + d) * 4
+                    wih, whh, bih, bhh = ps[k:k + 4]
+                    s0 = tuple(sts[j][l * ndir + d] for j in range(nstate))
+                    xs = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def sc(carry, xt):
+                        _, new = step_fn(xt, carry, wih, whh, bih, bhh, act)
+                        return new, new[0]
+
+                    final, ys = lax.scan(sc, s0, xs)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    outs_dir.append(ys)
+                    for j in range(nstate):
+                        new_states[j].append(final[j])
+                out = outs_dir[0] if ndir == 1 else \
+                    jnp.concatenate(outs_dir, axis=-1)
+                if dropout and training and l < layers - 1 and drop_keys:
+                    keep = jax.random.bernoulli(drop_keys[l], 1 - dropout,
+                                                out.shape)
+                    out = jnp.where(keep, out / (1 - dropout), 0.0)
+            packed = [jnp.stack(s) for s in new_states]
+            return tuple([out] + packed)
+
+        res = invoke(fused, [tnc] + params + list(states),
+                     n_out=1 + nstate)
+        out = res[0] if self._layout == "TNC" else \
+            res[0].transpose((1, 0, 2))
+        if ret_states:
+            return out, list(res[1:])
+        return out
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="tanh",
+                 layout="TNC", **kw):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         layout, **kw)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", **kw):
+        super().__init__("lstm", hidden_size, num_layers, layout, **kw)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", **kw):
+        super().__init__("gru", hidden_size, num_layers, layout, **kw)
+
+
+# -- cells -------------------------------------------------------------------
+class HybridRecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ..ndarray import zeros
+        return [zeros(s) for s in self.state_shape(batch_size)]
+
+    def state_shape(self, batch_size):
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        if begin_state is None:
+            bsz = inputs.shape[layout.find("N")]
+            begin_state = self.begin_state(bsz)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            xt = nd.slice_axis(inputs, axis=axis, begin=t, end=t + 1)
+            xt = nd.squeeze(xt, axis=axis)
+            out, states = self(xt, states)
+            outputs.append(out)
+        if merge_outputs is False:
+            return outputs, states
+        stacked = nd.stack(*outputs, axis=axis)
+        if valid_length is not None:
+            stacked = nd.SequenceMask(stacked, valid_length,
+                                      use_sequence_length=True,
+                                      axis=axis)
+        return stacked, states
+
+
+class RNNCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kw):
+        super().__init__(**kw)
+        self._hidden = hidden_size
+        self._act = activation
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init="zeros")
+
+    def state_shape(self, batch_size):
+        return [(batch_size, self._hidden)]
+
+    def _finalize(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self.i2h_weight.shape[0], x.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+
+    def forward(self, x, states):
+        self._finalize(x)
+        act = self._act
+        def f(x_, h, wih, whh, bih, bhh):
+            out, _ = _step_rnn(x_, (h,), wih, whh, bih, bhh, act)
+            return out
+        out = invoke(f, [x, states[0], self.i2h_weight.data(),
+                         self.h2h_weight.data(), self.i2h_bias.data(),
+                         self.h2h_bias.data()])
+        return out, [out]
+
+
+class LSTMCell(RNNCell):
+    def __init__(self, hidden_size, input_size=0, **kw):
+        HybridRecurrentCell.__init__(self, **kw)
+        self._hidden = hidden_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init="zeros")
+
+    def state_shape(self, batch_size):
+        return [(batch_size, self._hidden), (batch_size, self._hidden)]
+
+    def forward(self, x, states):
+        self._finalize(x)
+        def f(x_, h, c, wih, whh, bih, bhh):
+            h2, (h2_, c2) = _step_lstm(x_, (h, c), wih, whh, bih, bhh)
+            return h2, c2
+        h2, c2 = invoke(f, [x, states[0], states[1],
+                            self.i2h_weight.data(), self.h2h_weight.data(),
+                            self.i2h_bias.data(), self.h2h_bias.data()],
+                        n_out=2)
+        return h2, [h2, c2]
+
+
+class GRUCell(RNNCell):
+    def __init__(self, hidden_size, input_size=0, **kw):
+        HybridRecurrentCell.__init__(self, **kw)
+        self._hidden = hidden_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(3 * hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(3 * hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,),
+                                  init="zeros")
+
+    def state_shape(self, batch_size):
+        return [(batch_size, self._hidden)]
+
+    def forward(self, x, states):
+        self._finalize(x)
+        def f(x_, h, wih, whh, bih, bhh):
+            h2, _ = _step_gru(x_, (h,), wih, whh, bih, bhh)
+            return h2
+        h2 = invoke(f, [x, states[0], self.i2h_weight.data(),
+                        self.h2h_weight.data(), self.i2h_bias.data(),
+                        self.h2h_bias.data()])
+        return h2, [h2]
+
+
+class SequentialRNNCell(HybridRecurrentCell):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell)
+
+    def state_shape(self, batch_size):
+        out = []
+        for c in self._cells:
+            out.extend(c.state_shape(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kw):
+        out = []
+        for c in self._cells:
+            out.extend(c.begin_state(batch_size))
+        return out
+
+    def forward(self, x, states):
+        new_states = []
+        p = 0
+        for c in self._cells:
+            n = len(c.state_shape(0))
+            x, s = c(x, states[p:p + n])
+            new_states.extend(s)
+            p += n
+        return x, new_states
+
+
+class ResidualCell(HybridRecurrentCell):
+    def __init__(self, base_cell, **kw):
+        super().__init__(**kw)
+        self.base_cell = base_cell
+
+    def state_shape(self, batch_size):
+        return self.base_cell.state_shape(batch_size)
+
+    def begin_state(self, *a, **k):
+        return self.base_cell.begin_state(*a, **k)
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class DropoutCell(HybridRecurrentCell):
+    def __init__(self, rate, **kw):
+        super().__init__(**kw)
+        self._rate = rate
+
+    def state_shape(self, batch_size):
+        return []
+
+    def forward(self, x, states):
+        return nd.Dropout(x, p=self._rate), states
+
+
+class ZoneoutCell(HybridRecurrentCell):
+    """reference: rnn.ZoneoutCell — randomly keep previous states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kw):
+        super().__init__(**kw)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_shape(self, batch_size):
+        return self.base_cell.state_shape(batch_size)
+
+    def begin_state(self, *a, **k):
+        self._prev_output = None
+        return self.base_cell.begin_state(*a, **k)
+
+    def forward(self, x, states):
+        from .. import autograd as _ag
+        out, new_states = self.base_cell(x, states)
+        if not _ag.is_training():
+            return out, new_states
+        from ..nd import random as _ndr
+
+        def mix(new, old, p):
+            if p == 0.0 or old is None:
+                return new
+            mask = _ndr.bernoulli(p, shape=new.shape)
+            return nd.where(mask, old, new)
+
+        prev = self._prev_output
+        out_mixed = mix(out, prev, self._zo)
+        self._prev_output = out
+        mixed_states = [mix(ns, s, self._zs)
+                        for ns, s in zip(new_states, states)]
+        return out_mixed, mixed_states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    def __init__(self, l_cell, r_cell, **kw):
+        super().__init__(**kw)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_shape(self, batch_size):
+        return self.l_cell.state_shape(batch_size) + \
+            self.r_cell.state_shape(batch_size)
+
+    def begin_state(self, *a, **k):
+        return self.l_cell.begin_state(*a, **k) + \
+            self.r_cell.begin_state(*a, **k)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        bsz = inputs.shape[layout.find("N")]
+        states = begin_state or self.begin_state(bsz)
+        nl = len(self.l_cell.state_shape(0))
+        lo, ls = self.l_cell.unroll(length, inputs, states[:nl], layout,
+                                    True, valid_length)
+        rev = nd.flip(inputs, axis=axis)
+        ro, rs = self.r_cell.unroll(length, rev, states[nl:], layout, True,
+                                    valid_length)
+        ro = nd.flip(ro, axis=axis)
+        return nd.concat(lo, ro, dim=-1), ls + rs
+
+    def forward(self, x, states):
+        raise NotImplementedError("use unroll() for BidirectionalCell")

@@ -1,0 +1,145 @@
+"""gluon.Trainer (reference: mxnet/gluon/trainer.py).
+
+Applies optimizer updates to a set of Parameters, optionally syncing
+gradients through a KVStore. TPU-first: with kvstore 'tpu_sync' the gradient
+sync is a mesh psum executed by the fused data-parallel step
+(parallel/data_parallel.py); this class covers the eager path and the
+optimizer bookkeeping (states, save/load, lr schedule access).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import optimizer as opt
+from ..kvstore import KVStore, create as kv_create
+from ..ndarray import NDArray
+from ..sparse import RowSparseNDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        self._params: List[Parameter] = [p for p in params
+                                         if p.grad_req != "null"]
+        self._all_params = list(params)
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._optimizer.idx2name = {i: p.name
+                                    for i, p in enumerate(self._params)}
+        self._states: Dict[int, object] = {}
+        self._kvstore: Optional[KVStore] = None
+        self._kv_type = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._init_done = False
+        self._scale = 1.0
+
+    # -- lazy init (params may still be deferred at construction) ----------
+    def _init_states(self):
+        if self._init_done:
+            return
+        if self._kv_type and not isinstance(self._kv_type, str):
+            self._kvstore = self._kv_type
+        elif isinstance(self._kv_type, str) and \
+                self._kv_type not in ("device", "local", None):
+            self._kvstore = kv_create(self._kv_type)
+        if self._kvstore is not None and self._update_on_kvstore is None:
+            # reference default: dist stores update on the store
+            self._update_on_kvstore = self._kvstore.type.startswith("dist")
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        if not (self._kvstore is not None and self._update_on_kvstore):
+            for i, p in enumerate(self._params):
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(
+                        i, p.data())
+        self._init_done = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- core ---------------------------------------------------------------
+    def allreduce_grads(self):
+        """Cross-replica grad sum. Single-process meshes do this inside the
+        fused step (lax.psum); eager path is a no-op on one device."""
+        self._init_states()
+
+    def _row_sparse_grad(self, p: Parameter):
+        """Convert a dense grad of an embedding into row_sparse using the
+        rows touched in the last forward (grad rows that are non-zero)."""
+        g = p.grad()
+        import numpy as _np
+        arr = _np.asarray(jax.device_get(g._data))
+        nz = _np.where(_np.any(arr != 0, axis=tuple(range(1, arr.ndim))))[0]
+        return RowSparseNDArray(nz.astype(_np.int64), arr[nz], arr.shape)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale grads by 1/batch_size then update (reference
+        semantics)."""
+        self._init_states()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self.step(batch_size, ignore_stale_grad)
+
+    def _update(self):
+        on_kv = self._kvstore is not None and self._update_on_kvstore
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grad = p.grad()
+            if p._grad_stype == "row_sparse":
+                grad = self._row_sparse_grad(p)
+            if on_kv:
+                # optimizer runs on the store; pull refreshed weights back
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, out=p.data())
+            else:
+                if self._kvstore is not None:
+                    # sync-only store: allreduce grads, update locally
+                    self._kvstore.pushpull(i, grad, out=grad)
+                self._states[i] = self._optimizer.update(
+                    i, p.data(), grad, self._states[i])
+
+    # -- io -----------------------------------------------------------------
+    def save_states(self, fname):
+        import pickle
+        self._init_states()
+        host = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x,
+            self._states)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": host,
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count":
+                             self._optimizer._index_update_count}, f)
+
+    def load_states(self, fname):
+        import pickle
+        self._init_states()
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._states = jax.tree_util.tree_map(jnp.asarray, blob["states"])
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer._index_update_count = blob["index_update_count"]

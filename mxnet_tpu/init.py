@@ -1,0 +1,5 @@
+"""mx.init — alias namespace for initializers (reference parity)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import (Initializer, Zero, Zeros, One, Ones, Constant,
+                          Uniform, Normal, Orthogonal, Xavier, MSRAPrelu,
+                          Bilinear, LSTMBias, Mixed)  # noqa: F401

@@ -1,0 +1,229 @@
+"""Weight initializers (reference: mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from .ndarray import NDArray
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name):
+    if isinstance(name, Initializer):
+        return name
+    if name is None:
+        return Uniform(0.07)
+    return _REGISTRY[str(name).lower()]()
+
+
+class InitDesc(str):
+    """Parameter-name-carrying descriptor (reference parity)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        o = super().__new__(cls, name)
+        o.attrs = attrs or {}
+        o.global_init = global_init
+        return o
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray):
+        self.init_weight(str(name), arr)
+
+    def init_weight(self, name: str, arr: NDArray):
+        # dispatch by conventional suffixes, like the reference's
+        # Initializer._init_default
+        if name.endswith("bias"):
+            arr._data = jnp.zeros_like(arr._data)
+        elif name.endswith("gamma") or "running_var" in name \
+                or "moving_var" in name:
+            arr._data = jnp.ones_like(arr._data)
+        elif name.endswith("beta") or "running_mean" in name \
+                or "moving_mean" in name:
+            arr._data = jnp.zeros_like(arr._data)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+
+Zeros = Zero
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+
+Ones = One
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._data = jnp.full_like(arr._data, self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        arr._data = jax.random.uniform(
+            k, arr.shape, jnp.float32, -self.scale,
+            self.scale).astype(arr._data.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        arr._data = (jax.random.normal(k, arr.shape, jnp.float32) *
+                     self.sigma).astype(arr._data.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._data = (self.scale * q.reshape(arr.shape)).astype(
+            arr._data.dtype)
+
+
+def _fan(shape, factor_type):
+    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return float(fan_in)
+    return float(fan_out)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, arr):
+        k = _random.next_key()
+        factor = _fan(arr.shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(k, arr.shape, jnp.float32, -scale,
+                                     scale)
+        else:
+            out = jax.random.normal(k, arr.shape, jnp.float32) * scale
+        arr._data = out.astype(arr._data.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(shape),
+                                dtype=arr._data.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference parity)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        arr._data = jnp.asarray(b, dtype=arr._data.dtype)
+
+
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f"no initializer matched {name}")

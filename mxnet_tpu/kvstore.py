@@ -1,0 +1,204 @@
+"""KVStore — the distributed key-value parameter store.
+
+Reference parity: mxnet/kvstore.py + src/kvstore/ (local aggregation, NCCL
+allreduce, dist parameter server). TPU-first redesign per BASELINE.json:
+`tpu_sync` replaces NCCL push/pull with XLA AllReduce over the ICI mesh —
+the hot path does NOT go through this object at all: Trainer's fused step
+runs inside shard_map and calls lax.psum directly (see
+parallel/data_parallel.py), which is how XLA wants collectives expressed.
+This class remains the API-compatible control plane: key registry, optimizer
+offload (set_optimizer = the reference's "update on kvstore"), sparse
+row_sparse_pull for the PS path, and eager aggregation for non-jit callers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+from .sparse import RowSparseNDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._store: Dict = {}
+        self._optimizer = None
+        self._opt_states: Dict = {}
+        self._compression = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    # -- data plane --------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        self._store[key] = value if not isinstance(value, list) else value[0]
+        if self._optimizer is not None and not isinstance(
+                value, RowSparseNDArray):
+            self._opt_states[key] = \
+                self._optimizer.create_state_multi_precision(
+                    key, self._store[key])
+
+    def _aggregate(self, value):
+        """Sum grads from all local devices (reference: comm.cc Reduce)."""
+        if isinstance(value, list):
+            if isinstance(value[0], RowSparseNDArray):
+                out = value[0]
+                for v in value[1:]:
+                    out = out + v
+                return out
+            total = value[0]._data
+            for v in value[1:]:
+                total = total + v._data
+            return NDArray(total, ctx=value[0].ctx)
+        return value
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        agg = self._aggregate(value)
+        if self._optimizer is not None:
+            weight = self._store[key]
+            self._opt_states[key] = self._optimizer.update(
+                key, weight, agg, self._opt_states.get(key))
+        else:
+            # default updater = assign the aggregate (reference semantics:
+            # init 2, push 8 -> pull reads 8)
+            raw = agg.todense()._data if isinstance(agg, RowSparseNDArray) \
+                else agg._data
+            self._store[key] = NDArray(raw)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        src = self._store[key]
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o._data = jax.device_put(src._data, o.ctx.jax_device) \
+                if o.ctx != src.ctx else src._data
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce (reference: kvstore 'pushpull' / NCCL path).
+        Without an optimizer attached this is a pure gradient allreduce."""
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i],
+                              out[i] if out is not None else None, priority)
+            return
+        agg = self._aggregate(value)
+        if self._optimizer is not None:
+            self.push(key, agg, priority)
+            if out is not None:
+                self.pull(key, out, priority)
+            return
+        if out is None:
+            return
+        outs = out if isinstance(out, list) else [out]
+        raw = agg.todense()._data if isinstance(agg, RowSparseNDArray) \
+            else agg._data
+        for o in outs:
+            o._data = jax.device_put(raw, o.ctx.jax_device)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """PS-path sparse pull: only requested rows travel (reference:
+        kvstore dist row_sparse_pull)."""
+        src = self._store[key]
+        outs = out if isinstance(out, list) else [out]
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for o, r in zip(outs, rids):
+            if isinstance(src, RowSparseNDArray):
+                o_rows = src.retain(r)
+                o.indices, o.data = o_rows.indices, o_rows.data
+            else:
+                rows = r._data.astype(jnp.int32)
+                vals = src._data[rows]
+                if isinstance(o, RowSparseNDArray):
+                    o.indices = NDArray(rows.astype(jnp.int64))
+                    o.data = NDArray(vals)
+                else:
+                    o._data = src._data
+
+    # -- optimizer offload -------------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        for key, w in self._store.items():
+            self._opt_states[key] = \
+                optimizer.create_state_multi_precision(key, w)
+
+    def is_capable(self, capability: str) -> bool:
+        return capability in ("optimizer", "row_sparse_pull")
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit/fp16 gradient compression (reference: the PS-path option).
+        On TPU, EQuARX-style quantized allreduce (PAPERS.md) would live in
+        the collective itself; recorded here for API parity."""
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        import pickle
+        with open(fname, "wb") as f:
+            states = jax.tree_util.tree_map(
+                lambda x: jax.device_get(x) if isinstance(x, jax.Array)
+                else x, self._opt_states)
+            pickle.dump(states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            self._opt_states = pickle.load(f)
+
+    def barrier(self):
+        from .ndarray import waitall
+        waitall()
+
+
+class TPUSyncKVStore(KVStore):
+    """'tpu_sync' — synchronous data parallelism over the device mesh.
+
+    The eager API aggregates across per-device replicas like 'device' mode;
+    the fused path is parallel/data_parallel.py (shard_map + psum), which
+    Trainer selects automatically when a mesh is active.
+    """
+
+    def __init__(self, kv_type="tpu_sync"):
+        super().__init__(kv_type)
+
+    @property
+    def num_devices(self):
+        return len(jax.devices())
+
+
+def create(name: str = "local") -> KVStore:
+    """mx.kv.create — 'local' | 'device' | 'tpu_sync' | 'dist_tpu_sync' |
+    'dist_sync' | 'dist_async' | 'nccl' (alias of tpu_sync)."""
+    name = name.lower()
+    if name in ("local", "device"):
+        return KVStore(name)
+    if name in ("tpu_sync", "nccl", "dist_tpu_sync", "dist_sync",
+                "dist_device_sync", "horovod"):
+        return TPUSyncKVStore(name)
+    if name == "dist_async":
+        return KVStore(name)  # single-process: degenerates to local PS
+    raise ValueError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,339 @@
+"""Evaluation metrics (reference: mxnet/metric.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
+           "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "Perplexity", "PearsonCorrelation", "Loss",
+           "CompositeEvalMetric", "create", "CustomMetric", "np"]
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        m = CompositeEvalMetric()
+        for c in metric:
+            m.add(create(c, *args, **kwargs))
+        return m
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    return _REGISTRY[metric.lower()](*args, **kwargs)
+
+
+def _listify(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kw):
+        super().__init__(name, **kw)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if pred.shape != label.shape:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(_np.int64).reshape(-1)
+            label = label.astype(_np.int64).reshape(-1)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kw):
+        super().__init__(f"{name}_{top_k}", **kw)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).astype(_np.int64).reshape(-1)
+            pred = _as_np(pred)
+            top = _np.argsort(-pred, axis=-1)[:, :self.top_k]
+            self.sum_metric += float((top == label[:, None]).any(-1).sum())
+            self.num_inst += len(label)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kw):
+        super().__init__(name, **kw)
+        self.average = average
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).reshape(-1).astype(_np.int64)
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(_np.int64)
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1e-12)
+        rec = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@register
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        self.tp = self.fp = self.fn = self.tn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).reshape(-1).astype(_np.int64)
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(_np.int64)
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.tn += float(((pred == 0) & (label == 0)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        denom = math.sqrt((self.tp + self.fp) * (self.tp + self.fn) *
+                          (self.tn + self.fp) * (self.tn + self.fn))
+        mcc = (self.tp * self.tn - self.fp * self.fn) / max(denom, 1e-12)
+        return self.name, mcc
+
+
+class _Regression(EvalMetric):
+    def _err(self, label, pred):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).astype(_np.float64)
+            pred = _as_np(pred).astype(_np.float64).reshape(label.shape)
+            self.sum_metric += float(self._err(label, pred))
+            self.num_inst += label.shape[0] if label.ndim else 1
+
+
+@register
+class MAE(_Regression):
+    def __init__(self, name="mae", **kw):
+        super().__init__(name, **kw)
+
+    def _err(self, label, pred):
+        return _np.abs(label - pred).mean() * (label.shape[0]
+                                               if label.ndim else 1)
+
+
+@register
+class MSE(_Regression):
+    def __init__(self, name="mse", **kw):
+        super().__init__(name, **kw)
+
+    def _err(self, label, pred):
+        return ((label - pred) ** 2).mean() * (label.shape[0]
+                                               if label.ndim else 1)
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kw):
+        EvalMetric.__init__(self, name, **kw)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.sqrt(self.sum_metric / self.num_inst)
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).astype(_np.int64).reshape(-1)
+            pred = _as_np(pred).reshape(len(label), -1)
+            p = pred[_np.arange(len(label)), label]
+            self.sum_metric += float(-_np.log(p + self.eps).sum())
+            self.num_inst += len(label)
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        super().__init__(eps, name, **kw)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kw):
+        super().__init__(name=name, **kw)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_np(label).astype(_np.int64).reshape(-1)
+            pred = _as_np(pred).reshape(len(label), -1)
+            p = pred[_np.arange(len(label)), label]
+            ce = -_np.log(p + self.eps)
+            if self.ignore_label is not None:
+                keep = label != self.ignore_label
+                ce = ce[keep]
+                self.num_inst += int(keep.sum())
+            else:
+                self.num_inst += len(label)
+            self.sum_metric += float(ce.sum())
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        self._l = []
+        self._p = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            self._l.append(_as_np(label).reshape(-1))
+            self._p.append(_as_np(pred).reshape(-1))
+            self.num_inst += 1
+
+    def get(self):
+        if not self._l:
+            return self.name, float("nan")
+        l = _np.concatenate(self._l)
+        p = _np.concatenate(self._p)
+        return self.name, float(_np.corrcoef(l, p)[0, 1])
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, _, preds):
+        for pred in _listify(preds):
+            v = _as_np(pred)
+            self.sum_metric += float(v.sum())
+            self.num_inst += v.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kw):
+        super().__init__(name, **kw)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            v = self._feval(_as_np(label), _as_np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kw):
+        super().__init__(name, **kw)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, vals = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            vals.append(v)
+        return names, vals
+
+
+np = CustomMetric  # reference alias mx.metric.np wraps a numpy feval

@@ -1,0 +1,30 @@
+"""Model zoo (reference: gluon model_zoo/vision + GluonCV/GluonNLP model
+families per BASELINE.json configs)."""
+from __future__ import annotations
+
+_FACTORIES = {}
+
+
+def register_model(name):
+    def deco(fn):
+        _FACTORIES[name] = fn
+        return fn
+    return deco
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    # populate registry lazily
+    from . import lenet, resnet, mobilenet  # noqa: F401
+    try:
+        from . import vgg, alexnet, squeezenet, densenet  # noqa: F401
+    except ImportError:
+        pass
+    try:
+        from . import bert, transformer, llama, fm  # noqa: F401
+    except ImportError:
+        pass
+    if name not in _FACTORIES:
+        raise ValueError(f"unknown model {name}; have "
+                         f"{sorted(_FACTORIES)}")
+    return _FACTORIES[name](**kwargs)

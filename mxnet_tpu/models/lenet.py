@@ -1,0 +1,29 @@
+"""LeNet-5 (reference: the PR1 MNIST example model,
+example/image-classification & gluon MNIST tutorial)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from . import register_model
+
+__all__ = ["LeNet", "lenet"]
+
+
+class LeNet(nn.HybridSequential):
+    def __init__(self, classes=10, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self.add(
+            nn.Conv2D(6, kernel_size=5, padding=2, activation="tanh",
+                      layout=layout),
+            nn.AvgPool2D(pool_size=2, strides=2, layout=layout),
+            nn.Conv2D(16, kernel_size=5, activation="tanh", layout=layout),
+            nn.AvgPool2D(pool_size=2, strides=2, layout=layout),
+            nn.Flatten(),
+            nn.Dense(120, activation="tanh"),
+            nn.Dense(84, activation="tanh"),
+            nn.Dense(classes),
+        )
+
+
+@register_model("lenet")
+def lenet(classes=10, **kwargs):
+    return LeNet(classes=classes, **kwargs)

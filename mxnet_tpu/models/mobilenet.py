@@ -1,0 +1,116 @@
+"""MobileNet V1/V2 (reference: gluon/model_zoo/vision/mobilenet.py).
+Depthwise convs = grouped convs with groups=channels; XLA lowers these to
+TPU depthwise convolutions. Default layout NHWC."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from . import register_model
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_5",
+           "mobilenet0_25", "mobilenet_v2_1_0", "mobilenet_v2_0_5"]
+
+
+def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, layout="NHWC"):
+    ax = layout.index("C")
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False, layout=layout))
+    out.add(nn.BatchNorm(axis=ax))
+    if active:
+        out.add(nn.Activation("relu6"))
+
+
+class LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = HybridSequential()
+        if t != 1:
+            _add_conv(self.out, in_channels * t, layout=layout)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
+                  pad=1, num_group=in_channels * t, layout=layout)
+        _add_conv(self.out, channels, active=False, layout=layout)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    """V1 (depthwise-separable stacks)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        ch = [int(c * multiplier) for c in
+              [32, 64, 128, 128, 256, 256, 512, 512, 512, 512, 512, 512,
+               1024, 1024]]
+        _add_conv(self.features, ch[0], kernel=3, stride=2, pad=1,
+                  layout=layout)
+        strides = [1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1]
+        for i, s in enumerate(strides):
+            _add_conv(self.features, ch[i], kernel=3, stride=s, pad=1,
+                      num_group=ch[i], layout=layout)  # depthwise
+            _add_conv(self.features, ch[i + 1], layout=layout)  # pointwise
+        self.features.add(nn.GlobalAvgPool2D(layout=layout), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        first = int(32 * multiplier)
+        _add_conv(self.features, first, kernel=3, stride=2, pad=1,
+                  layout=layout)
+        in_ch = first
+        # (t, c, n, s) spec from the paper/reference
+        for t, c, n, s in [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+                           (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+                           (6, 320, 1, 1)]:
+            c = int(c * multiplier)
+            for i in range(n):
+                self.features.add(LinearBottleneck(
+                    in_ch, c, t, s if i == 0 else 1, layout=layout))
+                in_ch = c
+        last = int(1280 * max(1.0, multiplier))
+        _add_conv(self.features, last, layout=layout)
+        self.features.add(nn.GlobalAvgPool2D(layout=layout), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+@register_model("mobilenet1.0")
+def mobilenet1_0(**kw):
+    return MobileNet(1.0, **kw)
+
+
+@register_model("mobilenet0.5")
+def mobilenet0_5(**kw):
+    return MobileNet(0.5, **kw)
+
+
+@register_model("mobilenet0.25")
+def mobilenet0_25(**kw):
+    return MobileNet(0.25, **kw)
+
+
+@register_model("mobilenetv2_1.0")
+def mobilenet_v2_1_0(**kw):
+    return MobileNetV2(1.0, **kw)
+
+
+@register_model("mobilenetv2_0.5")
+def mobilenet_v2_0_5(**kw):
+    return MobileNetV2(0.5, **kw)

@@ -1,0 +1,180 @@
+"""Elementwise + broadcast binary ops (reference: src/operator/tensor/
+elemwise_unary_op*.cc, elemwise_binary_broadcast_op*.cc)."""
+from __future__ import annotations
+
+import operator as _op
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, invoke
+
+__all__ = []  # filled by registration below
+
+_mod = sys.modules[__name__]
+
+
+def _unary(name, fn):
+    def op(data, **kwargs):
+        return invoke(fn, [data])
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name} (reference op: mx.nd.{name})."
+    setattr(_mod, name, op)
+    __all__.append(name)
+
+
+_gamma_fn = None
+
+
+def _get_gammaln():
+    global _gamma_fn
+    if _gamma_fn is None:
+        from jax.scipy.special import gammaln
+        _gamma_fn = gammaln
+    return _gamma_fn
+
+
+_UNARY = {
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": _op.neg,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "fix": jnp.fix,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "trunc": jnp.trunc,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "gammaln": lambda x: _get_gammaln()(x),
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "logical_not": lambda x: jnp.logical_not(x).astype(jnp.float32),
+    "isnan": lambda x: jnp.isnan(x).astype(jnp.float32),
+    "isinf": lambda x: jnp.isinf(x).astype(jnp.float32),
+    "isfinite": lambda x: jnp.isfinite(x).astype(jnp.float32),
+}
+for _n, _f in _UNARY.items():
+    _unary(_n, _f)
+
+
+def gamma(data):
+    """Gamma function Γ(x) (reference: mx.nd.gamma)."""
+    return invoke(lambda x: jnp.exp(_get_gammaln()(x)), [data])
+
+
+__all__.append("gamma")
+
+
+def _binary(name, fn, cast_bool=False):
+    def op(lhs, rhs, **kwargs):
+        if cast_bool:
+            f = lambda a, b: fn(a, b).astype(jnp.float32)
+        else:
+            f = fn
+        if isinstance(rhs, NDArray):
+            return invoke(f, [lhs, rhs])
+        return invoke(lambda a: f(a, rhs), [lhs])
+    op.__name__ = name
+    op.__doc__ = f"Broadcast binary {name} (reference op: mx.nd.{name})."
+    setattr(_mod, name, op)
+    __all__.append(name)
+
+
+_BINARY = {
+    "add": _op.add, "subtract": _op.sub, "multiply": _op.mul,
+    "divide": _op.truediv, "modulo": _op.mod, "power": _op.pow,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot, "arctan2": jnp.arctan2,
+}
+for _n, _f in _BINARY.items():
+    _binary(_n, _f)
+
+# broadcast_* aliases (the reference distinguishes elemwise vs broadcast;
+# XLA broadcasting subsumes both)
+for _n, _f in [("broadcast_add", _op.add), ("broadcast_sub", _op.sub),
+               ("broadcast_plus", _op.add), ("broadcast_minus", _op.sub),
+               ("broadcast_mul", _op.mul), ("broadcast_div", _op.truediv),
+               ("broadcast_mod", _op.mod), ("broadcast_power", _op.pow),
+               ("broadcast_maximum", jnp.maximum),
+               ("broadcast_minimum", jnp.minimum),
+               ("elemwise_add", _op.add), ("elemwise_sub", _op.sub),
+               ("elemwise_mul", _op.mul), ("elemwise_div", _op.truediv)]:
+    _binary(_n, _f)
+
+for _n, _f in [("equal", _op.eq), ("not_equal", _op.ne),
+               ("greater", _op.gt), ("greater_equal", _op.ge),
+               ("lesser", _op.lt), ("lesser_equal", _op.le),
+               ("broadcast_equal", _op.eq), ("broadcast_not_equal", _op.ne),
+               ("broadcast_greater", _op.gt),
+               ("broadcast_greater_equal", _op.ge),
+               ("broadcast_lesser", _op.lt),
+               ("broadcast_lesser_equal", _op.le),
+               ("logical_and", jnp.logical_and),
+               ("logical_or", jnp.logical_or),
+               ("logical_xor", jnp.logical_xor),
+               ("broadcast_logical_and", jnp.logical_and),
+               ("broadcast_logical_or", jnp.logical_or),
+               ("broadcast_logical_xor", jnp.logical_xor)]:
+    _binary(_n, _f, cast_bool=True)
+
+
+def add_n(*args):
+    """Sum of N arrays (reference: mx.nd.add_n / ElementWiseSum)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return invoke(lambda *xs: sum(xs[1:], xs[0]), list(args))
+
+
+def clip(data, a_min, a_max):
+    return invoke(lambda x: jnp.clip(x, a_min, a_max), [data])
+
+
+def where(condition, x, y):
+    """Select by condition (reference: mx.nd.where)."""
+    return invoke(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                  [condition, x, y])
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return invoke(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), [data])
+
+
+def smooth_l1(data, scalar=1.0):
+    """Reference: mx.nd.smooth_l1 (Huber with transition at 1/scalar^2)."""
+    def f(x):
+        s2 = scalar * scalar
+        absx = jnp.abs(x)
+        return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+    return invoke(f, [data])
+
+
+__all__ += ["add_n", "clip", "where", "hard_sigmoid", "smooth_l1"]

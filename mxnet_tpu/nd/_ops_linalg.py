@@ -1,0 +1,49 @@
+"""dot / batch_dot / einsum (reference: src/operator/tensor/dot.cc,
+la_op.cc). MXU-bound: keep operands bf16 and let XLA pick tilings."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import invoke
+
+__all__ = ["dot", "batch_dot", "einsum", "khatri_rao", "outer"]
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts last axis of lhs with first axis of rhs
+    (tensordot semantics for ndim>2), unlike numpy matmul."""
+    def f(a, b):
+        aa = a.T if transpose_a and a.ndim == 2 else (
+            jnp.swapaxes(a, -1, -2) if transpose_a else a)
+        bb = b.T if transpose_b and b.ndim == 2 else (
+            jnp.swapaxes(b, 0, 1) if transpose_b else b)
+        if aa.ndim <= 2 and bb.ndim <= 2:
+            return jnp.dot(aa, bb)
+        return jnp.tensordot(aa, bb, axes=1)
+    return invoke(f, [lhs, rhs])
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        bb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return jnp.matmul(aa, bb)
+    return invoke(f, [lhs, rhs])
+
+
+def einsum(subscripts, *operands):
+    return invoke(lambda *xs: jnp.einsum(subscripts, *xs), list(operands))
+
+
+def outer(a, b):
+    return invoke(lambda x, y: jnp.outer(x, y), [a, b])
+
+
+def khatri_rao(*args):
+    def f(*ms):
+        out = ms[0]
+        for m in ms[1:]:
+            out = (out[:, None, :] * m[None, :, :]).reshape(
+                -1, out.shape[-1])
+        return out
+    return invoke(f, list(args))

@@ -1,0 +1,445 @@
+"""Neural-net ops (reference: src/operator/nn/*.cc — convolution, pooling,
+batch norm, dropout, fully_connected, softmax...). TPU-first notes: convs
+lower to lax.conv_general_dilated (XLA tiles them onto the MXU); norms are
+written as fusible elementwise chains; dropout threads functional RNG keys
+so it stays cacheable under jit (see random.py)."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from .. import random as _random
+from ..base import as_tuple
+from ..ndarray import NDArray, invoke
+
+__all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
+           "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "RMSNorm",
+           "L2Normalization", "Dropout", "Activation", "LeakyReLU",
+           "softmax", "log_softmax", "softmin", "SoftmaxOutput",
+           "softmax_cross_entropy", "gelu", "silu", "swish", "selu", "elu",
+           "prelu", "relu6", "log_sigmoid", "mish"]
+
+
+# -- dense ------------------------------------------------------------------
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True):
+    """x @ W.T + b (reference: fully_connected.cc). Weight layout
+    (num_hidden, in_units) matches the reference so checkpoints interop."""
+    def f_nb(x, w):
+        xx = x.reshape(x.shape[0], -1) if flatten and x.ndim > 2 else x
+        return jnp.matmul(xx, w.T)
+
+    def f(x, w, b):
+        return f_nb(x, w) + b
+
+    if no_bias or bias is None:
+        return invoke(f_nb, [data, weight])
+    return invoke(f, [data, weight, bias])
+
+
+# -- convolution ------------------------------------------------------------
+def _conv_dn(layout):
+    rhs = {"NCW": "OIW", "NWC": "WIO", "NCHW": "OIHW", "NHWC": "HWIO",
+           "NCDHW": "OIDHW", "NDHWC": "DHWIO"}[layout]
+    return (layout, rhs, layout)
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout="NCHW", **kw):
+    """Grouped N-D convolution (reference: convolution.cc / cuDNN path).
+    lax.conv_general_dilated → MXU. layout NHWC is the TPU-native fast path;
+    NCHW accepted for reference-script parity (XLA re-lays-out)."""
+    nd_ = len(kernel)
+    stride = as_tuple(stride or (1,) * nd_, nd_)
+    dilate = as_tuple(dilate or (1,) * nd_, nd_)
+    pad = as_tuple(pad or (0,) * nd_, nd_)
+    dn = _conv_dn(layout)
+    pads = [(p, p) for p in pad]
+    channel_axis = layout.index("C")
+
+    def f_nb(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pads,
+            lhs_dilation=(1,) * nd_, rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=num_group,
+            preferred_element_type=jnp.float32
+            if x.dtype == jnp.bfloat16 else None)
+
+    def f(x, w, b):
+        out = f_nb(x, w)
+        bshape = [1] * out.ndim
+        bshape[channel_axis] = -1
+        return out + b.reshape(bshape).astype(out.dtype)
+
+    if no_bias or bias is None:
+        return invoke(f_nb, [data, weight])
+    return invoke(f, [data, weight, bias])
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, layout="NCHW", **kw):
+    """Transposed conv (reference: deconvolution.cc) via input dilation."""
+    nd_ = len(kernel)
+    stride = as_tuple(stride or (1,) * nd_, nd_)
+    dilate = as_tuple(dilate or (1,) * nd_, nd_)
+    pad = as_tuple(pad or (0,) * nd_, nd_)
+    adj = as_tuple(adj or (0,) * nd_, nd_)
+    dn = _conv_dn(layout)
+    channel_axis = layout.index("C")
+    # transposed conv = conv with lhs_dilation=stride and flipped kernel
+    pads = [(d * (k - 1) - p, d * (k - 1) - p + a)
+            for k, p, d, a in zip(kernel, pad, dilate, adj)]
+
+    def f_nb(x, w):
+        spatial = [i for i, c in enumerate(dn[1]) if c not in ("O", "I")]
+        wf = w
+        for ax in spatial:
+            wf = jnp.flip(wf, axis=ax)
+        # swap O/I: weight stored (in, out//group, *k) like the reference
+        o_ax, i_ax = dn[1].index("O"), dn[1].index("I")
+        wf = jnp.swapaxes(wf, o_ax, i_ax)
+        return lax.conv_general_dilated(
+            x, wf, window_strides=(1,) * nd_, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=num_group)
+
+    def f(x, w, b):
+        out = f_nb(x, w)
+        bshape = [1] * out.ndim
+        bshape[channel_axis] = -1
+        return out + b.reshape(bshape).astype(out.dtype)
+
+    if no_bias or bias is None:
+        return invoke(f_nb, [data, weight])
+    return invoke(f, [data, weight, bias])
+
+
+# -- pooling ----------------------------------------------------------------
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, layout="NCHW", **kw):
+    """Max/avg/sum/lp pooling (reference: pooling.cc) via reduce_window."""
+    spatial = [i for i, c in enumerate(layout) if c not in ("N", "C")]
+
+    def f(x):
+        if global_pool:
+            return jnp.mean(x, axis=tuple(spatial), keepdims=True) \
+                if pool_type == "avg" else (
+                    jnp.max(x, axis=tuple(spatial), keepdims=True)
+                    if pool_type == "max"
+                    else jnp.sum(x, axis=tuple(spatial), keepdims=True))
+        nd_ = len(kernel)
+        st = as_tuple(stride or (1,) * nd_, nd_)
+        pd = as_tuple(pad or (0,) * nd_, nd_)
+        dims = [1] * x.ndim
+        strides = [1] * x.ndim
+        pads = [(0, 0)] * x.ndim
+        for j, ax in enumerate(spatial):
+            dims[ax] = kernel[j]
+            strides[ax] = st[j]
+            pads[ax] = (pd[j], pd[j])
+        if pooling_convention == "full":
+            # ceil division output size: pad extra on the high side
+            for j, ax in enumerate(spatial):
+                size = x.shape[ax] + 2 * pd[j] - kernel[j]
+                rem = size % st[j]
+                if rem:
+                    pads[ax] = (pd[j], pd[j] + st[j] - rem)
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / _math.prod(kernel)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return s / cnt
+    return invoke(f, [data])
+
+
+# -- normalization ----------------------------------------------------------
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              axis=1, output_mean_var=False, **kw):
+    """Reference: batch_norm.cc. Training mode uses batch stats and updates
+    the moving aux arrays in place (functional rebind — works both eagerly
+    and under hybridize tracing, where the new values surface as extra jit
+    outputs)."""
+    training = autograd.is_training() and not use_global_stats
+    red = None
+
+    def bshape(x):
+        s = [1] * x.ndim
+        s[axis] = x.shape[axis]
+        return tuple(s)
+
+    if training:
+        def f(x, g, b):
+            xs = x.astype(jnp.float32)
+            ax = tuple(i for i in range(x.ndim) if i != axis)
+            mean = jnp.mean(xs, axis=ax)
+            var = jnp.var(xs, axis=ax)
+            gg = jnp.ones_like(g) if fix_gamma else g
+            inv = lax.rsqrt(var + eps)
+            out = (xs - mean.reshape(bshape(x))) * \
+                (gg * inv).reshape(bshape(x)) + b.reshape(bshape(x))
+            return (out.astype(x.dtype), lax.stop_gradient(mean),
+                    lax.stop_gradient(var))
+        out, bm, bv = invoke(f, [data, gamma, beta], n_out=3)
+        with autograd.pause():
+            m = momentum
+            moving_mean._data = m * moving_mean._data + (1 - m) * bm._data
+            moving_var._data = m * moving_var._data + (1 - m) * bv._data
+        if output_mean_var:
+            return out, bm, bv
+        return out
+
+    def f(x, g, b, mm, mv):
+        gg = jnp.ones_like(g) if fix_gamma else g
+        inv = lax.rsqrt(mv + eps)
+        scale = (gg * inv).reshape(bshape(x))
+        shift = (b - mm * gg * inv).reshape(bshape(x))
+        return (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+    return invoke(f, [data, gamma, beta, moving_mean, moving_var])
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **kw):
+    """Reference: layer_norm.cc; fp32 accumulation for bf16 inputs."""
+    def f(x, g, b):
+        xs = x.astype(jnp.float32)
+        mean = jnp.mean(xs, axis=axis, keepdims=True)
+        var = jnp.var(xs, axis=axis, keepdims=True)
+        out = (xs - mean) * lax.rsqrt(var + eps)
+        return (out * g.astype(jnp.float32) +
+                b.astype(jnp.float32)).astype(x.dtype)
+    return invoke(f, [data, gamma, beta])
+
+
+def RMSNorm(data, gamma, axis=-1, eps=1e-6):
+    """TPU-era norm (Llama family); no reference op — contrib extension."""
+    def f(x, g):
+        xs = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xs), axis=axis, keepdims=True)
+        return (xs * lax.rsqrt(ms + eps) * g.astype(jnp.float32)) \
+            .astype(x.dtype)
+    return invoke(f, [data, gamma])
+
+
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    """Reference: contrib GroupNorm (NC...)."""
+    def f(x, g, b):
+        n, c = x.shape[0], x.shape[1]
+        rest = x.shape[2:]
+        xs = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups,
+                                           *rest)
+        ax = tuple(range(2, xs.ndim))
+        mean = jnp.mean(xs, axis=ax, keepdims=True)
+        var = jnp.var(xs, axis=ax, keepdims=True)
+        out = ((xs - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+        shape = (1, c) + (1,) * len(rest)
+        return (out * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+    return invoke(f, [data, gamma, beta])
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
+    """Reference: instance_norm.cc (NC...)."""
+    def f(x, g, b):
+        ax = tuple(range(2, x.ndim))
+        xs = x.astype(jnp.float32)
+        mean = jnp.mean(xs, axis=ax, keepdims=True)
+        var = jnp.var(xs, axis=ax, keepdims=True)
+        out = (xs - mean) * lax.rsqrt(var + eps)
+        shape = (1, x.shape[1]) + (1,) * len(ax)
+        return (out * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+    return invoke(f, [data, gamma, beta])
+
+
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    """Reference: l2_normalization.cc."""
+    def f(x):
+        if mode == "instance":
+            ax = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            ax = 1
+        else:  # spatial
+            ax = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+        return x / n
+    return invoke(f, [data])
+
+
+# -- dropout ----------------------------------------------------------------
+def Dropout(data, p=0.5, mode="training", axes=(), **kw):
+    """Reference: dropout.cc. Inverted dropout; functional key per call."""
+    active = (autograd.is_training() or mode == "always") and p > 0
+    if not active:
+        return data if isinstance(data, NDArray) else NDArray(data)
+    key = _random.next_key()
+
+    def f(x):
+        shape = list(x.shape)
+        for ax in axes:
+            shape[ax] = 1
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return invoke(f, [data])
+
+
+# -- activations ------------------------------------------------------------
+def Activation(data, act_type="relu"):
+    """Reference: activation.cc."""
+    fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+           "softsign": jax.nn.soft_sign, "gelu": jax.nn.gelu,
+           "silu": jax.nn.silu, "swish": jax.nn.silu,
+           "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+           "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+           "hard_swish": jax.nn.hard_swish}
+    return invoke(fns[act_type], [data])
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    """Reference: leaky_relu.cc (leaky/prelu/elu/selu/gelu/rrelu)."""
+    if act_type == "leaky":
+        return invoke(lambda x: jax.nn.leaky_relu(x, slope), [data])
+    if act_type == "prelu":
+        def f(x, g):
+            shape = (1, -1) + (1,) * (x.ndim - 2) if x.ndim > 1 else (-1,)
+            gg = g.reshape(shape) if g.ndim == 1 and x.ndim > 1 else g
+            return jnp.where(x >= 0, x, gg * x)
+        return invoke(f, [data, gamma])
+    if act_type == "elu":
+        return invoke(lambda x: jax.nn.elu(x, slope), [data])
+    if act_type == "selu":
+        return invoke(jax.nn.selu, [data])
+    if act_type == "gelu":
+        return invoke(lambda x: jax.nn.gelu(x, approximate=False), [data])
+    if act_type == "rrelu":
+        if autograd.is_training():
+            key = _random.next_key()
+            def f(x):
+                s = jax.random.uniform(key, x.shape, jnp.float32,
+                                       lower_bound, upper_bound)
+                return jnp.where(x >= 0, x, s.astype(x.dtype) * x)
+            return invoke(f, [data])
+        mid = (lower_bound + upper_bound) / 2
+        return invoke(lambda x: jax.nn.leaky_relu(x, mid), [data])
+    raise ValueError(act_type)
+
+
+def gelu(data, approximate=False):
+    return invoke(lambda x: jax.nn.gelu(x, approximate=approximate), [data])
+
+
+def silu(data):
+    return invoke(jax.nn.silu, [data])
+
+
+swish = silu
+
+
+def selu(data):
+    return invoke(jax.nn.selu, [data])
+
+
+def elu(data, alpha=1.0):
+    return invoke(lambda x: jax.nn.elu(x, alpha), [data])
+
+
+def prelu(data, gamma):
+    return LeakyReLU(data, gamma, act_type="prelu")
+
+
+def relu6(data):
+    return invoke(lambda x: jnp.clip(x, 0.0, 6.0), [data])
+
+
+def log_sigmoid(data):
+    return invoke(jax.nn.log_sigmoid, [data])
+
+
+def mish(data):
+    return invoke(lambda x: x * jnp.tanh(jax.nn.softplus(x)), [data])
+
+
+# -- softmax family ---------------------------------------------------------
+def softmax(data, axis=-1, temperature=None, length=None, use_length=False):
+    """Reference: softmax.cc (with optional length masking)."""
+    def f(x, *ln):
+        xs = x / temperature if temperature else x
+        if ln:
+            T = x.shape[axis]
+            pos = jnp.arange(T)
+            mask_shape = [1] * x.ndim
+            mask_shape[axis] = T
+            valid = pos.reshape(mask_shape) < \
+                ln[0].astype(jnp.int32).reshape(
+                    [x.shape[0]] + [1] * (x.ndim - 1))
+            xs = jnp.where(valid, xs, -jnp.inf)
+            out = jax.nn.softmax(xs, axis=axis)
+            return jnp.where(valid, out, 0.0)
+        return jax.nn.softmax(xs, axis=axis)
+    args = [data] + ([length] if use_length and length is not None else [])
+    return invoke(f, args)
+
+
+def log_softmax(data, axis=-1, temperature=None):
+    def f(x):
+        xs = x / temperature if temperature else x
+        return jax.nn.log_softmax(xs, axis=axis)
+    return invoke(f, [data])
+
+
+def softmin(data, axis=-1):
+    return invoke(lambda x: jax.nn.softmax(-x, axis=axis), [data])
+
+
+def softmax_cross_entropy(data, label):
+    """Reference: softmax_cross_entropy.cc — scalar summed CE over batch."""
+    def f(x, y):
+        lp = jax.nn.log_softmax(x, axis=-1)
+        picked = jnp.take_along_axis(
+            lp, y.astype(jnp.int32)[..., None], axis=-1)
+        return -jnp.sum(picked).reshape(1)
+    return invoke(f, [data, label])
+
+
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, multi_output=False,
+                  normalization="null", **kw):
+    """Legacy symbolic-era loss op (reference: softmax_output.cc): forward
+    is softmax, backward is (p - onehot) * grad_scale."""
+    @jax.custom_vjp
+    def _so(x, y):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _fwd(x, y):
+        p = jax.nn.softmax(x, axis=-1)
+        return p, (p, y)
+
+    def _bwd(res, g):
+        p, y = res
+        oh = jax.nn.one_hot(y.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        grad = (p - oh) * grad_scale
+        if use_ignore:
+            keep = (y != ignore_label).astype(p.dtype)[..., None]
+            grad = grad * keep
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            n = jnp.maximum(jnp.sum(y != ignore_label), 1)
+            grad = grad / n
+        return grad, jnp.zeros_like(y)
+
+    _so.defvjp(_fwd, _bwd)
+    return invoke(_so, [data, label])

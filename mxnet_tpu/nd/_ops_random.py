@@ -1,0 +1,139 @@
+"""mx.nd.random — sampling ops (reference: src/operator/random/*.cc).
+All draws go through random.next_key(): stateful eagerly, counter-folded
+under tracing so hybridized graphs stay cacheable."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from ..base import resolve_dtype
+from ..ndarray import NDArray, invoke
+
+__all__ = ["uniform", "normal", "randn", "randint", "exponential", "gamma",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "bernoulli", "shuffle", "random_uniform",
+           "random_normal", "random_randint"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    dt = resolve_dtype(dtype)
+    lo = low._data if isinstance(low, NDArray) else low
+    hi = high._data if isinstance(high, NDArray) else high
+    s = _shape(shape) if not isinstance(low, NDArray) else \
+        jnp.broadcast_shapes(jnp.shape(lo), jnp.shape(hi)) + _shape(shape)
+    out = jax.random.uniform(key, s, jnp.float32) * (hi - lo) + lo
+    return NDArray(out.astype(dt), ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    dt = resolve_dtype(dtype)
+    mu = loc._data if isinstance(loc, NDArray) else loc
+    sd = scale._data if isinstance(scale, NDArray) else scale
+    s = _shape(shape)
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        s = jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(sd)) + s
+    out = jax.random.normal(key, s, jnp.float32) * sd + mu
+    return NDArray(out.astype(dt), ctx=ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, **kw):
+    key = _random.next_key()
+    out = jax.random.randint(key, _shape(shape), low, high,
+                             resolve_dtype(dtype))
+    return NDArray(out, ctx=ctx)
+
+
+def exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    out = jax.random.exponential(key, _shape(shape),
+                                 resolve_dtype(dtype)) / lam
+    return NDArray(out, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    out = jax.random.gamma(key, alpha, _shape(shape),
+                           resolve_dtype(dtype)) * beta
+    return NDArray(out, ctx=ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    out = jax.random.poisson(key, lam, _shape(shape)).astype(
+        resolve_dtype(dtype))
+    return NDArray(out, ctx=ctx)
+
+
+def negative_binomial(k=1, p=0.5, shape=None, dtype="float32", ctx=None,
+                      **kw):
+    key = _random.next_key()
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    out = jax.random.poisson(k2, lam).astype(resolve_dtype(dtype))
+    return NDArray(out, ctx=ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    out = jax.random.poisson(k2, lam).astype(resolve_dtype(dtype))
+    return NDArray(out, ctx=ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    key = _random.next_key()
+    p = prob._data if isinstance(prob, NDArray) else prob
+    s = _shape(shape) if shape is not None else jnp.shape(p)
+    out = jax.random.bernoulli(key, p, s).astype(resolve_dtype(dtype))
+    return NDArray(out, ctx=ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample category ids from (batched) probability rows
+    (reference: sample_multinomial_op.cc)."""
+    key = _random.next_key()
+    n = 1 if shape is None else (shape if isinstance(shape, int)
+                                 else int(jnp.prod(jnp.asarray(shape))))
+
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        s = jax.random.categorical(key, logits, axis=-1,
+                                   shape=(n,) + p.shape[:-1])
+        s = jnp.moveaxis(s, 0, -1)
+        if shape is None:
+            s = s[..., 0]
+        return s.astype(resolve_dtype(dtype))
+    out = invoke(f, [data])
+    if get_prob:
+        from ._ops_reduce import pick
+        from ._ops_elem import log as _log
+        return out, _log(pick(data, out.astype("float32"), axis=-1))
+    return out
+
+
+def shuffle(data, **kw):
+    key = _random.next_key()
+    return invoke(lambda x: jax.random.permutation(key, x, axis=0), [data])
+
+
+# legacy aliases (mx.nd.random_uniform etc.)
+random_uniform = uniform
+random_normal = normal
+random_randint = randint

@@ -1,0 +1,126 @@
+"""Reductions, sorting, top-k (reference: src/operator/tensor/
+broadcast_reduce_op*.cc, ordering_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, invoke
+
+__all__ = ["sum", "nansum", "mean", "prod", "nanprod", "max", "min",
+           "argmax", "argmin", "argmax_channel", "norm", "topk", "sort",
+           "argsort", "pick", "cumsum", "cumprod", "all", "any",
+           "max_axis", "min_axis", "sum_axis"]
+
+
+def _axis_reduce(fn):
+    def op(data, axis=None, keepdims=False, exclude=False, **kw):
+        def f(x):
+            ax = axis
+            if exclude and ax is not None:
+                axs = (ax,) if isinstance(ax, int) else tuple(ax)
+                ax = tuple(i for i in range(x.ndim) if i not in
+                           tuple(a % x.ndim for a in axs))
+            return fn(x, axis=ax, keepdims=keepdims)
+        return invoke(f, [data])
+    return op
+
+
+sum = _axis_reduce(jnp.sum)
+sum_axis = sum
+nansum = _axis_reduce(jnp.nansum)
+mean = _axis_reduce(jnp.mean)
+prod = _axis_reduce(jnp.prod)
+nanprod = _axis_reduce(jnp.nanprod)
+max = _axis_reduce(jnp.max)
+max_axis = max
+min = _axis_reduce(jnp.min)
+min_axis = min
+all = _axis_reduce(lambda x, axis=None, keepdims=False:
+                   jnp.all(x, axis=axis, keepdims=keepdims).astype(jnp.float32))
+any = _axis_reduce(lambda x, axis=None, keepdims=False:
+                   jnp.any(x, axis=axis, keepdims=keepdims).astype(jnp.float32))
+
+
+def argmax(data, axis=None, keepdims=False):
+    return invoke(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
+                  .astype(jnp.float32), [data])
+
+
+def argmin(data, axis=None, keepdims=False):
+    return invoke(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
+                  .astype(jnp.float32), [data])
+
+
+def argmax_channel(data):
+    return invoke(lambda x: jnp.argmax(x, axis=-1).astype(jnp.float32),
+                  [data])
+
+
+def norm(data, ord=2, axis=None, keepdims=False):
+    def f(x):
+        if axis is None:
+            return jnp.linalg.norm(x.reshape(-1), ord=ord)
+        return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+    return invoke(f, [data])
+
+
+def cumsum(a, axis=None, dtype=None):
+    return invoke(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype), [a])
+
+
+def cumprod(a, axis=None, dtype=None):
+    return invoke(lambda x: jnp.cumprod(x, axis=axis, dtype=dtype), [a])
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: mx.nd.topk. ret_typ in {value, indices, mask, both}."""
+    def f(x):
+        xs = x if not is_ascend else -x
+        xs = jnp.moveaxis(xs, axis, -1)
+        vals, idx = jax.lax.top_k(xs, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "indices":
+            return idx.astype(jnp.float32)
+        if ret_typ == "both":
+            return vals, idx.astype(jnp.float32)
+        if ret_typ == "mask":
+            m = jnp.zeros(jnp.moveaxis(x, axis, -1).shape, x.dtype)
+            m = jnp.take_along_axis(
+                m, jnp.moveaxis(idx, axis, -1), axis=-1) * 0
+            oh = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1),
+                                x.shape[axis], dtype=x.dtype).sum(-2)
+            return jnp.moveaxis(oh, -1, axis)
+        raise ValueError(ret_typ)
+    n_out = 2 if ret_typ == "both" else 1
+    return invoke(f, [data], n_out=n_out)
+
+
+def sort(data, axis=-1, is_ascend=True):
+    def f(x):
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return invoke(f, [data])
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    def f(x):
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(jnp.float32)
+    return invoke(f, [data])
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick per-row elements by index (reference: mx.nd.pick)."""
+    def f(x, idx):
+        i = jnp.clip(idx.astype(jnp.int32), 0, x.shape[axis] - 1)
+        out = jnp.take_along_axis(x, jnp.expand_dims(i, axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+    return invoke(f, [data, index])

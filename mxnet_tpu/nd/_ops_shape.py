@@ -1,0 +1,352 @@
+"""Shape/index manipulation ops (reference: src/operator/tensor/
+matrix_op.cc, indexing_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import resolve_dtype
+from ..ndarray import NDArray, invoke
+
+__all__ = ["reshape", "reshape_like", "flatten", "transpose", "swapaxes",
+           "expand_dims", "squeeze", "broadcast_to", "broadcast_like",
+           "broadcast_axis", "split", "slice", "slice_axis", "slice_like",
+           "take", "batch_take", "gather_nd", "scatter_nd", "one_hot", "pad",
+           "tile", "repeat", "flip", "reverse", "cast", "Cast", "diag",
+           "shape_array", "size_array", "depth_to_space", "space_to_depth",
+           "SequenceMask", "SequenceLast", "SequenceReverse",
+           "sequence_mask", "sequence_last", "sequence_reverse",
+           "BlockGrad", "stop_gradient", "identity", "embedding", "Embedding",
+           "tril", "triu", "meshgrid", "unravel_index", "ravel_multi_index",
+           "boolean_mask"]
+
+
+def reshape(data, shape):
+    ins = data.shape
+    out = [ins[i] if s == 0 else s for i, s in enumerate(shape)]
+    return invoke(lambda x: jnp.reshape(x, tuple(out)), [data])
+
+
+def reshape_like(lhs, rhs):
+    return invoke(lambda x, y: jnp.reshape(x, y.shape), [lhs, rhs])
+
+
+def flatten(data):
+    return data.flatten()
+
+
+def transpose(data, axes=None):
+    return invoke(lambda x: jnp.transpose(x, axes or None), [data])
+
+
+def swapaxes(data, dim1, dim2):
+    return invoke(lambda x: jnp.swapaxes(x, dim1, dim2), [data])
+
+
+def expand_dims(data, axis):
+    return invoke(lambda x: jnp.expand_dims(x, axis), [data])
+
+
+def squeeze(data, axis=None):
+    return invoke(lambda x: jnp.squeeze(x, axis), [data])
+
+
+def broadcast_to(data, shape):
+    def f(x):
+        tgt = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+        return jnp.broadcast_to(x, tgt)
+    return invoke(f, [data])
+
+
+def broadcast_like(lhs, rhs):
+    return invoke(lambda x, y: jnp.broadcast_to(x, y.shape), [lhs, rhs])
+
+
+def broadcast_axis(data, axis=(), size=()):
+    def f(x):
+        tgt = list(x.shape)
+        axs = (axis,) if isinstance(axis, int) else axis
+        szs = (size,) if isinstance(size, int) else size
+        for a, s in zip(axs, szs):
+            tgt[a] = s
+        return jnp.broadcast_to(x, tuple(tgt))
+    return invoke(f, [data])
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    def f(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    if num_outputs == 1:
+        return invoke(lambda x: jnp.squeeze(x, axis) if squeeze_axis else x,
+                      [data])
+    return list(invoke(f, [data], n_out=num_outputs))
+
+
+def slice(data, begin, end, step=None):
+    pyslice = __import__("builtins").slice
+    def f(x):
+        stp = step or [None] * len(begin)
+        sl = tuple(pyslice(b, e, s) for b, e, s in zip(begin, end, stp))
+        return x[sl]
+    return invoke(f, [data])
+
+
+def slice_axis(data, axis, begin, end):
+    def f(x):
+        e = end if end is not None else x.shape[axis]
+        return jax.lax.slice_in_dim(x, begin, e, axis=axis)
+    return invoke(f, [data])
+
+
+def slice_like(data, shape_like, axes=None):
+    def f(x, y):
+        axs = axes if axes is not None else range(x.ndim)
+        sl = [pyslice(None)] * x.ndim
+        for a in axs:
+            sl[a] = pyslice(0, y.shape[a])
+        return x[tuple(sl)]
+    pyslice = __import__("builtins").slice
+    return invoke(f, [data, shape_like])
+
+
+def take(a, indices, axis=0, mode="clip"):
+    def f(x, idx):
+        i = idx.astype(jnp.int32)
+        if mode == "clip":
+            i = jnp.clip(i, 0, x.shape[axis] - 1)
+        elif mode == "wrap":
+            i = i % x.shape[axis]
+        return jnp.take(x, i, axis=axis)
+    return invoke(f, [a, indices])
+
+
+def batch_take(a, indices):
+    def f(x, idx):
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    return invoke(f, [a, indices])
+
+
+def gather_nd(data, indices):
+    """Reference: mx.nd.gather_nd — indices shape (M, N...) indexes first M
+    dims of data."""
+    def f(x, idx):
+        i = idx.astype(jnp.int32)
+        return x[tuple(i[k] for k in range(i.shape[0]))]
+    return invoke(f, [data, indices])
+
+
+def scatter_nd(data, indices, shape):
+    def f(vals, idx):
+        i = idx.astype(jnp.int32)
+        out = jnp.zeros(tuple(shape), vals.dtype)
+        return out.at[tuple(i[k] for k in range(i.shape[0]))].add(vals)
+    return invoke(f, [data, indices])
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    def f(idx):
+        oh = jax.nn.one_hot(idx.astype(jnp.int32), depth,
+                            dtype=resolve_dtype(dtype))
+        return oh * (on_value - off_value) + off_value
+    return invoke(f, [indices])
+
+
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Reference: mx.nd.pad (pad_width is 2*ndim flat tuple)."""
+    def f(x):
+        pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+        m = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+        if m == "constant":
+            return jnp.pad(x, pw, mode=m, constant_values=constant_value)
+        return jnp.pad(x, pw, mode=m)
+    return invoke(f, [data])
+
+
+def tile(data, reps):
+    return invoke(lambda x: jnp.tile(x, reps), [data])
+
+
+def repeat(data, repeats, axis=None):
+    return invoke(lambda x: jnp.repeat(x, repeats, axis), [data])
+
+
+def flip(data, axis):
+    return invoke(lambda x: jnp.flip(x, axis), [data])
+
+
+def reverse(data, axis):
+    return flip(data, axis)
+
+
+def cast(data, dtype):
+    dt = resolve_dtype(dtype)
+    return invoke(lambda x: x.astype(dt), [data])
+
+
+Cast = cast
+
+
+def diag(data, k=0):
+    return invoke(lambda x: jnp.diag(x, k) if x.ndim <= 1 else
+                  jnp.diagonal(x, k, -2, -1) if x.ndim > 2 else jnp.diag(x, k),
+                  [data])
+
+
+def tril(data, k=0):
+    return invoke(lambda x: jnp.tril(x, k), [data])
+
+
+def triu(data, k=0):
+    return invoke(lambda x: jnp.triu(x, k), [data])
+
+
+def shape_array(data):
+    return invoke(lambda x: jnp.asarray(x.shape, dtype=jnp.int64), [data])
+
+
+def size_array(data):
+    return invoke(lambda x: jnp.asarray([x.size], dtype=jnp.int64), [data])
+
+
+def depth_to_space(data, block_size):
+    def f(x):  # NCHW
+        n, c, h, w = x.shape
+        b = block_size
+        y = x.reshape(n, b, b, c // (b * b), h, w)
+        y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+        return y.reshape(n, c // (b * b), h * b, w * b)
+    return invoke(f, [data])
+
+
+def space_to_depth(data, block_size):
+    def f(x):  # NCHW
+        n, c, h, w = x.shape
+        b = block_size
+        y = x.reshape(n, c, h // b, b, w // b, b)
+        y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+        return y.reshape(n, c * b * b, h // b, w // b)
+    return invoke(f, [data])
+
+
+def meshgrid(*arrays, indexing="xy"):
+    outs = invoke(lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing)),
+                  list(arrays), n_out=len(arrays))
+    return list(outs)
+
+
+def unravel_index(data, shape):
+    def f(x):
+        return jnp.stack(jnp.unravel_index(x.astype(jnp.int32), tuple(shape))
+                         ).astype(jnp.float32)
+    return invoke(f, [data])
+
+
+def ravel_multi_index(data, shape):
+    def f(x):
+        i = x.astype(jnp.int32)
+        return jnp.ravel_multi_index(
+            tuple(i[k] for k in range(i.shape[0])), tuple(shape),
+            mode="clip").astype(jnp.float32)
+    return invoke(f, [data])
+
+
+def boolean_mask(data, index, axis=0):
+    # Dynamic-shape op: executes eagerly via numpy (cannot live under jit;
+    # the reference documents the same CachedOp restriction).
+    import numpy as _np
+    mask = _np.asarray(index.asnumpy() if isinstance(index, NDArray)
+                       else index).astype(bool)
+    sel = _np.nonzero(mask)[0]
+    return take(data, _as_nd(sel), axis=axis)
+
+
+def _as_nd(x):
+    from ..ndarray import array
+    return array(x)
+
+
+# -- sequence ops (time-major (T, N, ...), reference: sequence_*.cc) --------
+def _seq_mask_core(x, seqlen, value):
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]
+    mask = t < seqlen.astype(jnp.int32)[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, jnp.asarray(value, x.dtype))
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    def f(x, sl):
+        y = jnp.moveaxis(x, axis, 0) if axis != 0 else x
+        y = _seq_mask_core(y, sl, value)
+        return jnp.moveaxis(y, 0, axis) if axis != 0 else y
+    return invoke(f, [data, sequence_length])
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0):
+    def f(x, *sl):
+        y = jnp.moveaxis(x, axis, 0) if axis != 0 else x
+        if sl:
+            idx = jnp.clip(sl[0].astype(jnp.int32) - 1, 0, y.shape[0] - 1)
+            return jnp.take_along_axis(
+                y, idx.reshape((1, -1) + (1,) * (y.ndim - 2)), axis=0)[0]
+        return y[-1]
+    args = [data] + ([sequence_length] if use_sequence_length and
+                     sequence_length is not None else [])
+    return invoke(f, args)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0):
+    def f(x, *sl):
+        if not sl:
+            return jnp.flip(x, axis=0)
+        T = x.shape[0]
+        L = sl[0].astype(jnp.int32)[None, :]
+        t = jnp.arange(T)[:, None]
+        src = jnp.where(t < L, L - 1 - t, t)  # reverse within length
+        src = src.reshape((T, -1) + (1,) * (x.ndim - 2))
+        src = jnp.broadcast_to(src, x.shape)
+        return jnp.take_along_axis(x, src, axis=0)
+    args = [data] + ([sequence_length] if use_sequence_length and
+                     sequence_length is not None else [])
+    return invoke(f, args)
+
+
+sequence_mask = SequenceMask
+sequence_last = SequenceLast
+sequence_reverse = SequenceReverse
+
+
+def BlockGrad(data):
+    return invoke(jax.lax.stop_gradient, [data])
+
+
+stop_gradient = BlockGrad
+
+
+def identity(data):
+    return invoke(lambda x: x, [data])
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Reference: mx.nd.Embedding — row gather; sparse_grad handled by the
+    optimizer's lazy-row path (see sparse.py)."""
+    def f(idx, w):
+        return jnp.take(w, jnp.clip(idx.astype(jnp.int32), 0,
+                                    w.shape[0] - 1), axis=0)
+    # differentiate w.r.t. weight only: reorder so weight is a graph input
+    return invoke(lambda w, idx: f(idx, w), [weight, data])
+
+
+def embedding(data, weight, **kw):
+    return Embedding(data, weight, **kw)

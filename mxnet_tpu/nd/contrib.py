@@ -1,0 +1,123 @@
+"""mx.nd.contrib — grab-bag ops the reference keeps under contrib/
+(src/operator/contrib/*). Includes the numeric-safety monitors used by the
+failure-detection subsystem (SURVEY §5)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, invoke
+from ._ops_shape import one_hot  # noqa: F401 (re-export parity)
+
+__all__ = ["isnan", "isinf", "isfinite", "index_copy", "index_array",
+           "getnnz", "arange_like", "check_numerics", "has_inf_or_nan",
+           "div_sqrt_dim", "fft_stub", "boolean_mask", "allclose",
+           "interleaved_matmul_selfatt_qk", "rotary_embedding"]
+
+
+def isnan(data):
+    return invoke(lambda x: jnp.isnan(x).astype(jnp.float32), [data])
+
+
+def isinf(data):
+    return invoke(lambda x: jnp.isinf(x).astype(jnp.float32), [data])
+
+
+def isfinite(data):
+    return invoke(lambda x: jnp.isfinite(x).astype(jnp.float32), [data])
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return invoke(lambda x, y: jnp.allclose(
+        x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+        .astype(jnp.float32), [a, b])
+
+
+def has_inf_or_nan(data) -> bool:
+    """Eager numeric monitor (failure detection)."""
+    x = data._data if isinstance(data, NDArray) else data
+    return bool(jnp.logical_not(jnp.all(jnp.isfinite(x))))
+
+
+def check_numerics(data, name="tensor"):
+    """Raise if non-finite values present (reference: debug tooling)."""
+    if has_inf_or_nan(data):
+        raise FloatingPointError(f"non-finite values detected in {name}")
+    return data
+
+
+def index_copy(old, index, new):
+    def f(o, idx, n):
+        return o.at[idx.astype(jnp.int32)].set(n)
+    return invoke(f, [old, index, new])
+
+
+def index_array(data, axes=None):
+    def f(x):
+        idxs = jnp.indices(x.shape)
+        sel = idxs if axes is None else idxs[list(axes)]
+        return jnp.stack([s for s in sel], axis=-1).astype(jnp.int64)
+    return invoke(f, [data])
+
+
+def getnnz(data, axis=None):
+    return invoke(lambda x: jnp.sum(x != 0, axis=axis).astype(jnp.int64),
+                  [data])
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    def f(x):
+        n = x.size if axis is None else x.shape[axis]
+        r = start + step * jnp.arange(n, dtype=jnp.float32)
+        if repeat > 1:
+            r = jnp.repeat(r, repeat)
+        return r if axis is not None else r.reshape(x.shape)
+    return invoke(f, [data])
+
+
+def div_sqrt_dim(data):
+    return invoke(lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1],
+                                                     x.dtype)), [data])
+
+
+def boolean_mask(data, index, axis=0):
+    from ._ops_shape import boolean_mask as _bm
+    return _bm(data, index, axis)
+
+
+def rotary_embedding(data, base=10000.0, axis=-1):
+    """RoPE (TPU-era contrib op; used by models/llama.py)."""
+    def f(x):
+        d = x.shape[-1]
+        half = d // 2
+        pos = jnp.arange(x.shape[-3], dtype=jnp.float32)
+        inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        ang = pos[:, None] * inv[None, :]
+        sin, cos = jnp.sin(ang), jnp.cos(ang)
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x2 * cos + x1 * sin], axis=-1)
+    return invoke(f, [data])
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """Reference: contrib attention fusion op family — here attention is a
+    Pallas flash kernel (kernels/flash_attention.py); this op is the naive
+    fallback for parity."""
+    def f(qkv):
+        # qkv: (T, N, 3*H*D) interleaved
+        T, N, _ = qkv.shape
+        d = qkv.shape[-1] // (3 * heads)
+        qkv_r = qkv.reshape(T, N, heads, 3, d)
+        q = qkv_r[..., 0, :]
+        k = qkv_r[..., 1, :]
+        return jnp.einsum("tnhd,snhd->nhts", q, k).reshape(
+            N * heads, T, T) / jnp.sqrt(jnp.asarray(d, qkv.dtype))
+    return invoke(f, [queries_keys_values])
+
+
+def fft_stub(*a, **k):
+    raise NotImplementedError("FFT ops: use jnp.fft via raw jax; not in the "
+                              "reference's TPU-critical path")

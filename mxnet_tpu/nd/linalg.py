@@ -1,0 +1,110 @@
+"""mx.nd.linalg namespace (reference: src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import invoke
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+           "gelqf", "syevd", "inverse", "det", "slogdet", "cholesky", "svd",
+           "norm"]
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        bb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(aa, bb)
+    return invoke(f, [A, B])
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    def f(a, b, c):
+        aa = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        bb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(aa, bb) + beta * c
+    return invoke(f, [A, B, C])
+
+
+def potrf(A):
+    return invoke(jnp.linalg.cholesky, [A])
+
+
+cholesky = potrf
+
+
+def potri(A):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        Linv = jnp.linalg.solve(L, jnp.broadcast_to(eye, a.shape))
+        return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+    return invoke(f, [A])
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    from jax.scipy.linalg import solve_triangular
+
+    def f(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        low = lower != transpose
+        if rightside:
+            x = solve_triangular(jnp.swapaxes(aa, -1, -2),
+                                 jnp.swapaxes(b, -1, -2), lower=not low)
+            return alpha * jnp.swapaxes(x, -1, -2)
+        return alpha * solve_triangular(aa, b, lower=low)
+    return invoke(f, [A, B])
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        tri = jnp.swapaxes(tri, -1, -2) if transpose else tri
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+    return invoke(f, [A, B])
+
+
+def syrk(A, transpose=False, alpha=1.0):
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose
+                        else jnp.matmul(a, at))
+    return invoke(f, [A])
+
+
+def gelqf(A):
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return tuple(invoke(f, [A], n_out=2))
+
+
+def syevd(A):
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    return tuple(invoke(f, [A], n_out=2))
+
+
+def inverse(A):
+    return invoke(jnp.linalg.inv, [A])
+
+
+def det(A):
+    return invoke(jnp.linalg.det, [A])
+
+
+def slogdet(A):
+    return tuple(invoke(lambda a: tuple(jnp.linalg.slogdet(a)), [A], n_out=2))
+
+
+def svd(A):
+    return tuple(invoke(lambda a: tuple(jnp.linalg.svd(a,
+                                                       full_matrices=False)),
+                        [A], n_out=3))
+
+
+def norm(A, ord=2, axis=None, keepdims=False):
+    from ._ops_reduce import norm as _n
+    return _n(A, ord=ord, axis=axis, keepdims=keepdims)

@@ -1,0 +1,7 @@
+"""mx.nd.sparse namespace — re-export of the sparse storage types/ops
+(reference: mxnet/ndarray/sparse.py)."""
+from ..sparse import (RowSparseNDArray, CSRNDArray, row_sparse_array,
+                      csr_matrix, dot, elemwise_add, retain, zeros)
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
+           "csr_matrix", "dot", "elemwise_add", "retain", "zeros"]

@@ -1,0 +1,499 @@
+"""Optimizers (reference: mxnet/optimizer/optimizer.py + the fork's
+multi-precision/fused update kernels).
+
+TPU-first: every update rule is a pure jax function jitted once per
+parameter shape, so a whole weight update runs as one fused XLA kernel —
+the analogue of the reference's fused SGD/LAMB CUDA kernels. Mutable
+hyperparameters (lr, wd, step count, rescale_grad) enter as traced 0-d
+arrays so LR schedules never trigger recompiles.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import lr_scheduler as lr_scheduler  # re-exported (mx.optimizer.lr_scheduler)
+from .ndarray import NDArray
+from .sparse import RowSparseNDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "LARS",
+           "RMSProp", "AdaGrad", "Adagrad", "AdaDelta", "Adadelta", "FTRL",
+           "Signum", "SGLD", "create", "register", "lr_scheduler"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None, lr_scheduler=None, param_dict=None,
+                 multi_precision=False, begin_num_update=0, **kwargs):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict = {}
+        self.wd_mult: Dict = {}
+        self.idx2name: Dict[int, str] = {}
+        self._jitted = None
+
+    # -- bookkeeping (reference API) ---------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("lr_scheduler is set; use it instead")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) \
+            if self.lr_scheduler is not None else self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= getattr(p, "lr_mult", 1.0)
+        else:
+            lr *= self.lr_mult.get(index,
+                                   self.lr_mult.get(
+                                       self.idx2name.get(index), 1.0))
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= getattr(p, "wd_mult", 1.0)
+        else:
+            wd *= self.wd_mult.get(index,
+                                   self.wd_mult.get(
+                                       self.idx2name.get(index), 1.0))
+        return wd
+
+    # -- state -------------------------------------------------------------
+    def _use_mp(self, weight):
+        return self.multi_precision and weight._data.dtype in (
+            jnp.float16, jnp.bfloat16)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self._use_mp(weight):
+            master = weight._data.astype(jnp.float32)
+            return (master, self.create_state(index, NDArray(master)))
+        return self.create_state(index, weight)
+
+    # -- update ------------------------------------------------------------
+    def _preprocess(self, g, hyper):
+        g = g * hyper["rescale"].astype(g.dtype)
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _hyper(self, index):
+        return {"lr": _f32(self._get_lr(index)),
+                "wd": _f32(self._get_wd(index)),
+                "t": jnp.asarray(self._index_update_count.get(index, 1),
+                                 jnp.int32),
+                "rescale": _f32(self.rescale_grad)}
+
+    def _jit_step(self):
+        if self._jitted is None:
+            self._jitted = jax.jit(
+                lambda w, g, state, hyper: self._step(w, g, state, hyper))
+        return self._jitted
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        hyper = self._hyper(index)
+        if self._use_mp(weight) and isinstance(state, tuple) \
+                and len(state) == 2 and isinstance(state[0], jax.Array):
+            master, inner = state
+            if isinstance(grad, RowSparseNDArray):
+                new_master, new_inner = self._sparse_step(
+                    master, grad, inner, hyper)
+            else:
+                new_master, new_inner = self._jit_step()(
+                    master, grad._data.astype(jnp.float32), inner, hyper)
+            weight._data = new_master.astype(weight._data.dtype)
+            return (new_master, new_inner)
+        if isinstance(grad, RowSparseNDArray):
+            new_w, new_state = self._sparse_step(weight._data, grad, state,
+                                                 hyper)
+        else:
+            new_w, new_state = self._jit_step()(weight._data, grad._data,
+                                                state, hyper)
+        weight._data = new_w
+        return new_state
+
+    update_multi_precision = update
+
+    def _step(self, w, g, state, hyper):
+        raise NotImplementedError
+
+    def _sparse_step(self, w, grad, state, hyper):
+        """Lazy row-sparse path: run the dense rule on touched rows only
+        (reference: lazy_update kernels)."""
+        rows = grad.indices._data.astype(jnp.int32)
+        g = grad.data._data
+        w_rows = w[rows]
+        s_rows = jax.tree_util.tree_map(
+            lambda s: s[rows] if isinstance(s, jax.Array) and
+            s.shape[:1] == w.shape[:1] else s, state)
+        new_rows, new_srows = self._step(w_rows, g, s_rows, hyper)
+        new_w = w.at[rows].set(new_rows)
+
+        def put(s, ns):
+            if isinstance(s, jax.Array) and s.shape[:1] == w.shape[:1]:
+                return s.at[rows].set(ns)
+            return ns
+        new_state = jax.tree_util.tree_map(put, state, new_srows)
+        return new_w, new_state
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr}, wd={self.wd})"
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: sgd_update / sgd_mom_update kernels)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(weight._data, dtype=jnp.float32
+                              if weight._data.dtype in (jnp.float16,
+                                                        jnp.bfloat16)
+                              else weight._data.dtype)
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g, hyper)
+        g = g + wd.astype(g.dtype) * w.astype(g.dtype)
+        if state is None:
+            return (w - lr.astype(w.dtype) * g.astype(w.dtype)), None
+        mom = self.momentum * state + g.astype(state.dtype)
+        return (w - lr.astype(w.dtype) * mom.astype(w.dtype)), mom
+
+
+@register
+class NAG(SGD):
+    """Nesterov momentum (reference: nag_mom_update)."""
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g, hyper)
+        g = g + wd.astype(g.dtype) * w.astype(g.dtype)
+        if state is None:
+            return w - lr.astype(w.dtype) * g.astype(w.dtype), None
+        mom = self.momentum * state + g.astype(state.dtype)
+        upd = g.astype(state.dtype) + self.momentum * mom
+        return (w - lr.astype(w.dtype) * upd.astype(w.dtype)), mom
+
+
+@register
+class Adam(Optimizer):
+    """Reference: adam_update (lazy variant for row_sparse)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, jnp.float32)
+        return (z, z)
+
+    def _bias_correction(self, hyper):
+        t = hyper["t"].astype(jnp.float32)
+        return 1.0 - self.beta1 ** t, 1.0 - self.beta2 ** t
+
+    def _step(self, w, g, state, hyper):
+        m, v = state
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        g = g + wd * w.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        c1, c2 = self._bias_correction(hyper)
+        upd = (m / c1) / (jnp.sqrt(v / c2) + self.epsilon)
+        return (w - (lr * upd).astype(w.dtype)), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference: contrib adamw_update)."""
+
+    def _step(self, w, g, state, hyper):
+        m, v = state
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        c1, c2 = self._bias_correction(hyper)
+        upd = (m / c1) / (jnp.sqrt(v / c2) + self.epsilon) + \
+            wd * w.astype(jnp.float32)
+        return (w - (lr * upd).astype(w.dtype)), (m, v)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (reference: the
+    fork's lamb_update kernels, arXiv:1904.00962)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, hyper):
+        m, v = state
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        mh, vh = m, v
+        if self.bias_correction:
+            t = hyper["t"].astype(jnp.float32)
+            mh = m / (1 - self.beta1 ** t)
+            vh = v / (1 - self.beta2 ** t)
+        r = mh / (jnp.sqrt(vh) + self.epsilon) + wd * w.astype(jnp.float32)
+        wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+        rnorm = jnp.linalg.norm(r)
+        ratio = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return (w - (lr * ratio * r).astype(w.dtype)), (m, v)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling for large-batch ResNet (reference:
+    the fork's lars-sgd path used in MLPerf submissions)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, jnp.float32)
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        wf = w.astype(jnp.float32)
+        wnorm = jnp.linalg.norm(wf)
+        gnorm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        g = g + wd * wf
+        mom = self.momentum * state + lr * trust * g
+        return (w - mom.astype(w.dtype)), mom
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, jnp.float32)
+        if self.centered:
+            return (z, z, z)  # n, g_avg, mom
+        return (z, z)  # n, mom
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        g = g + wd * w.astype(jnp.float32)
+        if self.centered:
+            n, ga, mom = state
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            ga = self.rho * ga + (1 - self.rho) * g
+            mom = self.momentum * mom + lr * g / jnp.sqrt(
+                n - jnp.square(ga) + self.epsilon)
+            return (w - mom.astype(w.dtype)), (n, ga, mom)
+        n, mom = state
+        n = self.rho * n + (1 - self.rho) * jnp.square(g)
+        mom = self.momentum * mom + lr * g / jnp.sqrt(n + self.epsilon)
+        return (w - mom.astype(w.dtype)), (n, mom)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, jnp.float32)
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        g = g + wd * w.astype(jnp.float32)
+        hist = state + jnp.square(g)
+        return (w - (lr * g / (jnp.sqrt(hist) + self.epsilon))
+                .astype(w.dtype)), hist
+
+
+Adagrad = AdaGrad
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, hyper):
+        acc_g, acc_d = state
+        wd = hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        g = g + wd * w.astype(jnp.float32)
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        d = jnp.sqrt(acc_d + self.epsilon) / \
+            jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * jnp.square(d)
+        return (w - d.astype(w.dtype)), (acc_g, acc_d)
+
+
+Adadelta = AdaDelta
+
+
+@register
+class FTRL(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, jnp.float32)
+        return (z, z)  # z, n
+
+    def _step(self, w, g, state, hyper):
+        zst, n = state
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        new_n = n + jnp.square(g)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        zst = zst + g - sigma * w.astype(jnp.float32)
+        new_w = jnp.where(
+            jnp.abs(zst) <= self.lamda1, 0.0,
+            -(zst - jnp.sign(zst) * self.lamda1) /
+            ((self.beta + jnp.sqrt(new_n)) / lr + wd))
+        return new_w.astype(w.dtype), (zst, new_n)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, jnp.float32)
+
+    def _step(self, w, g, state, hyper):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        if state is None:
+            upd = jnp.sign(g)
+            new_state = None
+        else:
+            new_state = self.momentum * state + (1 - self.momentum) * g
+            upd = jnp.sign(new_state)
+        new_w = (1 - lr * (wd + self.wd_lh)) * w.astype(jnp.float32) - \
+            lr * upd
+        return new_w.astype(w.dtype), new_state
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference parity). Draws the
+    noise key eagerly per update, so this rule is not jit-cached."""
+
+    def update(self, index, weight, grad, state):
+        from . import random as _random
+        self._update_count(index)
+        hyper = self._hyper(index)
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(grad._data.astype(jnp.float32), hyper)
+        g = g + wd * weight._data.astype(jnp.float32)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  jnp.float32) * jnp.sqrt(lr)
+        weight._data = (weight._data.astype(jnp.float32) - 0.5 * lr * g +
+                        noise).astype(weight._data.dtype)
+        return None
+
+
+Test = SGD  # reference keeps a test optimizer alias

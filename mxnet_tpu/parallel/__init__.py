@@ -1,0 +1,14 @@
+"""parallel — mesh/sharding utilities, data/tensor/pipeline/sequence/expert
+parallelism (TPU-first replacement for the reference's KVStore NCCL/PS
+backends; see SURVEY §2 'KVStore & distributed')."""
+from .mesh import (make_mesh, Mesh, NamedSharding, PartitionSpec, P,
+                   current_mesh, set_mesh, local_mesh, hybrid_mesh)
+
+
+def __getattr__(name):
+    # heavier submodules load lazily to keep `import mxnet_tpu` light
+    import importlib
+    if name in ("data_parallel", "tensor_parallel", "pipeline",
+                "ring_attention", "moe", "multihost"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

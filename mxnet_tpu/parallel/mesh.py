@@ -1,0 +1,59 @@
+"""Device-mesh helpers (TPU-first core; no single reference analogue —
+replaces src/kvstore device topology + NCCL communicator setup).
+
+The recipe (scaling-book): pick a mesh, name the axes (dp/fsdp/tp/pp/sp/ep),
+annotate shardings, let XLA insert collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "P",
+           "current_mesh", "set_mesh", "local_mesh", "hybrid_mesh"]
+
+P = PartitionSpec
+
+_CURRENT: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _CURRENT
+    _CURRENT = mesh
+    return mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT
+
+
+def make_mesh(axis_shapes: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    """Build a Mesh over `devices` (default: all). axis_shapes may contain
+    one -1 (inferred)."""
+    devices = list(devices if devices is not None else jax.devices())
+    shapes = list(axis_shapes)
+    if -1 in shapes:
+        known = int(_np.prod([s for s in shapes if s != -1]))
+        shapes[shapes.index(-1)] = len(devices) // known
+    n = int(_np.prod(shapes))
+    assert n <= len(devices), f"mesh {shapes} needs {n} devices, " \
+        f"have {len(devices)}"
+    arr = _np.asarray(devices[:n]).reshape(shapes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def local_mesh(dp: int = -1) -> Mesh:
+    """Pure data-parallel mesh over all local devices."""
+    return make_mesh([dp], ["dp"])
+
+
+def hybrid_mesh(dp: int = -1, tp: int = 1, pp: int = 1,
+                devices=None) -> Mesh:
+    """dp×pp×tp mesh; tp innermost so tensor-parallel collectives ride the
+    fastest ICI links (scaling-book layout rule)."""
+    return make_mesh([dp, pp, tp], ["dp", "pp", "tp"], devices)

@@ -1,0 +1,90 @@
+"""Profiler (reference: mxnet/profiler.py + src/profiler/).
+
+Wraps jax.profiler for device traces plus host-side scoped timers; dumps a
+chrome-trace-compatible JSON like the reference's profile_output.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = ["set_config", "set_state", "scope", "Timer", "dump",
+           "start_device_trace", "stop_device_trace", "summary"]
+
+_CONFIG = {"filename": "profile.json", "aggregate_stats": True}
+_STATE = {"running": False}
+_EVENTS: List[dict] = []
+_AGG: Dict[str, List[float]] = {}
+
+
+def set_config(**kwargs):
+    _CONFIG.update(kwargs)
+
+
+def set_state(state="run"):
+    _STATE["running"] = state in ("run", True)
+
+
+@contextlib.contextmanager
+def scope(name: str, sync: bool = False):
+    """Host-side scoped timer; sync=True blocks on device (accurate op
+    timing under async dispatch, like the reference's engine profiling)."""
+    if not _STATE["running"]:
+        yield
+        return
+    t0 = time.perf_counter()
+    yield
+    if sync:
+        from .ndarray import waitall
+        waitall()
+    dt = (time.perf_counter() - t0) * 1e6
+    _EVENTS.append({"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dt,
+                    "pid": 0, "tid": 0})
+    _AGG.setdefault(name, []).append(dt)
+
+
+class Timer:
+    def __init__(self, name):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = scope(self.name, sync=True)
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def start_device_trace(logdir="/tmp/jax-trace"):
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace():
+    jax.profiler.stop_trace()
+
+
+def dump(finished=True):
+    with open(_CONFIG["filename"], "w") as f:
+        json.dump({"traceEvents": _EVENTS}, f)
+    return _CONFIG["filename"]
+
+
+def summary() -> str:
+    lines = [f"{'scope':<40}{'calls':>8}{'mean_us':>12}{'total_us':>14}"]
+    for name, durs in sorted(_AGG.items()):
+        lines.append(f"{name:<40}{len(durs):>8}"
+                     f"{sum(durs) / len(durs):>12.1f}{sum(durs):>14.1f}")
+    return "\n".join(lines)
+
+
+def dumps(reset=False):
+    s = summary()
+    if reset:
+        _AGG.clear()
+        _EVENTS.clear()
+    return s

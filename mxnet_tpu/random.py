@@ -1,0 +1,63 @@
+"""Global RNG state (mx.random.seed) bridged to jax's functional keys.
+
+Reference parity: mxnet/random.py + src/resource.cc random resources. The
+reference keeps per-device cuRAND states; here a process-global key is split
+per draw (eager mode). Inside a traced/hybridized function, stateful splitting
+would bake a constant into the executable, so a *trace key* is pushed by the
+hybrid executor and draws fold a per-call counter into it — every invocation
+of the compiled graph gets fresh randomness, matching the reference's
+semantics for Dropout under CachedOp.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_STATE = threading.local()
+
+
+class _TraceKey:
+    __slots__ = ("key", "counter")
+
+    def __init__(self, key):
+        self.key = key
+        self.counter = 0
+
+
+def _st():
+    if not hasattr(_STATE, "key"):
+        _STATE.key = jax.random.PRNGKey(0)
+        _STATE.trace_stack = []
+    return _STATE
+
+
+def seed(seed_state: int, ctx=None):
+    _st().key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    s = _st()
+    if s.trace_stack:
+        tk = s.trace_stack[-1]
+        tk.counter += 1
+        return jax.random.fold_in(tk.key, tk.counter)
+    s.key, sub = jax.random.split(s.key)
+    return sub
+
+
+@contextlib.contextmanager
+def trace_key(key):
+    """Used by HybridBlock's compiled path: all draws inside derive from
+    `key` (a traced argument), keeping the executable cacheable."""
+    s = _st()
+    s.trace_stack.append(_TraceKey(key))
+    try:
+        yield
+    finally:
+        s.trace_stack.pop()
+
+
+def is_tracing_rng() -> bool:
+    return bool(_st().trace_stack)

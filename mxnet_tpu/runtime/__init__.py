@@ -1,0 +1,6 @@
+"""Host-side native runtime (reference: the C++ engine + recordio in
+src/engine, src/recordio). C++ implementations live in runtime/cc and are
+loaded via ctypes; every component has a pure-Python fallback so the
+framework works before `python -m mxnet_tpu.runtime.build` compiles them.
+"""
+from . import recordio  # noqa: F401

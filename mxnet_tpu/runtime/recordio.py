@@ -1,0 +1,170 @@
+"""RecordIO — MXNet's record file format (reference: src/recordio.cc,
+python/mxnet/recordio.py). Wire format kept byte-compatible: each record is
+[magic u32 | lrecord u32 | payload | pad to 4B], magic=0xced7230a,
+lrecord = (cflag<<29) | length. The hot path (read/seek/parse) is the C++
+library in cc/recordio.cc (ctypes); this module is the API + fallback.
+"""
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import struct
+from typing import Optional
+
+import numpy as _np
+
+_MAGIC = 0xCED7230A
+_LMASK = (1 << 29) - 1
+
+IRHeader = collections.namedtuple("IRHeader",
+                                  ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def _load_native():
+    so = os.path.join(os.path.dirname(__file__), "cc", "libmxtpu_runtime.so")
+    if os.path.exists(so):
+        try:
+            return ctypes.CDLL(so)
+        except OSError:
+            return None
+    return None
+
+
+_NATIVE = _load_native()
+
+
+class MXRecordIO:
+    """Sequential record reader/writer."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self._fp = None
+        self.open()
+
+    def open(self):
+        self._fp = open(self.uri, "wb" if self.flag == "w" else "rb")
+
+    def close(self):
+        if self._fp:
+            self._fp.close()
+            self._fp = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reset(self):
+        self._fp.seek(0)
+
+    def tell(self):
+        return self._fp.tell()
+
+    def write(self, buf: bytes):
+        assert self.flag == "w"
+        lrec = len(buf) & _LMASK
+        self._fp.write(struct.pack("<II", _MAGIC, lrec))
+        self._fp.write(buf)
+        pad = (-len(buf)) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert self.flag == "r"
+        head = self._fp.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"bad RecordIO magic {magic:#x} in {self.uri}")
+        length = lrec & _LMASK
+        buf = self._fp.read(length)
+        pad = (-length) % 4
+        if pad:
+            self._fp.read(pad)
+        return buf
+
+
+class IndexedRecordIO(MXRecordIO):
+    """Record file + .idx (key\\toffset per line) for random access."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    k, off = line.strip().split("\t")
+                    k = key_type(k)
+                    self.idx[k] = int(off)
+                    self.keys.append(k)
+
+    def close(self):
+        if self.flag == "w" and self.idx:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx_key):
+        self._fp.seek(self.idx[idx_key])
+
+    def read_idx(self, idx_key) -> bytes:
+        self.seek(idx_key)
+        return self.read()
+
+    def write_idx(self, idx_key, buf: bytes):
+        pos = self.tell()
+        self.write(buf)
+        self.idx[idx_key] = pos
+        self.keys.append(idx_key)
+
+
+# -- pack/unpack (reference: mxnet/recordio.py pack/unpack/pack_img) --------
+def pack(header: IRHeader, s: bytes) -> bytes:
+    label = header.label
+    if isinstance(label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), header.id,
+                          header.id2)
+        return hdr + s
+    label = _np.asarray(label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        arr = _np.frombuffer(payload[:flag * 4], dtype=_np.float32)
+        return IRHeader(flag, arr, id_, id2), payload[flag * 4:]
+    return IRHeader(flag, label, id_, id2), payload
+
+
+def pack_img(header: IRHeader, img: _np.ndarray, quality=95,
+             img_fmt=".raw") -> bytes:
+    """Pack an HWC uint8 image. Format: u16 h, u16 w, u8 c + raw bytes
+    (no JPEG codec dependency in this image; reference uses cv2)."""
+    img = _np.ascontiguousarray(img, dtype=_np.uint8)
+    h, w = img.shape[:2]
+    c = img.shape[2] if img.ndim == 3 else 1
+    blob = struct.pack("<HHB", h, w, c) + img.tobytes()
+    return pack(header, blob)
+
+
+def unpack_img(s: bytes, iscolor=-1):
+    header, blob = unpack(s)
+    h, w, c = struct.unpack("<HHB", blob[:5])
+    img = _np.frombuffer(blob[5:5 + h * w * c],
+                         dtype=_np.uint8).reshape(
+        (h, w, c) if c > 1 else (h, w))
+    return header, img

@@ -1,0 +1,255 @@
+"""Sparse storage types (reference: mxnet/ndarray/sparse.py +
+src/operator/tensor/cast_storage.cc, dot.cc sparse kernels).
+
+TPU-first: XLA has no native sparse tensors, so RowSparse = (indices, values)
+pair and CSR = (indptr, indices, data) triple of dense jax arrays with
+static nnz; gathers/segment-sums lower to efficient TPU ops. The payoff is
+the same as the reference's: embedding-sized gradients never materialize
+dense, and the KVStore PS path ships only touched rows.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .context import Context, current_context
+from .ndarray import NDArray, array
+
+
+class RowSparseNDArray:
+    """Rows at `indices` hold `values`; all other rows are zero."""
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape: Tuple[int, ...],
+                 ctx: Optional[Context] = None):
+        self.indices = indices if isinstance(indices, NDArray) \
+            else array(indices, dtype="int64")
+        self.data = values if isinstance(values, NDArray) else array(values)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @classmethod
+    def from_dense(cls, dense: NDArray):
+        arr = dense.asnumpy()
+        nz = _np.where(_np.any(arr.reshape(arr.shape[0], -1) != 0, axis=1))[0]
+        return cls(nz.astype(_np.int64), arr[nz], arr.shape)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(stype)
+
+    def todense(self) -> NDArray:
+        out = jnp.zeros(self._shape, self.data._data.dtype)
+        out = out.at[self.indices._data.astype(jnp.int32)].set(
+            self.data._data)
+        return NDArray(out, ctx=self._ctx)
+
+    def asnumpy(self):
+        return _np.asarray(self.todense()._data)
+
+    def copy(self):
+        return RowSparseNDArray(self.indices.copy(), self.data.copy(),
+                                self._shape, self._ctx)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            idx = jnp.concatenate([self.indices._data, other.indices._data])
+            val = jnp.concatenate([self.data._data, other.data._data])
+            return RowSparseNDArray(NDArray(idx), NDArray(val), self._shape,
+                                    self._ctx)
+        return self.todense() + other
+
+    def __mul__(self, scalar):
+        return RowSparseNDArray(self.indices, self.data * scalar,
+                                self._shape, self._ctx)
+
+    __rmul__ = __mul__
+
+    def retain(self, indices: NDArray) -> "RowSparseNDArray":
+        """Keep only the requested rows (reference: sparse_retain.cc) —
+        the row_sparse_pull primitive."""
+        want = indices._data.astype(jnp.int64)
+        have = self.indices._data
+        # membership: for each kept idx, gather matching value (dedup via
+        # segment-sum into the compact row set)
+        seg = jnp.searchsorted(want, have)
+        inrange = seg < want.shape[0]
+        hit = inrange & (jnp.where(inrange, want[jnp.clip(seg, 0,
+                         want.shape[0] - 1)], -1) == have)
+        vals = jax.ops.segment_sum(
+            jnp.where(hit[(...,) + (None,) * (self.data._data.ndim - 1)],
+                      self.data._data, 0),
+            jnp.where(hit, seg, want.shape[0]),
+            num_segments=want.shape[0] + 1)[:-1]
+        return RowSparseNDArray(NDArray(want), NDArray(vals), self._shape,
+                                self._ctx)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._shape} nnz-rows="
+                f"{self.indices.shape[0]} @{self._ctx}>")
+
+
+class CSRNDArray:
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape,
+                 ctx: Optional[Context] = None):
+        self.data = data if isinstance(data, NDArray) else array(data)
+        self.indices = indices if isinstance(indices, NDArray) \
+            else array(indices, dtype="int64")
+        self.indptr = indptr if isinstance(indptr, NDArray) \
+            else array(indptr, dtype="int64")
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @classmethod
+    def from_dense(cls, dense: NDArray):
+        arr = dense.asnumpy()
+        assert arr.ndim == 2
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(arr.shape[0]):
+            nz = _np.nonzero(arr[r])[0]
+            indices.extend(nz.tolist())
+            data.extend(arr[r, nz].tolist())
+            indptr.append(len(indices))
+        return cls(_np.asarray(data, arr.dtype),
+                   _np.asarray(indices, _np.int64),
+                   _np.asarray(indptr, _np.int64), arr.shape)
+
+    def todense(self) -> NDArray:
+        indptr = _np.asarray(self.indptr._data)
+        rows = _np.repeat(_np.arange(self._shape[0]), _np.diff(indptr))
+        out = jnp.zeros(self._shape, self.data._data.dtype)
+        out = out.at[jnp.asarray(rows),
+                     self.indices._data.astype(jnp.int32)].set(
+            self.data._data)
+        return NDArray(out, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(stype)
+
+    def asnumpy(self):
+        return _np.asarray(self.todense()._data)
+
+    def _row_ids(self):
+        indptr = _np.asarray(self.indptr._data)
+        return jnp.asarray(_np.repeat(_np.arange(self._shape[0]),
+                                      _np.diff(indptr)))
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._shape} nnz={self.data.shape[0]} "
+                f"@{self._ctx}>")
+
+
+# -- functional namespace ---------------------------------------------------
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        return RowSparseNDArray(_np.asarray(indices, _np.int64),
+                                _np.asarray(values,
+                                            dtype or _np.float32),
+                                shape, ctx)
+    if isinstance(arg, NDArray):
+        return RowSparseNDArray.from_dense(arg)
+    return RowSparseNDArray.from_dense(array(arg, dtype=dtype))
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        return CSRNDArray(_np.asarray(data, dtype or _np.float32),
+                          _np.asarray(indices, _np.int64),
+                          _np.asarray(indptr, _np.int64), shape, ctx)
+    if isinstance(arg, NDArray):
+        return CSRNDArray.from_dense(arg)
+    return CSRNDArray.from_dense(array(arg, dtype=dtype))
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse.dot: CSR×dense (forward FM/linear path, reference dot.cc
+    sparse kernels) via segment_sum — TPU-friendly static-nnz gather."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        rows = lhs._row_ids()
+        cols = lhs.indices._data.astype(jnp.int32)
+        vals = lhs.data._data
+        if transpose_a:
+            gathered = rhs._data[rows] * vals[:, None]
+            out = jax.ops.segment_sum(gathered, cols,
+                                      num_segments=lhs._shape[1])
+            return NDArray(out)
+        gathered = rhs._data[cols] * vals[:, None]
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs._shape[0])
+        return NDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from .nd import dot as _dot
+        return _dot(lhs, rhs, transpose_a, transpose_b)
+    raise TypeError(f"sparse.dot unsupported: {type(lhs)} x {type(rhs)}")
+
+
+def elemwise_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        return a + b
+    da = a.todense() if hasattr(a, "todense") else a
+    db = b.todense() if hasattr(b, "todense") else b
+    return da + db
+
+
+def retain(data: RowSparseNDArray, indices):
+    return data.retain(indices if isinstance(indices, NDArray)
+                       else array(indices, dtype="int64"))
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,), _np.int64),
+                                _np.zeros((0,) + tuple(shape[1:]),
+                                          dtype or _np.float32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype or _np.float32),
+                          _np.zeros((0,), _np.int64),
+                          _np.zeros((shape[0] + 1,), _np.int64), shape, ctx)
+    from .ndarray import zeros as _z
+    return _z(shape, ctx, dtype)

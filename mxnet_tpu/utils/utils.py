@@ -1,0 +1,68 @@
+"""gluon.utils (reference: mxnet/gluon/utils.py): batch splitting, gradient
+clipping."""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0,
+               even_split=True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(f"batch {size} not divisible by {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(i * step, (i + 1) * step
+                                if i < num_slice - 1 else size)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference API: split batch across contexts. On a TPU mesh the fused
+    data-parallel step shards instead; this covers eager multi-device
+    emulation."""
+    from ..ndarray import array
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite=True):
+    """Reference: gluon.utils.clip_global_norm."""
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(
+        a._data.astype(jnp.float32))) for a in arrays))
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-12))
+    for a in arrays:
+        a._data = (a._data.astype(jnp.float32) * scale).astype(a._data.dtype)
+    return float(total)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    h = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            d = f.read(1 << 20)
+            if not d:
+                break
+            h.update(d)
+    return h.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, **kw):
+    raise RuntimeError("no network egress in this environment; place files "
+                       "locally (vision datasets fall back to synthetic)")

@@ -1,0 +1,19 @@
+"""Test harness config: force an 8-device virtual CPU mesh (SURVEY §4).
+
+Note: the axon site hook rewrites jax_platforms to "axon,cpu" in every
+interpreter, which would dial the TPU tunnel from unit tests; the
+config.update below must run before any backend initialization.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
