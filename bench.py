@@ -1,0 +1,68 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Matches BASELINE.json's headline metric. Runs the fused train step
+(fwd+bwd+SGD in one XLA executable) in bf16 NHWC on whatever the default
+jax platform provides (the real TPU chip under the driver; CPU elsewhere).
+vs_baseline compares against the reference fork's published V100+AMP
+ResNet-50 number (~1360 img/s, ptrendx MXNet AMP benchmarks).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+REFERENCE_IMG_PER_SEC = 1360.0  # ptrendx/mxnet ResNet-50 V100 AMP
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.models.resnet import resnet50_v1
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    amp.init("bfloat16")
+    amp.convert_block(net)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                           multi_precision=True)
+    step = FusedTrainStep(net, loss_fn, opt, mesh=None)
+
+    x = mx.nd.array(np.random.rand(batch, image, image, 3)
+                    .astype(np.float32), dtype="bfloat16")
+    y = mx.nd.array(np.random.randint(0, 1000, batch), dtype="int32")
+
+    # warmup (compile)
+    step(x, y).wait_to_read()
+    step(x, y).wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = step(x, y)
+    l.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REFERENCE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""End-to-end MNIST training (reference: the PR1 Gluon MNIST example —
+unchanged workflow, only the context line differs).
+
+Usage: python examples/train_mnist.py [--epochs 1] [--hybridize]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--hybridize", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    print(f"context: {ctx}")
+
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.13, 0.31)])
+    train_set = MNIST(train=True).transform_first(tf)
+    test_set = MNIST(train=False).transform_first(tf)
+    train_data = gluon.data.DataLoader(train_set, args.batch_size,
+                                       shuffle=True)
+    test_data = gluon.data.DataLoader(test_set, args.batch_size)
+
+    net = mx.models.get_model("lenet")
+    net.initialize(init=mx.init.Xavier(), ctx=ctx)
+    if args.hybridize:
+        net.hybridize()
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        t0 = time.time()
+        for x, y in train_data:
+            x = x.as_in_context(ctx)
+            y = y.as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y).mean()
+            loss.backward()
+            trainer.step(1)
+            metric.update(y, out)
+        name, acc = metric.get()
+        print(f"epoch {epoch}: train {name}={acc:.4f} "
+              f"loss={loss.asscalar():.4f} ({time.time() - t0:.1f}s)")
+
+    metric.reset()
+    for x, y in test_data:
+        metric.update(y, net(x.as_in_context(ctx)))
+    name, acc = metric.get()
+    print(f"test {name}={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -182,11 +182,12 @@ class Block:
 
 
 class _CacheEntry:
-    __slots__ = ("jit_fn", "tr_names", "aux_names", "tensor_pos",
+    __slots__ = ("jit_fn", "raw_fn", "tr_names", "aux_names", "tensor_pos",
                  "out_treedef", "n_out")
 
     def __init__(self, jit_fn, tr_names, aux_names, tensor_pos):
         self.jit_fn = jit_fn
+        self.raw_fn = None  # unjitted fn for composition (fused train step)
         self.tr_names = tr_names
         self.aux_names = aux_names
         self.tensor_pos = tensor_pos
@@ -347,8 +348,31 @@ class HybridBlock(Block):
                 for n, v in saved.items():
                     params[n]._data._data = v
 
+        entry.raw_fn = fn
         entry.jit_fn = jax.jit(fn)
         return entry
+
+    def trace_entry(self, proto_args, training=True):
+        """Public composition hook: returns a _CacheEntry whose raw_fn
+        (tr_params, aux_params, rng_key, *tensors) -> (flat_outs, new_aux)
+        is unjitted — the fused train step (parallel/) differentiates and
+        shards it inside a single larger jit."""
+        params = self._get_params()
+        if any(p._data is None for p in params.values()):
+            # materialize deferred shapes with one eager forward, like
+            # _call_cached does, so raw_fn never sees uninitialized params
+            with autograd.pause():
+                Block.__call__(self, *proto_args)
+            self._cached_params = None
+            params = self._get_params()
+            still = [n for n, p in params.items() if p._data is None]
+            if still:
+                raise RuntimeError(
+                    f"parameters not initialized before trace_entry: "
+                    f"{still}; call net.initialize() first")
+        tensor_pos = tuple(i for i, a in enumerate(proto_args)
+                           if isinstance(a, NDArray))
+        return self._build(tensor_pos, proto_args, training, params)
 
 
 class Sequential(Block):

@@ -65,49 +65,53 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
             return
-        # threaded prefetch pipeline (C++ engine handles scheduling when
-        # built; see runtime/engine.py — falls back to Python threads)
-        q: "queue.Queue" = queue.Queue(self._prefetch)
-        sentinel = object()
+        # prefetch pipeline scheduled on the native host engine
+        # (runtime/cc/engine.cc; Python-thread fallback has the same
+        # semantics). Bounded window preserves batch order.
+        from collections import deque
+        eng = _shared_engine(self._num_workers)
+        window = deque()
+        it = iter(self._batch_sampler)
 
-        def producer():
-            try:
-                it = iter(self._batch_sampler)
-                sem = threading.Semaphore(self._num_workers)
-                threads = []
+        def submit():
+            indices = next(it, None)
+            if indices is None:
+                return False
+            ev = threading.Event()
+            slot = []
 
-                def work(idx_list, slot):
-                    try:
-                        slot.append(self._load_batch(idx_list))
-                    except Exception as e:  # surface in consumer
-                        slot.append(e)
-                    finally:
-                        sem.release()
+            def work(indices=indices, ev=ev, slot=slot):
+                try:
+                    slot.append(self._load_batch(indices))
+                except Exception as e:  # surface in consumer
+                    slot.append(e)
+                finally:
+                    ev.set()
 
-                pending = []
-                for indices in it:
-                    sem.acquire()
-                    slot = []
-                    t = threading.Thread(target=work,
-                                         args=(indices, slot), daemon=True)
-                    t.start()
-                    pending.append((t, slot))
-                    while pending and not pending[0][0].is_alive():
-                        t0, s0 = pending.pop(0)
-                        t0.join()
-                        q.put(s0[0])
-                for t0, s0 in pending:
-                    t0.join()
-                    q.put(s0[0])
-            finally:
-                q.put(sentinel)
+            eng.push(work)
+            window.append((ev, slot))
+            return True
 
-        th = threading.Thread(target=producer, daemon=True)
-        th.start()
-        while True:
-            item = q.get(timeout=self._timeout)
-            if item is sentinel:
+        for _ in range(self._prefetch):
+            if not submit():
                 break
+        while window:
+            ev, slot = window.popleft()
+            if not ev.wait(self._timeout):
+                raise TimeoutError("DataLoader worker timed out")
+            item = slot[0]
             if isinstance(item, Exception):
                 raise item
+            submit()
             yield item
+
+
+_ENGINES = {}
+
+
+def _shared_engine(num_workers):
+    from ...runtime import engine as _engine
+    key = num_workers
+    if key not in _ENGINES:
+        _ENGINES[key] = _engine.create(num_workers)
+    return _ENGINES[key]

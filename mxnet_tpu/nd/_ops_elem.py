@@ -53,7 +53,7 @@ _UNARY = {
     "sign": jnp.sign,
     "round": jnp.round,
     "rint": jnp.rint,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,
     "floor": jnp.floor,
     "ceil": jnp.ceil,
     "trunc": jnp.trunc,

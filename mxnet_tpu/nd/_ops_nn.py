@@ -63,12 +63,11 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
     channel_axis = layout.index("C")
 
     def f_nb(x, w):
+        # bf16 in/out; the MXU accumulates in fp32 internally
         return lax.conv_general_dilated(
             x, w, window_strides=stride, padding=pads,
             lhs_dilation=(1,) * nd_, rhs_dilation=dilate,
-            dimension_numbers=dn, feature_group_count=num_group,
-            preferred_element_type=jnp.float32
-            if x.dtype == jnp.bfloat16 else None)
+            dimension_numbers=dn, feature_group_count=num_group)
 
     def f(x, w, b):
         out = f_nb(x, w)

@@ -541,8 +541,11 @@ def array(source, ctx=None, dtype=None) -> NDArray:
             raw = raw.astype(resolve_dtype(dtype))
         return _make(raw, ctx)
     if dtype is None:
+        is_np = isinstance(source, _np.ndarray)
         src = _np.asarray(source)
-        if src.dtype == _np.float64:
+        if not is_np and not hasattr(source, "dtype"):
+            dtype = _np.float32  # python lists default to f32 (reference)
+        elif src.dtype == _np.float64:
             dtype = _np.float32
         elif src.dtype == _np.int64 and not jax.config.jax_enable_x64:
             dtype = _np.int32

@@ -42,6 +42,13 @@ def _f32(x):
     return jnp.asarray(x, jnp.float32)
 
 
+def _state_zeros(weight, n):
+    """n DISTINCT fp32 zero buffers. Each slot must be its own allocation:
+    the fused train step donates optimizer state (donate_argnums), and XLA
+    rejects (and would corrupt) the same buffer donated twice."""
+    return tuple(jnp.zeros(weight.shape, jnp.float32) for _ in range(n))
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
                  clip_gradient=None, lr_scheduler=None, param_dict=None,
@@ -249,8 +256,7 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
-        return (z, z)
+        return _state_zeros(weight, 2)
 
     def _bias_correction(self, hyper):
         t = hyper["t"].astype(jnp.float32)
@@ -298,8 +304,7 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
-        return (z, z)
+        return _state_zeros(weight, 2)
 
     def _step(self, w, g, state, hyper):
         m, v = state
@@ -358,10 +363,9 @@ class RMSProp(Optimizer):
         self.epsilon, self.centered = epsilon, centered
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
         if self.centered:
-            return (z, z, z)  # n, g_avg, mom
-        return (z, z)  # n, mom
+            return _state_zeros(weight, 3)  # n, g_avg, mom
+        return _state_zeros(weight, 2)  # n, mom
 
     def _step(self, w, g, state, hyper):
         lr, wd = hyper["lr"], hyper["wd"]
@@ -408,8 +412,7 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
-        return (z, z)
+        return _state_zeros(weight, 2)
 
     def _step(self, w, g, state, hyper):
         acc_g, acc_d = state
@@ -433,8 +436,7 @@ class FTRL(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
-        return (z, z)  # z, n
+        return _state_zeros(weight, 2)  # z, n
 
     def _step(self, w, g, state, hyper):
         zst, n = state

@@ -1,0 +1,201 @@
+"""Fused training step + data parallelism over the device mesh.
+
+This is the TPU-first replacement for the reference's hot training loop
+(CachedOp forward → engine backward → NCCL allreduce → fused SGD kernel;
+src/imperative + src/kvstore/kvstore_nccl.cc): ONE jit compiles
+forward + backward + gradient allreduce + optimizer update, with buffers
+donated, so a training step is a single XLA executable. Data parallelism is
+sharding, not message passing — the batch carries PartitionSpec('dp', ...)
+and XLA inserts the gradient AllReduce over ICI during the backward pass.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .. import random as _random
+from ..ndarray import NDArray
+from .mesh import current_mesh
+
+__all__ = ["FusedTrainStep", "split_batch_spec"]
+
+
+def split_batch_spec(ndim: int, axis: int = 0, dp_axis: str = "dp"):
+    spec = [None] * ndim
+    spec[axis] = dp_axis
+    return P(*spec)
+
+
+class FusedTrainStep:
+    """Compile net+loss+optimizer into one XLA executable.
+
+    Usage (bench.py / examples):
+        step = FusedTrainStep(net, loss_fn, trainer, mesh=mesh)
+        loss = step(x, y)          # one fused device step
+        step.sync_to_params()      # write weights back for checkpointing
+
+    `trainer` may be a gluon.Trainer or a raw mx.optimizer.Optimizer.
+    With a mesh, batch args are sharded over `dp_axis` and parameters are
+    replicated (pure DP); parameters whose Parameter.sharding is set keep
+    their own PartitionSpec (tensor parallelism composes — see
+    tensor_parallel.py).
+    """
+
+    def __init__(self, net, loss_fn, trainer, mesh: Optional[Mesh] = None,
+                 dp_axis: str = "dp", donate: bool = True,
+                 n_model_inputs: int = 1, grad_accum: int = 1):
+        from ..gluon.trainer import Trainer
+        self.net = net
+        self.loss_fn = loss_fn
+        if isinstance(trainer, Trainer):
+            self.optimizer = trainer._optimizer
+            self._trainer = trainer
+        else:
+            self.optimizer = trainer
+            self._trainer = None
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.dp_axis = dp_axis
+        self.donate = donate
+        self.n_model_inputs = n_model_inputs
+        self.grad_accum = grad_accum
+        self._compiled = None
+        self._params = None
+        self._tr = None
+        self._aux = None
+        self._states = None
+        self._step_count = 0
+
+    # -- state pull/push ----------------------------------------------------
+    def _init_state(self, args):
+        params = self.net.collect_params()
+        # materialize deferred params with one eager forward
+        needs_init = any(p._data is None for p in params.values())
+        if needs_init:
+            with autograd.pause():
+                self.net(*args[:self.n_model_inputs])
+            params = self.net.collect_params()
+        self._params = params
+        self._tr_names = [n for n, p in params.items()
+                         if p.grad_req != "null"]
+        self._aux_names = [n for n, p in params.items()
+                          if p.grad_req == "null"]
+        self._tr = {n: params[n].data()._data for n in self._tr_names}
+        self._aux = {n: params[n].data()._data for n in self._aux_names}
+        self._states = {n: self.optimizer.create_state(i, params[n].data())
+                        for i, n in enumerate(self._tr_names)}
+        for i, n in enumerate(self._tr_names):
+            self.optimizer.idx2name[i] = n
+
+    def sync_to_params(self):
+        """Write device weights back into the Parameters (checkpointing /
+        eval through the normal Gluon path)."""
+        for n in self._tr_names:
+            self._params[n].data()._data = self._tr[n]
+        for n in self._aux_names:
+            self._params[n].data()._data = self._aux[n]
+
+    # -- compilation ---------------------------------------------------------
+    def _param_spec(self, name) -> P:
+        p = self._params[name]
+        if p.sharding is not None:
+            return p.sharding
+        return P()  # replicated
+
+    def _build(self, args):
+        entry = self.net.trace_entry(list(args[:self.n_model_inputs]),
+                                     training=True)
+        tr_names = entry.tr_names
+        aux_names = entry.aux_names
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+        n_in = self.n_model_inputs
+        treedef_box = entry
+
+        def step(tr, aux, states, hyper, key, *batch):
+            def loss_of(tr_):
+                flat, new_aux = entry.raw_fn(tr_, aux, key, *[
+                    b for b in batch[:n_in]])
+                outs = jax.tree_util.tree_unflatten(
+                    treedef_box.out_treedef,
+                    [NDArray(f) for f in flat])
+                with autograd._mode(False, True), _random.trace_key(
+                        jax.random.fold_in(key, 7)):
+                    labels = [NDArray(b) for b in batch[n_in:]]
+                    l = loss_fn(outs, *labels) if not isinstance(
+                        outs, tuple) else loss_fn(*outs, *labels)
+                    l = l.mean()
+                return l._data.astype(jnp.float32), new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tr)
+            new_tr, new_states = {}, {}
+            for n in tr_names:
+                new_tr[n], new_states[n] = opt._step(
+                    tr[n], grads[n], states[n], hyper)
+            return loss, new_tr, new_aux, new_states
+
+        if self.mesh is not None:
+            mesh = self.mesh
+            repl = NamedSharding(mesh, P())
+            tr_sh = {n: NamedSharding(mesh, self._param_spec(n))
+                     for n in tr_names}
+            aux_sh = {n: NamedSharding(mesh, self._param_spec(n))
+                      for n in aux_names}
+            # state shards mirror their weight's sharding
+            st_sh = {n: jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, self._param_spec(n)),
+                self._states[n]) for n in tr_names}
+            batch_sh = tuple(
+                NamedSharding(mesh, split_batch_spec(
+                    _np.ndim(a._data if isinstance(a, NDArray) else a),
+                    0, self.dp_axis))
+                for a in args)
+            hyper_sh = {k: repl for k in ("lr", "wd", "t", "rescale")}
+            self._compiled = jax.jit(
+                step,
+                in_shardings=(tr_sh, aux_sh, st_sh, hyper_sh, repl,
+                              *batch_sh),
+                out_shardings=(repl, tr_sh, aux_sh, st_sh),
+                donate_argnums=(0, 2) if self.donate else ())
+            # place initial state on the mesh (args arrive single-device)
+            self._tr = {n: jax.device_put(v, tr_sh[n])
+                        for n, v in self._tr.items()}
+            self._aux = {n: jax.device_put(v, aux_sh[n])
+                         for n, v in self._aux.items()}
+            self._states = jax.device_put(self._states, st_sh)
+            self._batch_sh = batch_sh
+        else:
+            self._compiled = jax.jit(
+                step, donate_argnums=(0, 2) if self.donate else ())
+        self._tr_names = tr_names
+        self._aux_names = aux_names
+
+    # -- execution ------------------------------------------------------------
+    def __call__(self, *args) -> NDArray:
+        if self._params is None:
+            self._init_state(args)
+        if self._compiled is None:
+            self._build(args)
+        self._step_count += 1
+        self.optimizer.num_update = self._step_count
+        hyper = {"lr": jnp.asarray(self.optimizer.learning_rate,
+                                   jnp.float32),
+                 "wd": jnp.asarray(self.optimizer.wd, jnp.float32),
+                 "t": jnp.asarray(self._step_count, jnp.int32),
+                 "rescale": jnp.asarray(self.optimizer.rescale_grad,
+                                        jnp.float32)}
+        key = _random.next_key()
+        raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+               for a in args]
+        if self.mesh is not None:
+            raw = [jax.device_put(r, sh)
+                   for r, sh in zip(raw, self._batch_sh)]
+        loss, self._tr, self._aux, self._states = self._compiled(
+            self._tr, self._aux, self._states, hyper, key, *raw)
+        return NDArray(loss)

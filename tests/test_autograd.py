@@ -1,0 +1,156 @@
+"""Autograd tape tests (SURVEY §4): chain/branch, head grads, grad(),
+custom Function, train/predict modes, finite differences."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def fd_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        p, m = x.copy(), x.copy()
+        p[i] += eps
+        m[i] -= eps
+        g[i] = (f(p) - f(m)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_grad():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    a.attach_grad()
+    with autograd.record():
+        b = (a * a).sum()
+    b.backward()
+    assert np.allclose(a.grad.asnumpy(), 2 * a.asnumpy())
+
+
+def test_chain_and_branch():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * x + y  # two uses of y
+        l = z.sum()
+    l.backward()
+    # z = 2x^2 + 2x -> dz/dx = 4x + 2
+    assert np.allclose(x.grad.asnumpy(), 4 * x.asnumpy() + 2)
+
+
+def test_fd_check_composite():
+    rs = np.random.RandomState(0)
+    xv = rs.rand(3, 3).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        l = (nd.tanh(x) * nd.exp(-x) + x.sigmoid()).sum()
+    l.backward()
+
+    def f(v):
+        v = nd.array(v)
+        return float((nd.tanh(v) * nd.exp(-v) + v.sigmoid()).sum()
+                     .asscalar())
+    assert np.allclose(x.grad.asnumpy(), fd_grad(f, xv), atol=1e-2)
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            (x * 2).backward()
+    assert x.grad.asscalar() == 6.0
+    x.grad[:] = 0
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])  # y treated const
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        l = (nd.BlockGrad(x * x) + x).sum()
+    l.backward()
+    assert np.allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    with autograd.record():
+        x.attach_grad()
+        y = x * x
+    g = autograd.grad(y, x)
+    assert np.allclose(g.asnumpy(), [6.0])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.saved = x
+            return x * x
+
+        def backward(self, dy):
+            return dy * 2 * self.saved
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = nd.array([[1.0, 2.0, 3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, 2, axis=1)
+        l = (parts[0] * 2 + parts[1] * 3).sum()
+    l.backward()
+    assert np.allclose(x.grad.asnumpy(), [[2, 2, 3, 3]])
+
+
+def test_embedding_grad():
+    w = nd.random.normal(shape=(5, 3))
+    w.attach_grad()
+    idx = nd.array([0, 0, 2], dtype="int32")
+    with autograd.record():
+        out = nd.Embedding(idx, w)
+        l = out.sum()
+    l.backward()
+    g = w.grad.asnumpy()
+    assert np.allclose(g[0], 2.0) and np.allclose(g[2], 1.0) \
+        and np.allclose(g[1], 0.0)

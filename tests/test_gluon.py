@@ -1,0 +1,233 @@
+"""Gluon layer/block tests (SURVEY §4): shapes, hybridize consistency,
+deferred init, save/load, trainer."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_shape_inference():
+    net = nn.Dense(7)
+    net.initialize()
+    out = net(nd.ones((4, 3)))
+    assert out.shape == (4, 7)
+    assert net.weight.shape == (7, 3)
+
+
+def test_dense_no_flatten():
+    net = nn.Dense(7, flatten=False)
+    net.initialize()
+    assert net(nd.ones((4, 5, 3))).shape == (4, 5, 7)
+
+
+def test_conv2d_output_shape():
+    net = nn.Conv2D(8, kernel_size=3, strides=2, padding=1)
+    net.initialize()
+    out = net(nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 8, 8, 8)
+    assert net.weight.shape == (8, 3, 3, 3)
+
+
+def test_conv2d_nhwc():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, layout="NHWC")
+    net.initialize()
+    assert net(nd.ones((2, 16, 16, 3))).shape == (2, 16, 16, 8)
+
+
+def test_conv_groups_depthwise():
+    net = nn.Conv2D(6, kernel_size=3, padding=1, groups=6, in_channels=6)
+    net.initialize()
+    assert net(nd.ones((1, 6, 8, 8))).shape == (1, 6, 8, 8)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    net.initialize()
+    assert net(nd.ones((1, 3, 8, 8))).shape == (1, 4, 16, 16)
+
+
+def test_pooling():
+    x = nd.random.normal(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    # avg pool matches numpy
+    y = nn.AvgPool2D(2, 2)(x).asnumpy()
+    ref = x.asnumpy().reshape(2, 3, 4, 2, 4, 2).mean((3, 5))
+    assert np.allclose(y, ref, atol=1e-6)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm(axis=1, in_channels=3)
+    bn.initialize()
+    x = nd.random.normal(loc=3.0, scale=2.0, shape=(16, 3, 4, 4))
+    with autograd.record():
+        out = bn(x)
+    o = out.asnumpy()
+    assert abs(o.mean()) < 0.1 and abs(o.std() - 1.0) < 0.1
+    # eval mode uses running stats
+    out_eval = bn(x)
+    assert not np.allclose(o, out_eval.asnumpy())
+
+
+def test_layernorm_values():
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    x = nd.array([[1.0, 2.0, 3.0, 4.0]])
+    o = ln(x).asnumpy()
+    ref = (x.asnumpy() - 2.5) / np.sqrt(1.25 + 1e-5)
+    assert np.allclose(o, ref, atol=1e-4)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]], dtype="int32"))
+    assert out.shape == (2, 2, 4)
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    with autograd.record():
+        y1 = do(x)
+    assert (y1.asnumpy() == 0).mean() > 0.3
+    y2 = do(x)  # eval: identity
+    assert np.allclose(y2.asnumpy(), 1.0)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(axis=1),
+            nn.Dense(3))
+    net.initialize()
+    x = nd.random.normal(shape=(5, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, atol=1e-5)
+    # second call uses the cache
+    hybrid2 = net(x).asnumpy()
+    assert np.allclose(hybrid, hybrid2)
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    mx.random.seed(7)
+    x = nd.random.normal(shape=(4, 5))
+    net1 = build()
+    net1.initialize()
+    net1(x)  # materialize deferred shapes
+    net2 = build()
+    net2.initialize()
+    net2(x)
+    # copy params
+    p1 = net1.collect_params()
+    p2 = net2.collect_params()
+    for k in p1.keys():
+        p2[k].set_data(p1[k].data())
+    net2.hybridize()
+    for net in (net1, net2):
+        with autograd.record():
+            l = (net(x) ** 2).sum()
+        l.backward()
+    for k in p1.keys():
+        assert np.allclose(p1[k].grad().asnumpy(),
+                           p2[k].grad().asnumpy(), atol=1e-5), k
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = nd.random.normal(shape=(3, 4))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "w.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net2.load_parameters(f)
+    assert np.allclose(net2(x).asnumpy(), ref)
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, use_bias=False, in_units=1)
+    net.initialize(init=mx.init.One())
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    x = nd.array([[2.0]])
+    with autograd.record():
+        l = net(x).sum()
+    l.backward()
+    tr.step(1)
+    # w <- 1 - 0.1 * 2
+    assert np.allclose(net.weight.data().asnumpy(), [[0.8]], atol=1e-6)
+
+
+def test_trainer_learns():
+    mx.random.seed(3)
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+    w_true = np.array([[1.5], [-2.0]], np.float32)
+    X = np.random.RandomState(0).rand(64, 2).astype(np.float32)
+    Y = X @ w_true
+    l2 = mx.gluon.loss.L2Loss()
+    for _ in range(100):
+        xb, yb = nd.array(X), nd.array(Y)
+        with autograd.record():
+            l = l2(net(xb), yb).mean()
+        l.backward()
+        tr.step(64)
+    assert l.asscalar() < 0.01
+
+
+def test_constant_and_grad_req():
+    p = mx.gluon.Parameter("w", shape=(2,), grad_req="null")
+    p.initialize()
+    assert p.grad_req == "null"
+    c = mx.gluon.Constant("c", [1.0, 2.0])
+    c.initialize()
+    assert np.allclose(c.data().asnumpy(), [1, 2])
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.BatchNorm(axis=1))
+    net.initialize()
+    net(nd.ones((2, 3)))
+    all_p = net.collect_params()
+    wsel = net.collect_params(".*weight")
+    assert len(wsel) == 1
+    assert any("running_mean" in k for k in all_p.keys())
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert net[1]._units == 5
+
+
+def test_lr_scheduler_in_trainer():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                            base_lr=1.0)
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = nd.ones((1, 1))
+    for i in range(5):
+        with autograd.record():
+            l = net(x).sum()
+        l.backward()
+        tr.step(1)
+    assert tr.learning_rate < 1.0

@@ -1,0 +1,136 @@
+"""Loss + metric tests (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_l2_loss():
+    l = gloss.L2Loss()
+    out = l(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    assert np.allclose(out.asnumpy(), [0.5, 2.0])
+
+
+def test_l1_loss():
+    out = gloss.L1Loss()(nd.array([[1.0, -3.0]]), nd.array([[0.0, 0.0]]))
+    assert np.allclose(out.asnumpy(), [2.0])
+
+
+def test_softmax_ce_sparse_vs_dense():
+    logits = nd.array([[1.0, 2.0, 3.0], [1.0, 2.0, 3.0]])
+    sparse = gloss.SoftmaxCrossEntropyLoss()(logits, nd.array([2, 0]))
+    dense = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        logits, nd.one_hot(nd.array([2, 0], dtype="int32"), 3))
+    assert np.allclose(sparse.asnumpy(), dense.asnumpy(), atol=1e-5)
+    ref0 = -np.log(np.exp(3) / np.exp([1, 2, 3]).sum())
+    assert np.allclose(sparse.asnumpy()[0], ref0, atol=1e-5)
+
+
+def test_sigmoid_bce_stable():
+    l = gloss.SigmoidBCELoss()
+    big = l(nd.array([[100.0]]), nd.array([[0.0]]))
+    assert np.isfinite(big.asscalar()) and big.asscalar() > 50
+    from_sig = gloss.SigmoidBCELoss(from_sigmoid=True)(
+        nd.array([[0.8]]), nd.array([[1.0]]))
+    assert np.allclose(from_sig.asscalar(), -np.log(0.8), atol=1e-5)
+
+
+def test_kl_huber_hinge():
+    p = nd.array([[0.5, 0.5]])
+    q = nd.log_softmax(nd.array([[0.0, 0.0]]))
+    kl = gloss.KLDivLoss()(q, p)
+    assert np.allclose(kl.asscalar(), 0.0, atol=1e-6)
+    h = gloss.HuberLoss(rho=1.0)(nd.array([3.0]), nd.array([0.0]))
+    assert np.allclose(h.asscalar(), 2.5)
+    hi = gloss.HingeLoss()(nd.array([0.5]), nd.array([1.0]))
+    assert np.allclose(hi.asscalar(), 0.5)
+
+
+def test_triplet():
+    t = gloss.TripletLoss(margin=1.0)
+    out = t(nd.array([[0.0]]), nd.array([[0.0]]), nd.array([[2.0]]))
+    assert np.allclose(out.asscalar(), 0.0)  # neg far -> no loss
+
+
+def test_ctc_loss_decreases():
+    mx.random.seed(0)
+    T, N, C, L = 8, 2, 5, 3
+    logits = nd.random.normal(shape=(N, T, C))
+    logits.attach_grad()
+    labels = nd.array([[1, 2, 3], [2, 3, -1]])
+    ctc = gloss.CTCLoss()
+    with autograd.record():
+        l = ctc(logits, labels).mean()
+    l.backward()
+    assert np.isfinite(l.asscalar())
+    assert np.isfinite(logits.grad.asnumpy()).all()
+    # gradient step reduces loss
+    l0 = l.asscalar()
+    logits2 = nd.array(logits.asnumpy() - 0.5 * logits.grad.asnumpy())
+    l1 = ctc(logits2, labels).mean().asscalar()
+    assert l1 < l0
+
+
+def test_losses_are_differentiable():
+    for L, args in [
+        (gloss.L2Loss(), (nd.ones((2, 3)), nd.zeros((2, 3)))),
+        (gloss.SoftmaxCrossEntropyLoss(),
+         (nd.ones((2, 4)), nd.array([0, 1]))),
+        (gloss.SigmoidBCELoss(), (nd.ones((2, 3)), nd.zeros((2, 3)))),
+    ]:
+        x = args[0]
+        x.attach_grad()
+        with autograd.record():
+            out = L(x, *args[1:]).mean()
+        out.backward()
+        assert np.isfinite(x.grad.asnumpy()).all()
+
+
+def test_accuracy_metric():
+    m = mx.metric.Accuracy()
+    m.update(nd.array([1, 0]), nd.array([[0.1, 0.9], [0.8, 0.2]]))
+    assert m.get()[1] == 1.0
+    m.update(nd.array([[1], [1]]), nd.array([[0.9, 0.1], [0.1, 0.9]]))
+    assert m.get()[1] == 0.75
+
+
+def test_topk_f1_mcc():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    m.update(nd.array([2]), nd.array([[0.3, 0.1, 0.2]]))
+    assert m.get()[1] == 1.0
+    f1 = mx.metric.F1()
+    f1.update(nd.array([1, 0, 1]), nd.array([[0.1, 0.9], [0.9, 0.1],
+                                             [0.9, 0.1]]))
+    assert 0 < f1.get()[1] < 1
+    mcc = mx.metric.MCC()
+    mcc.update(nd.array([1, 0]), nd.array([[0.1, 0.9], [0.9, 0.1]]))
+    assert np.isclose(mcc.get()[1], 1.0)
+
+
+def test_regression_metrics():
+    mae = mx.metric.MAE()
+    mae.update(nd.array([1.0, 2.0]), nd.array([2.0, 4.0]))
+    assert np.isclose(mae.get()[1], 1.5)
+    rmse = mx.metric.RMSE()
+    rmse.update(nd.array([0.0]), nd.array([3.0]))
+    assert np.isclose(rmse.get()[1], 3.0)
+
+
+def test_perplexity_composite():
+    p = mx.metric.Perplexity()
+    p.update(nd.array([0]), nd.array([[1.0, 0.0]]))
+    assert np.isclose(p.get()[1], 1.0, atol=1e-6)
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.TopKAccuracy(top_k=2))
+    comp.update(nd.array([1]), nd.array([[0.1, 0.9]]))
+    names, vals = comp.get()
+    assert len(names) == 2
+
+
+def test_custom_metric():
+    m = mx.metric.create(lambda l, p: float(np.abs(l - p).sum()))
+    m.update(nd.array([1.0]), nd.array([3.0]))
+    assert m.get()[1] == 2.0

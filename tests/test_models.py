@@ -1,0 +1,172 @@
+"""Model-family tests: tiny-config forward shapes + one-batch training
+sanity (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+
+def test_lenet_mnist_shapes():
+    net = mx.models.get_model("lenet")
+    net.initialize()
+    out = net(nd.random.normal(shape=(2, 1, 28, 28)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_thumbnail():
+    net = mx.models.get_model("resnet18_v1", classes=10, thumbnail=True,
+                              layout="NHWC")
+    net.initialize()
+    with autograd.record():
+        out = net(nd.random.normal(shape=(2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_v2_forward():
+    net = mx.models.get_model("resnet50_v2", classes=10, layout="NHWC")
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 64, 64, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_mobilenet_v2():
+    net = mx.models.get_model("mobilenetv2_0.5", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 64, 64, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_bert_tiny_forward_and_train():
+    net = mx.models.get_model("bert_tiny")
+    net.initialize()
+    ids = nd.array(np.random.randint(0, 128, (2, 16)), dtype="int32")
+    seg = nd.zeros((2, 16), dtype="int32")
+    vl = nd.array([16, 10])
+    mlm, nsp = net(ids, seg, vl)
+    assert mlm.shape == (2, 16, 128)
+    assert nsp.shape == (2, 2)
+    # MLM loss decreases over a few fused steps
+    def loss_fn(outs, labels, nsp_labels):
+        mlm_logits, nsp_logits = outs
+        ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        return ce(mlm_logits.reshape(-1, 128), labels.reshape(-1)).mean() \
+            + ce(nsp_logits, nsp_labels).mean()
+    # FusedTrainStep passes tuple outs via loss_fn(*outs, *labels)
+    def loss_flat(mlm_logits, nsp_logits, labels, nsp_labels):
+        ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        return ce(mlm_logits.reshape(-1, 128), labels.reshape(-1)).mean() \
+            + ce(nsp_logits, nsp_labels).mean()
+    opt = mx.optimizer.Adam(learning_rate=3e-3)
+    step = FusedTrainStep(net, loss_flat, opt, mesh=None,
+                          n_model_inputs=3)
+    labels = ids
+    nsp_labels = nd.array([0, 1])
+    l0 = step(ids, seg, vl, labels, nsp_labels).asscalar()
+    for _ in range(8):
+        l = step(ids, seg, vl, labels, nsp_labels)
+    assert l.asscalar() < l0
+
+
+def test_transformer_tiny_mt():
+    net = mx.models.get_model("transformer_tiny")
+    net.initialize()
+    src = nd.array(np.random.randint(0, 100, (2, 8)), dtype="int32")
+    tgt = nd.array(np.random.randint(0, 100, (2, 6)), dtype="int32")
+    vl = nd.array([8, 5])
+    out = net(src, tgt, vl)
+    assert out.shape == (2, 6, 100)
+    # causal check: logits at position t must not depend on tgt[t+1:]
+    tgt2 = tgt.asnumpy().copy()
+    tgt2[:, -1] = (tgt2[:, -1] + 1) % 100
+    with autograd.predict_mode():
+        o1 = net(src, tgt, vl).asnumpy()
+        o2 = net(src, nd.array(tgt2, dtype="int32"), vl).asnumpy()
+    assert np.allclose(o1[:, :-1], o2[:, :-1], atol=1e-4)
+
+
+def test_llama_tiny_train():
+    net = mx.models.get_model("llama_tiny")
+    net.initialize()
+    ids = nd.array(np.random.randint(0, 256, (2, 16)), dtype="int32")
+    out = net(ids)
+    assert out.shape == (2, 16, 256)
+    # causality
+    ids2 = ids.asnumpy().copy()
+    ids2[:, -1] = (ids2[:, -1] + 1) % 256
+    o1 = net(ids).asnumpy()
+    o2 = net(nd.array(ids2, dtype="int32")).asnumpy()
+    assert np.allclose(o1[:, :-1], o2[:, :-1], atol=1e-4)
+
+
+def test_fm_sparse_train():
+    from mxnet_tpu.sparse import CSRNDArray
+    rs = np.random.RandomState(0)
+    n_feat, batch = 50, 16
+    net = mx.models.get_model("factorization_machine", num_features=n_feat,
+                              factor_dim=4)
+    net.initialize()
+    dense = (rs.rand(batch, n_feat) < 0.1).astype(np.float32) * \
+        rs.rand(batch, n_feat).astype(np.float32)
+    x = CSRNDArray.from_dense(nd.array(dense))
+    w_true = rs.randn(n_feat).astype(np.float32)
+    y = nd.array(dense @ w_true)
+    l2 = mx.gluon.loss.L2Loss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.1})
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            l = l2(net(x), y).mean()
+        l.backward()
+        tr.step(batch)
+        losses.append(l.asscalar())
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_rnn_layers():
+    from mxnet_tpu.gluon import rnn
+    for cls, nstate in [(rnn.LSTM, 2), (rnn.GRU, 1), (rnn.RNN, 1)]:
+        layer = cls(8, num_layers=2)
+        layer.initialize()
+        x = nd.random.normal(shape=(5, 3, 4))  # (T, N, C)
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        out, states = layer(x, layer.begin_state(3))
+        assert len(states) == nstate
+        assert states[0].shape == (2, 3, 8)
+
+
+def test_rnn_bidirectional():
+    from mxnet_tpu.gluon import rnn
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True)
+    layer.initialize()
+    out = layer(nd.random.normal(shape=(5, 3, 4)))
+    assert out.shape == (5, 3, 16)
+
+
+def test_rnn_cells_unroll():
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.random.normal(shape=(3, 6, 4))  # (N, T, C)
+    out, states = cell.unroll(6, x, layout="NTC")
+    assert out.shape == (3, 6, 8)
+    gru = rnn.GRUCell(8)
+    gru.initialize()
+    out, _ = gru.unroll(6, x, layout="NTC")
+    assert out.shape == (3, 6, 8)
+
+
+def test_rnn_grad_flows():
+    from mxnet_tpu.gluon import rnn
+    layer = rnn.LSTM(4, num_layers=1)
+    layer.initialize()
+    x = nd.random.normal(shape=(3, 2, 4))
+    with autograd.record():
+        l = layer(x).sum()
+    l.backward()
+    w = layer.collect_params()
+    g = w["l0_i2h_weight"].grad().asnumpy()
+    assert np.abs(g).sum() > 0

@@ -1,0 +1,171 @@
+"""Op-level tests: numeric parity vs numpy (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    assert nd.zeros((2, 3)).asnumpy().tolist() == [[0] * 3] * 2
+    assert nd.ones((2,)).asnumpy().tolist() == [1, 1]
+    assert nd.full((2, 2), 7).asnumpy().tolist() == [[7, 7], [7, 7]]
+    assert np.allclose(nd.arange(0, 5).asnumpy(), np.arange(0, 5))
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32  # python int lists default to f32
+    b = nd.array(np.eye(3))
+    assert b.dtype == np.float32  # float64 downcast
+
+
+def test_arithmetic_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    assert np.allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    assert np.allclose((a * 2 + 1).asnumpy(), [[3, 5], [7, 9]])
+    assert np.allclose((1.0 / a).asnumpy(), 1.0 / a.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert np.allclose((-a).asnumpy(), -a.asnumpy())
+    assert np.allclose((a - b).asnumpy(), a.asnumpy() - b.asnumpy())
+
+
+def test_comparison_masks():
+    a = nd.array([1.0, 2.0, 3.0])
+    m = a > 1.5
+    assert m.asnumpy().tolist() == [0.0, 1.0, 1.0]
+    assert (a == 2.0).asnumpy().tolist() == [0.0, 1.0, 0.0]
+
+
+def test_indexing():
+    a = nd.arange(0, 12).reshape(3, 4)
+    assert a[1].asnumpy().tolist() == [4, 5, 6, 7]
+    assert a[1:3, 0:2].shape == (2, 2)
+    a[0, 0] = 99.0
+    assert a.asnumpy()[0, 0] == 99.0
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.take(a, idx).shape == (2, 4)
+
+
+def test_reshape_transpose():
+    a = nd.arange(0, 6).reshape(2, 3)
+    assert a.T.shape == (3, 2)
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.reshape(0, 3).shape == (2, 3)  # 0 = copy dim
+    assert nd.expand_dims(a, 0).shape == (1, 2, 3)
+    assert nd.flip(a, 1).asnumpy()[0].tolist() == [2, 1, 0]
+
+
+def test_reductions():
+    x = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert np.allclose(a.sum().asscalar(), x.sum(), rtol=1e-5)
+    assert np.allclose(nd.mean(a, axis=1).asnumpy(), x.mean(1), rtol=1e-5)
+    assert np.allclose(nd.max(a, axis=(0, 2)).asnumpy(), x.max((0, 2)))
+    assert np.allclose(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                       x.sum(axis=(0, 2)), rtol=1e-5)
+    assert int(nd.argmax(a.reshape(3, 20), axis=1).asnumpy()[0]) == \
+        int(x.reshape(3, 20).argmax(1)[0])
+
+
+def test_unary_math():
+    x = np.random.RandomState(1).rand(4, 4).astype(np.float32) + 0.1
+    a = nd.array(x)
+    for name, ref in [("exp", np.exp), ("log", np.log),
+                      ("sqrt", np.sqrt), ("abs", np.abs),
+                      ("sin", np.sin), ("tanh", np.tanh)]:
+        assert np.allclose(getattr(nd, name)(a).asnumpy(), ref(x),
+                           rtol=1e-5, atol=1e-6), name
+
+
+def test_dot_semantics():
+    # MXNet dot contracts last axis of lhs with first of rhs
+    a = nd.ones((2, 3))
+    b = nd.ones((3, 4))
+    assert nd.dot(a, b).shape == (2, 4)
+    c = nd.ones((2, 3, 4))
+    d = nd.ones((4, 5))
+    assert nd.dot(c, d).shape == (2, 3, 5)
+    assert nd.batch_dot(nd.ones((5, 2, 3)), nd.ones((5, 3, 4))).shape == \
+        (5, 2, 4)
+    assert nd.dot(a, nd.ones((4, 3)), transpose_b=True).shape == (2, 4)
+
+
+def test_concat_split_defaults():
+    a = nd.ones((2, 3))
+    # reference default dim=1
+    assert nd.concat(a, a).shape == (2, 6)
+    assert nd.concat(a, a, dim=0).shape == (4, 3)
+    parts = nd.split(nd.ones((2, 6)), 2)  # default axis=1
+    assert parts[0].shape == (2, 3)
+    assert nd.stack(a, a).shape == (2, 2, 3)
+
+
+def test_where_clip_onehot():
+    a = nd.array([1.0, -2.0, 3.0])
+    assert nd.where(a > 0, a, nd.zeros_like(a)).asnumpy().tolist() == \
+        [1.0, 0.0, 3.0]
+    assert a.clip(-1, 1).asnumpy().tolist() == [1.0, -1.0, 1.0]
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_gather_scatter():
+    data = nd.arange(0, 9).reshape(3, 3)
+    idx = nd.array([[0, 2], [1, 0]], dtype="int32")
+    g = nd.gather_nd(data, idx)
+    assert g.asnumpy().tolist() == [1.0, 6.0]
+    s = nd.scatter_nd(nd.array([5.0, 7.0]), idx, (3, 3))
+    assert s.asnumpy()[0, 1] == 5.0 and s.asnumpy()[2, 0] == 7.0
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    assert nd.topk(a, k=2, ret_typ="value").asnumpy().tolist() == [[3, 2]]
+    assert nd.sort(a).asnumpy().tolist() == [[1, 2, 3]]
+    assert nd.argsort(a).asnumpy().tolist() == [[1, 2, 0]]
+
+
+def test_sequence_ops():
+    x = nd.ones((4, 2, 3))  # (T, N, C)
+    sl = nd.array([2, 4])
+    m = nd.SequenceMask(x, sl, use_sequence_length=True, value=0.0)
+    out = m.asnumpy()
+    assert out[1, 0].sum() == 3 and out[2, 0].sum() == 0
+    last = nd.SequenceLast(x * nd.arange(1, 5).reshape(4, 1, 1), sl,
+                           use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], 2.0)
+    assert np.allclose(last.asnumpy()[1], 4.0)
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    assert a.astype("int32").asnumpy().dtype == np.int32
+    assert nd.cast(a, "float16").asnumpy().dtype == np.float16
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    b = a
+    a += 1
+    assert b.asnumpy()[0, 0] == 2.0  # same object mutated
+    a *= 3
+    assert b.asnumpy()[0, 0] == 6.0
+
+
+def test_context_api():
+    assert mx.cpu().device_type == "cpu"
+    assert mx.gpu(0).device_type == "tpu"  # alias
+    with mx.Context("cpu", 0):
+        x = nd.zeros((1,))
+    assert x.context.device_type == "cpu"
+    assert mx.num_gpus() == mx.num_tpus()
+
+
+def test_waitall_and_async():
+    a = nd.ones((64, 64))
+    for _ in range(5):
+        a = a @ a * 0.01
+    a.wait_to_read()
+    mx.waitall()
+    assert np.isfinite(a.asnumpy()).all()

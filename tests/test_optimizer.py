@@ -1,0 +1,152 @@
+"""Optimizer update-rule tests vs hand-computed references (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.sparse import RowSparseNDArray
+
+
+def run_steps(opt, w0, grads):
+    w = nd.array(np.array(w0, np.float32))
+    state = opt.create_state_multi_precision(0, w)
+    for g in grads:
+        state = opt.update(0, w, nd.array(np.array(g, np.float32)), state)
+    return w.asnumpy()
+
+
+def test_sgd_plain():
+    out = run_steps(mx.optimizer.SGD(learning_rate=0.1), [1.0], [[1.0]])
+    assert np.allclose(out, [0.9])
+
+
+def test_sgd_momentum():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    out = run_steps(opt, [1.0], [[1.0], [1.0]])
+    # m1=1, w=1-0.1; m2=0.9+1=1.9, w=0.9-0.19
+    assert np.allclose(out, [0.71], atol=1e-6)
+
+
+def test_sgd_wd():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    out = run_steps(opt, [1.0], [[0.0]])
+    assert np.allclose(out, [1.0 - 0.1 * 0.1])
+
+
+def test_nag():
+    opt = mx.optimizer.NAG(learning_rate=0.1, momentum=0.9)
+    out = run_steps(opt, [1.0], [[1.0]])
+    # mom=1; upd=1+0.9*1=1.9; w=1-0.19
+    assert np.allclose(out, [0.81], atol=1e-6)
+
+
+def test_adam_first_step():
+    opt = mx.optimizer.Adam(learning_rate=0.001)
+    out = run_steps(opt, [1.0], [[0.5]])
+    # first step of adam moves by ~lr regardless of grad scale
+    assert np.allclose(out, [1.0 - 0.001], atol=1e-5)
+
+
+def test_adamw_decoupled():
+    opt = mx.optimizer.AdamW(learning_rate=0.0, wd=0.1)
+    out = run_steps(opt, [1.0], [[0.5]])
+    assert np.allclose(out, [1.0])  # lr=0 -> no update incl. wd
+
+
+def test_rmsprop():
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, rho=0.9, momentum=0.0)
+    out = run_steps(opt, [1.0], [[1.0]])
+    n = 0.1
+    expect = 1.0 - 0.01 * 1.0 / np.sqrt(n + 1e-8)
+    assert np.allclose(out, [expect], atol=1e-5)
+
+
+def test_adagrad():
+    opt = mx.optimizer.AdaGrad(learning_rate=0.1)
+    out = run_steps(opt, [1.0], [[2.0]])
+    assert np.allclose(out, [1.0 - 0.1 * 2.0 / (2.0 + 1e-7)], atol=1e-5)
+
+
+def test_lamb_moves():
+    opt = mx.optimizer.LAMB(learning_rate=0.01)
+    out = run_steps(opt, [1.0, 2.0], [[0.1, 0.2]])
+    assert np.all(out < [1.0, 2.0])
+
+
+def test_lars_moves():
+    opt = mx.optimizer.LARS(learning_rate=0.1)
+    out = run_steps(opt, [1.0], [[1.0]])
+    assert out[0] < 1.0
+
+
+def test_signum():
+    opt = mx.optimizer.Signum(learning_rate=0.1, momentum=0.0)
+    out = run_steps(opt, [1.0], [[-3.0]])
+    assert np.allclose(out, [1.1], atol=1e-6)
+
+
+def test_ftrl_sparsifies():
+    opt = mx.optimizer.FTRL(lamda1=10.0, learning_rate=0.1)
+    out = run_steps(opt, [0.5], [[0.01]])
+    assert np.allclose(out, [0.0])  # l1 dominates
+
+
+def test_clip_gradient():
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=0.1)
+    out = run_steps(opt, [1.0], [[100.0]])
+    assert np.allclose(out, [0.9])
+
+
+def test_rescale_grad():
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=0.5)
+    out = run_steps(opt, [1.0], [[1.0]])
+    assert np.allclose(out, [0.5])
+
+
+def test_multi_precision_bf16():
+    opt = mx.optimizer.SGD(learning_rate=0.0001, momentum=0.9,
+                           multi_precision=True)
+    w = nd.array(np.ones(4, np.float32)).astype("bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[0].dtype == np.float32
+    for _ in range(10):
+        state = opt.update(0, w, nd.array(np.full(4, 1e-3)).astype(
+            "bfloat16"), state)
+    # master accumulated tiny updates that bf16 alone would lose
+    master = np.asarray(state[0])
+    assert (master < 1.0).all()
+
+
+def test_sparse_lazy_update():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w = nd.array(np.ones((4, 2), np.float32))
+    state = opt.create_state(0, w)
+    g = RowSparseNDArray(np.array([1], np.int64),
+                         np.full((1, 2), 1.0, np.float32), (4, 2))
+    state = opt.update(0, w, g, state)
+    out = w.asnumpy()
+    assert np.allclose(out[1], 0.9) and np.allclose(out[0], 1.0)
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(15) == 0.5
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                             base_lr=1.0)
+    assert np.isclose(m(7), 0.1)
+    assert np.isclose(m(12), 0.01)
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert np.isclose(p(50), 0.5)
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert np.isclose(c(50), 0.5)
+    w = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1,
+                                      warmup_steps=10)
+    assert w(5) < 1.0
+
+
+def test_optimizer_create_registry():
+    for name in ["sgd", "adam", "adamw", "lamb", "rmsprop", "adagrad",
+                 "adadelta", "ftrl", "nag", "signum", "lars"]:
+        opt = mx.optimizer.create(name)
+        assert isinstance(opt, mx.optimizer.Optimizer)
