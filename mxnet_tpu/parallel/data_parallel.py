@@ -23,13 +23,102 @@ from .. import random as _random
 from ..ndarray import NDArray
 from .mesh import current_mesh
 
-__all__ = ["FusedTrainStep", "split_batch_spec"]
+__all__ = ["FusedTrainStep", "ShardedForward", "split_batch_spec"]
 
 
 def split_batch_spec(ndim: int, axis: int = 0, dp_axis: str = "dp"):
     spec = [None] * ndim
     spec[axis] = dp_axis
     return P(*spec)
+
+
+class ShardedForward:
+    """Mesh-sharded inference: jit the traced forward with parameter
+    shardings (Parameter.sharding, replicated otherwise) and the batch
+    split over `dp_axis`. The inference twin of FusedTrainStep — tensor-
+    parallel layers' sharding constraints only bind inside this compiled
+    region."""
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 dp_axis: str = "dp", training: bool = False):
+        self.net = net
+        self.mesh = mesh if mesh is not None else current_mesh()
+        if self.mesh is None:
+            raise ValueError(
+                "ShardedForward needs an active mesh (pass mesh= or "
+                "parallel.set_mesh(...)); for single-device inference "
+                "just call the net (hybridized) directly")
+        self.dp_axis = dp_axis
+        self.training = training
+        self._compiled = None
+        self._entry = None
+        self._seen = {}  # param name -> id of host array last placed
+
+    def _build(self, args):
+        mesh = self.mesh
+        params = self.net.collect_params()
+        if any(p._data is None for p in params.values()):
+            with autograd.pause():
+                self.net(*args)
+            params = self.net.collect_params()
+        entry = self.net.trace_entry(list(args), training=self.training)
+        self._entry = entry
+
+        def spec_of(n):
+            s = params[n].sharding
+            return s if s is not None else P()
+
+        tr_sh = {n: NamedSharding(mesh, spec_of(n))
+                 for n in entry.tr_names}
+        aux_sh = {n: NamedSharding(mesh, spec_of(n))
+                  for n in entry.aux_names}
+        dp = self.dp_axis if (mesh is not None and
+                              self.dp_axis in mesh.axis_names) else None
+        batch_sh = tuple(
+            NamedSharding(mesh, split_batch_spec(
+                _np.ndim(a._data if isinstance(a, NDArray) else a), 0,
+                dp)) if dp else NamedSharding(mesh, P())
+            for a in args)
+        repl = NamedSharding(mesh, P())
+
+        def fwd(tr, aux, key, *batch):
+            flat, _ = entry.raw_fn(tr, aux, key, *batch)
+            return flat
+
+        self._compiled = jax.jit(
+            fwd, in_shardings=(tr_sh, aux_sh, repl, *batch_sh))
+        self._params = params
+        self._tr_sh, self._aux_sh = tr_sh, aux_sh
+        self._tr, self._aux = {}, {}
+        self._refresh()
+        self._batch_sh = batch_sh
+
+    def _refresh(self):
+        """(Re-)place any parameter whose host array changed since the
+        last call (e.g. set_data / load_parameters between evals)."""
+        for names, store, shs in ((self._entry.tr_names, self._tr,
+                                   self._tr_sh),
+                                  (self._entry.aux_names, self._aux,
+                                   self._aux_sh)):
+            for n in names:
+                v = self._params[n].data()._data
+                if self._seen.get(n) != id(v):
+                    store[n] = jax.device_put(v, shs[n])
+                    self._seen[n] = id(v)
+
+    def __call__(self, *args):
+        if self._compiled is None:
+            self._build(args)
+        else:
+            self._refresh()
+        key = _random.next_key()
+        raw = [jax.device_put(
+            a._data if isinstance(a, NDArray) else jnp.asarray(a), sh)
+            for a, sh in zip(args, self._batch_sh)]
+        flat = self._compiled(self._tr, self._aux, key, *raw)
+        out = jax.tree_util.tree_unflatten(
+            self._entry.out_treedef, [NDArray(f) for f in flat])
+        return out
 
 
 class FusedTrainStep:
@@ -94,11 +183,20 @@ class FusedTrainStep:
 
     def sync_to_params(self):
         """Write device weights back into the Parameters (checkpointing /
-        eval through the normal Gluon path)."""
+        eval through the normal Gluon path). Mesh-sharded weights are
+        gathered to a single replicated array so eager code can use them."""
+        def unshard(v):
+            if not hasattr(v, "sharding") or \
+                    len(v.sharding.device_set) <= 1:
+                return v
+            if v.sharding.is_fully_replicated:
+                # one shard already holds the full value — no host copy
+                return v.addressable_shards[0].data
+            return jnp.asarray(_np.asarray(v))  # gather sharded dims
         for n in self._tr_names:
-            self._params[n].data()._data = self._tr[n]
+            self._params[n].data()._data = unshard(self._tr[n])
         for n in self._aux_names:
-            self._params[n].data()._data = self._aux[n]
+            self._params[n].data()._data = unshard(self._aux[n])
 
     # -- compilation ---------------------------------------------------------
     def _param_spec(self, name) -> P:

@@ -1,0 +1,184 @@
+"""Tensor (model) parallelism — Megatron-style sharded layers, TPU-first.
+
+Reference parity: ptrendx/mxnet scales large layers with NCCL allreduce
+inside manually-split ops (src/kvstore/kvstore_nccl.cc wiring through
+contrib layers). The TPU rebuild instead annotates *weight shardings*
+(jax.sharding.PartitionSpec on each Parameter) and lets XLA's SPMD
+partitioner insert the all-gather / reduce-scatter collectives over the
+ICI mesh — the compiler, not the framework, schedules communication.
+
+Layer recipe (Megatron-LM, public):
+  ColumnParallelDense: W (units, in) sharded P('tp', None)  — output is
+    sharded on features; no collective needed going in.
+  RowParallelDense:    W (units, in) sharded P(None, 'tp')  — input is
+    feature-sharded; XLA inserts the psum on the output.
+  Chained column→row (attention qkv→out, MLP up→down) needs exactly ONE
+  AllReduce per pair, matching the NCCL count in the reference.
+
+`sharding_constraint` is the escape hatch to pin activation layouts when
+the propagation pass picks a bad one.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import nd
+from ..ndarray import NDArray
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Embedding
+from .mesh import current_mesh
+
+__all__ = ["ColumnParallelDense", "RowParallelDense",
+           "VocabParallelEmbedding", "TPMLP", "TPSelfAttention",
+           "sharding_constraint"]
+
+
+def sharding_constraint(x, *spec, tp_axis=None):
+    """Pin an activation's PartitionSpec inside a traced/jitted region.
+
+    No-op when no mesh is active (eager single-chip). Accepts NDArray or
+    raw jax.Array; returns the same type.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = P(*spec)
+    raw = x._data if isinstance(x, NDArray) else x
+    if not isinstance(raw, jax.core.Tracer):
+        # Eager call: single-chip semantics; shardings materialize only
+        # inside compiled steps (FusedTrainStep / ShardedForward), where
+        # every operand is mesh-placed.
+        return x
+    out = jax.lax.with_sharding_constraint(raw, NamedSharding(mesh, spec))
+    return NDArray(out) if isinstance(x, NDArray) else out
+
+
+class ColumnParallelDense(Dense):
+    """Dense with the output dimension sharded over the `tp` mesh axis.
+
+    Weight layout is (units, in_units) like gluon.nn.Dense; the units
+    (row) dimension carries the 'tp' spec, so each shard computes a slice
+    of the output features. Set ``gather_output=True`` to force the output
+    back to replicated (one all-gather); leave False when feeding a
+    RowParallelDense.
+    """
+
+    def __init__(self, units, *args, tp_axis="tp", gather_output=False,
+                 **kwargs):
+        super().__init__(units, *args, **kwargs)
+        self._tp_axis = tp_axis
+        self._gather_output = gather_output
+        self.weight.sharding = P(tp_axis, None)
+        if self.bias is not None:
+            self.bias.sharding = P(tp_axis)
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._gather_output:
+            out = sharding_constraint(out, *([None] * out.ndim))
+        else:
+            spec = [None] * out.ndim
+            spec[-1] = self._tp_axis
+            out = sharding_constraint(out, *spec)
+        return out
+
+
+class RowParallelDense(Dense):
+    """Dense with the input (contraction) dimension sharded over `tp`.
+
+    Expects a feature-sharded input (e.g. from ColumnParallelDense);
+    each shard computes a partial matmul and XLA inserts the AllReduce
+    to produce the replicated output. The bias is replicated and added
+    after the reduction (kept unsharded so it is applied once).
+    """
+
+    def __init__(self, units, *args, tp_axis="tp", **kwargs):
+        super().__init__(units, *args, **kwargs)
+        self._tp_axis = tp_axis
+        self.weight.sharding = P(None, tp_axis)
+        # bias stays replicated (P()) — added once, post-reduction.
+
+    def forward(self, x):
+        spec = [None] * x.ndim
+        spec[-1] = self._tp_axis
+        x = sharding_constraint(x, *spec)
+        out = super().forward(x)
+        return sharding_constraint(out, *([None] * out.ndim))
+
+
+class VocabParallelEmbedding(Embedding):
+    """Embedding with the vocabulary dimension sharded over `tp`.
+
+    XLA partitions the gather: each shard holds vocab/tp rows and
+    contributes zeros for out-of-shard ids, summed over the tp axis.
+    """
+
+    def __init__(self, input_dim, output_dim, *args, tp_axis="tp",
+                 **kwargs):
+        super().__init__(input_dim, output_dim, *args, **kwargs)
+        self._tp_axis = tp_axis
+        self.weight.sharding = P(tp_axis, None)
+
+
+class TPMLP(HybridBlock):
+    """Transformer MLP with one AllReduce: column-parallel up projection,
+    row-parallel down projection (Megatron pattern)."""
+
+    def __init__(self, hidden, intermediate, activation="gelu",
+                 tp_axis="tp", dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self.up = ColumnParallelDense(intermediate, flatten=False,
+                                      tp_axis=tp_axis, dtype=dtype,
+                                      in_units=hidden)
+        self.down = RowParallelDense(hidden, flatten=False,
+                                     tp_axis=tp_axis, dtype=dtype,
+                                     in_units=intermediate)
+        self._act = activation
+
+    def forward(self, x):
+        h = self.up(x)
+        h = nd.Activation(h, act_type=self._act)
+        return self.down(h)
+
+
+class TPSelfAttention(HybridBlock):
+    """Multi-head self-attention sharded over heads (tp axis).
+
+    qkv is column-parallel (heads split across shards), the output
+    projection is row-parallel — one AllReduce per attention block,
+    mirroring Megatron / the reference's NCCL-fused attention.
+    """
+
+    def __init__(self, hidden, num_heads, tp_axis="tp", dtype="float32",
+                 causal=False, **kwargs):
+        super().__init__(**kwargs)
+        assert hidden % num_heads == 0
+        self._h = hidden
+        self._nh = num_heads
+        self._hd = hidden // num_heads
+        self._causal = causal
+        self._tp_axis = tp_axis
+        self.qkv = ColumnParallelDense(3 * hidden, flatten=False,
+                                       tp_axis=tp_axis, dtype=dtype,
+                                       in_units=hidden)
+        self.out = RowParallelDense(hidden, flatten=False,
+                                    tp_axis=tp_axis, dtype=dtype,
+                                    in_units=hidden)
+
+    def forward(self, x):
+        B, T, _ = x.shape
+        qkv = self.qkv(x)  # (B, T, 3H) feature-sharded
+        raw = qkv._data.reshape(B, T, 3, self._nh, self._hd)
+        # heads dim carries the tp spec — all per-head work stays local
+        raw = sharding_constraint(
+            raw, None, None, None, self._tp_axis, None)
+        q = jnp.swapaxes(raw[:, :, 0], 1, 2)  # (B, nh, T, hd)
+        k = jnp.swapaxes(raw[:, :, 1], 1, 2)
+        v = jnp.swapaxes(raw[:, :, 2], 1, 2)
+        from .ring_attention import _full_attention
+        ctx = _full_attention(q, k, v, self._causal, None)
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, self._h)
+        ctx = sharding_constraint(ctx, None, None, self._tp_axis)
+        return self.out(NDArray(ctx))
