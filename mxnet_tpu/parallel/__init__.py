@@ -2,7 +2,8 @@
 parallelism (TPU-first replacement for the reference's KVStore NCCL/PS
 backends; see SURVEY §2 'KVStore & distributed')."""
 from .mesh import (make_mesh, Mesh, NamedSharding, PartitionSpec, P,
-                   current_mesh, set_mesh, local_mesh, hybrid_mesh)
+                   current_mesh, set_mesh, use_mesh, local_mesh,
+                   hybrid_mesh)
 
 
 def __getattr__(name):

@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd
 from .. import random as _random
 from ..ndarray import NDArray
-from .mesh import current_mesh
+from .mesh import current_mesh, use_mesh
 
 __all__ = ["FusedTrainStep", "ShardedForward", "split_batch_spec"]
 
@@ -30,6 +30,24 @@ def split_batch_spec(ndim: int, axis: int = 0, dp_axis: str = "dp"):
     spec = [None] * ndim
     spec[axis] = dp_axis
     return P(*spec)
+
+
+def _param_shardings(params, names, mesh):
+    """NamedSharding per parameter: its Parameter.sharding spec, else
+    replicated."""
+    return {n: NamedSharding(mesh, params[n].sharding
+                             if params[n].sharding is not None else P())
+            for n in names}
+
+
+def _batch_shardings(args, mesh, dp_axis):
+    """Batch args sharded over `dp_axis` on dim 0 (replicated when the
+    mesh has no such axis, e.g. a tp-only mesh)."""
+    dp = dp_axis if dp_axis in mesh.axis_names else None
+    return tuple(
+        NamedSharding(mesh, split_batch_spec(
+            _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp))
+        for a in args)
 
 
 class ShardedForward:
@@ -52,7 +70,7 @@ class ShardedForward:
         self.training = training
         self._compiled = None
         self._entry = None
-        self._seen = {}  # param name -> id of host array last placed
+        self._seen = {}  # param name -> host array last placed
 
     def _build(self, args):
         mesh = self.mesh
@@ -61,24 +79,13 @@ class ShardedForward:
             with autograd.pause():
                 self.net(*args)
             params = self.net.collect_params()
-        entry = self.net.trace_entry(list(args), training=self.training)
+        with use_mesh(mesh):
+            entry = self.net.trace_entry(list(args),
+                                         training=self.training)
         self._entry = entry
-
-        def spec_of(n):
-            s = params[n].sharding
-            return s if s is not None else P()
-
-        tr_sh = {n: NamedSharding(mesh, spec_of(n))
-                 for n in entry.tr_names}
-        aux_sh = {n: NamedSharding(mesh, spec_of(n))
-                  for n in entry.aux_names}
-        dp = self.dp_axis if (mesh is not None and
-                              self.dp_axis in mesh.axis_names) else None
-        batch_sh = tuple(
-            NamedSharding(mesh, split_batch_spec(
-                _np.ndim(a._data if isinstance(a, NDArray) else a), 0,
-                dp)) if dp else NamedSharding(mesh, P())
-            for a in args)
+        tr_sh = _param_shardings(params, entry.tr_names, mesh)
+        aux_sh = _param_shardings(params, entry.aux_names, mesh)
+        batch_sh = _batch_shardings(args, mesh, self.dp_axis)
         repl = NamedSharding(mesh, P())
 
         def fwd(tr, aux, key, *batch):
@@ -102,9 +109,9 @@ class ShardedForward:
                                    self._aux_sh)):
             for n in names:
                 v = self._params[n].data()._data
-                if self._seen.get(n) != id(v):
+                if self._seen.get(n) is not v:
                     store[n] = jax.device_put(v, shs[n])
-                    self._seen[n] = id(v)
+                    self._seen[n] = v
 
     def __call__(self, *args):
         if self._compiled is None:
@@ -115,7 +122,8 @@ class ShardedForward:
         raw = [jax.device_put(
             a._data if isinstance(a, NDArray) else jnp.asarray(a), sh)
             for a, sh in zip(args, self._batch_sh)]
-        flat = self._compiled(self._tr, self._aux, key, *raw)
+        with use_mesh(self.mesh):
+            flat = self._compiled(self._tr, self._aux, key, *raw)
         out = jax.tree_util.tree_unflatten(
             self._entry.out_treedef, [NDArray(f) for f in flat])
         return out
@@ -192,6 +200,10 @@ class FusedTrainStep:
             if v.sharding.is_fully_replicated:
                 # one shard already holds the full value — no host copy
                 return v.addressable_shards[0].data
+            if not v.is_fully_addressable:  # multi-host (TPU pod) case
+                from jax.experimental import multihost_utils
+                return jnp.asarray(
+                    multihost_utils.process_allgather(v, tiled=True))
             return jnp.asarray(_np.asarray(v))  # gather sharded dims
         for n in self._tr_names:
             self._params[n].data()._data = unshard(self._tr[n])
@@ -199,15 +211,10 @@ class FusedTrainStep:
             self._params[n].data()._data = unshard(self._aux[n])
 
     # -- compilation ---------------------------------------------------------
-    def _param_spec(self, name) -> P:
-        p = self._params[name]
-        if p.sharding is not None:
-            return p.sharding
-        return P()  # replicated
-
     def _build(self, args):
-        entry = self.net.trace_entry(list(args[:self.n_model_inputs]),
-                                     training=True)
+        with use_mesh(self.mesh):
+            entry = self.net.trace_entry(
+                list(args[:self.n_model_inputs]), training=True)
         tr_names = entry.tr_names
         aux_names = entry.aux_names
         opt = self.optimizer
@@ -241,19 +248,13 @@ class FusedTrainStep:
         if self.mesh is not None:
             mesh = self.mesh
             repl = NamedSharding(mesh, P())
-            tr_sh = {n: NamedSharding(mesh, self._param_spec(n))
-                     for n in tr_names}
-            aux_sh = {n: NamedSharding(mesh, self._param_spec(n))
-                      for n in aux_names}
+            tr_sh = _param_shardings(self._params, tr_names, mesh)
+            aux_sh = _param_shardings(self._params, aux_names, mesh)
             # state shards mirror their weight's sharding
             st_sh = {n: jax.tree_util.tree_map(
-                lambda _: NamedSharding(mesh, self._param_spec(n)),
+                lambda _, sh=tr_sh[n]: sh,
                 self._states[n]) for n in tr_names}
-            batch_sh = tuple(
-                NamedSharding(mesh, split_batch_spec(
-                    _np.ndim(a._data if isinstance(a, NDArray) else a),
-                    0, self.dp_axis))
-                for a in args)
+            batch_sh = _batch_shardings(args, mesh, self.dp_axis)
             hyper_sh = {k: repl for k in ("lr", "wd", "t", "rescale")}
             self._compiled = jax.jit(
                 step,
@@ -294,6 +295,8 @@ class FusedTrainStep:
         if self.mesh is not None:
             raw = [jax.device_put(r, sh)
                    for r, sh in zip(raw, self._batch_sh)]
-        loss, self._tr, self._aux, self._states = self._compiled(
-            self._tr, self._aux, self._states, hyper, key, *raw)
+        with use_mesh(self.mesh if self.mesh is not None
+                      else current_mesh()):
+            loss, self._tr, self._aux, self._states = self._compiled(
+                self._tr, self._aux, self._states, hyper, key, *raw)
         return NDArray(loss)

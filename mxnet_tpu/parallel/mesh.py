@@ -14,7 +14,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "P",
-           "current_mesh", "set_mesh", "local_mesh", "hybrid_mesh"]
+           "current_mesh", "set_mesh", "use_mesh", "local_mesh",
+           "hybrid_mesh"]
 
 P = PartitionSpec
 
@@ -25,6 +26,28 @@ def set_mesh(mesh: Optional[Mesh]):
     global _CURRENT
     _CURRENT = mesh
     return mesh
+
+
+class use_mesh:
+    """Scoped mesh binding: `with use_mesh(m): ...` — makes `m` the mesh
+    sharding_constraint and friends resolve, restoring the previous one on
+    exit. Compiled wrappers (FusedTrainStep/ShardedForward) bind their own
+    mesh this way so an explicitly-passed mesh wins over the global."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _CURRENT
+        self._prev = _CURRENT
+        _CURRENT = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _CURRENT
+        _CURRENT = self._prev
+        return False
 
 
 def current_mesh() -> Optional[Mesh]:

@@ -1,0 +1,114 @@
+"""Mixture-of-Experts with expert parallelism over an `ep` mesh axis.
+
+Reference parity: MXNet's sparse/contrib mixture layers route on the
+host and launch per-expert kernels; here routing is the GShard/Switch
+einsum formulation — a dispatch one-hot (tokens×experts×capacity)
+contracted against the token matrix — so the whole layer is dense
+einsums XLA can partition. Expert weights carry a leading expert dim
+sharded `P('ep', ...)`; with the dispatched activations constrained to
+the same axis, the SPMD partitioner inserts the token all-to-all over
+ICI exactly where the reference would call NCCL alltoall.
+
+Top-k routing with capacity dropping (overflowed tokens pass through
+via the residual connection of the surrounding block) + the standard
+load-balance auxiliary loss.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nd
+from ..ndarray import NDArray
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from .tensor_parallel import sharding_constraint
+
+__all__ = ["MoEMLP"]
+
+
+class MoEMLP(HybridBlock):
+    """Switch/GShard-style MoE feed-forward block.
+
+    forward(x: (B, T, H)) -> (B, T, H)  [or (out, aux_loss) when
+    ``return_aux_loss=True``; aux_loss is the load-balance penalty].
+    """
+
+    def __init__(self, hidden, intermediate, num_experts, top_k=2,
+                 capacity_factor=1.5, activation="gelu", ep_axis="ep",
+                 return_aux_loss=False, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        E = num_experts
+        self._E, self._k = E, top_k
+        self._cf = capacity_factor
+        self._act = activation
+        self._ep = ep_axis
+        self._return_aux = return_aux_loss
+        self.gate = Parameter("gate", shape=(E, hidden), dtype=dtype,
+                              init="xavier")
+        self.w_up = Parameter("w_up", shape=(E, intermediate, hidden),
+                              dtype=dtype, init="xavier",
+                              sharding=P(ep_axis, None, None))
+        self.b_up = Parameter("b_up", shape=(E, intermediate), dtype=dtype,
+                              init="zeros", sharding=P(ep_axis, None))
+        self.w_down = Parameter("w_down", shape=(E, hidden, intermediate),
+                                dtype=dtype, init="xavier",
+                                sharding=P(ep_axis, None, None))
+        self.b_down = Parameter("b_down", shape=(E, hidden), dtype=dtype,
+                                init="zeros", sharding=P(ep_axis, None))
+
+    def _route(self, flat):
+        """Top-k routing with per-expert capacity. flat: (S, H)."""
+        S = flat.shape[0]
+        E, k = self._E, self._k
+        C = max(1, int(S * k * self._cf / E))
+        logits = flat @ self.gate.data()._data.T  # (S, E)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)  # (S, k)
+        gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+        dispatch = jnp.zeros((S, E, C), jnp.float32)
+        combine = jnp.zeros((S, E, C), jnp.float32)
+        counts = jnp.zeros((E,), jnp.int32)
+        for j in range(k):  # static unroll (k is 1 or 2 in practice)
+            oh = jax.nn.one_hot(topi[:, j], E, dtype=jnp.int32)  # (S, E)
+            pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]
+            counts = counts + oh.sum(axis=0)
+            keep = (pos < C) & (oh > 0)
+            pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C)  # (S,E,C)
+            d = pos_oh * keep[..., None].astype(jnp.float32)
+            dispatch = dispatch + d
+            combine = combine + d * gates[:, j][:, None, None]
+
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * p_e
+        me = probs.mean(axis=0)  # mean router prob per expert
+        fe = dispatch.sum(axis=(0, 2)) / jnp.maximum(
+            dispatch.sum(), 1.0)  # fraction of routed tokens per expert
+        aux = E * jnp.sum(fe * me)
+        return dispatch, combine, aux, C
+
+    def forward(self, x):
+        raw = x._data if isinstance(x, NDArray) else x
+        B, T, H = raw.shape
+        flat = raw.reshape(B * T, H)
+        dispatch, combine, aux, C = self._route(flat)
+
+        ein = jnp.einsum  # dispatch: (S,E,C) ⊗ (S,H) → (E,C,H)
+        exp_in = ein("sec,sh->ech", dispatch.astype(raw.dtype), flat)
+        exp_in = sharding_constraint(exp_in, self._ep, None, None)
+        wu = self.w_up.data()._data
+        bu = self.b_up.data()._data
+        wd = self.w_down.data()._data
+        bd = self.b_down.data()._data
+        h = ein("ech,eih->eci", exp_in, wu) + bu[:, None, :]
+        h = nd.Activation(NDArray(h), act_type=self._act)._data
+        out_e = ein("eci,ehi->ech", h, wd) + bd[:, None, :]
+        out_e = sharding_constraint(out_e, self._ep, None, None)
+        out = ein("sec,ech->sh", combine.astype(raw.dtype), out_e)
+        out = out.reshape(B, T, H)
+        res = NDArray(out) if isinstance(x, NDArray) else out
+        if self._return_aux:
+            a = NDArray(aux) if isinstance(x, NDArray) else aux
+            return res, a
+        return res

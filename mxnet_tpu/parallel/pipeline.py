@@ -1,0 +1,130 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a `pp` mesh axis.
+
+Reference parity: MXNet's model-parallel examples place layer groups on
+different GPUs and rely on the dependency engine to overlap them
+(example/model-parallel; ctx lists in Gluon). The TPU rebuild runs the
+schedule *inside* one XLA program: stage parameters are stacked on a
+leading dimension sharded over `pp`, a `lax.scan` ticks the pipeline,
+and `lax.ppermute` shifts activations to the next stage over ICI. The
+whole pipeline — bubbles, steady state, drain — is a single compiled
+loop XLA can overlap with collectives.
+
+Constraints (classic GPipe):
+  * every stage maps (mb, ...) -> (mb, ...) with the same shape/dtype
+    (transformer blocks satisfy this);
+  * all stages share one parameter treedef (stacked leading dim = pp).
+
+`gpipe(...)` is differentiable — reverse-mode flows back through the
+scan/ppermute schedule, so it drops into FusedTrainStep loss functions.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+__all__ = ["stack_stage_params", "gpipe", "sequential_apply"]
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage parameter pytrees (identical treedefs) into one
+    pytree whose leaves carry a leading `pp` dimension."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def sequential_apply(stage_fn, stacked_params, x):
+    """Reference semantics: run the stages one after another (no mesh).
+    Used as the single-device fallback and in tests."""
+    n = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+    def body(h, i):
+        p_i = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
+        return stage_fn(p_i, h), ()
+
+    out, _ = jax.lax.scan(body, x, jnp.arange(n))
+    return out
+
+
+def _vary(x, axis_name):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    return jax.lax.pvary(x, (axis_name,))
+
+
+def _gpipe_local(params, mbatches, stage_fn, axis_name):
+    """Per-device schedule body (runs inside shard_map).
+
+    params: this stage's parameters (leading pp dim already split away).
+    mbatches: (M, mb, ...) full microbatched input, replicated; only
+    stage 0 reads it. Returns (M, mb, ...) outputs via a final psum
+    (only the last stage contributes non-zeros).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = mbatches.shape[0]
+    perm = [(i, i + 1) for i in range(n - 1)]  # no wraparound
+
+    state0 = _vary(jnp.zeros(mbatches.shape[1:], mbatches.dtype),
+                   axis_name)
+    out0 = _vary(jnp.zeros_like(mbatches), axis_name)
+
+    def tick(carry, t):
+        state, outputs = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            mbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        inp = jnp.where(idx == 0, feed, state)
+        out = stage_fn(params, inp)
+        j = jnp.clip(t - (n - 1), 0, M - 1)
+        upd = jax.lax.dynamic_update_index_in_dim(outputs, out, j, 0)
+        take = jnp.logical_and(idx == n - 1, t >= n - 1)
+        outputs = jnp.where(take, upd, outputs)
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outputs), ()
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, out0), jnp.arange(M + n - 1))
+    # broadcast the last stage's results to every pp shard
+    return jax.lax.psum(outputs, axis_name)
+
+
+def gpipe(stage_fn, stacked_params, x, num_microbatches, mesh=None,
+          pp_axis="pp"):
+    """Run `x` through the staged pipeline.
+
+    stage_fn: (stage_params, h) -> h, shape-preserving.
+    stacked_params: pytree with leading dim = num_stages (sharded over
+        `pp_axis` when a mesh is active).
+    x: (B, ...) batch; B % num_microbatches == 0.
+
+    Without a mesh (or without a `pp` axis) this degrades to the exact
+    sequential computation.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or pp_axis not in mesh.axis_names:
+        return sequential_apply(stage_fn, stacked_params, x)
+    n = mesh.shape[pp_axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    assert leaves[0].shape[0] == n, \
+        f"{leaves[0].shape[0]} stages vs pp={n} shards"
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    mb = B // num_microbatches
+    mbatches = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P(pp_axis, *([None] * (a.ndim - 1))), stacked_params)
+    # strip the (now size-1) stage dim inside the body
+    def body(params, mbs):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        return _gpipe_local(params, mbs, stage_fn, pp_axis)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(param_specs, P()), out_specs=P())
+    out = fn(stacked_params, mbatches)
+    return out.reshape(B, *out.shape[2:])

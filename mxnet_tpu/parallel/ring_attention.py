@@ -29,7 +29,8 @@ from jax import shard_map
 from ..ndarray import NDArray
 from .mesh import current_mesh
 
-__all__ = ["ring_attention", "ulysses_attention", "ring_attention_local"]
+__all__ = ["ring_attention", "ulysses_attention", "ring_attention_local",
+           "full_attention"]
 
 _NEG = -1e30  # large-negative mask value; avoids -inf NaN in exp
 
@@ -112,7 +113,7 @@ def ring_attention(q, k, v, mesh=None, sp_axis="sp", causal=True,
     raw_q, raw_k, raw_v = _as_raw(q), _as_raw(k), _as_raw(v)
     if mesh is None or sp_axis not in mesh.axis_names:
         # single-shard fallback: plain attention
-        out = _full_attention(raw_q, raw_k, raw_v, causal, scale)
+        out = full_attention(raw_q, raw_k, raw_v, causal, scale)
         return _wrap_like(out, q)
     spec = P(None, None, sp_axis, None)
     fn = shard_map(
@@ -122,7 +123,10 @@ def ring_attention(q, k, v, mesh=None, sp_axis="sp", causal=True,
     return _wrap_like(fn(raw_q, raw_k, raw_v), q)
 
 
-def _full_attention(q, k, v, causal, scale):
+def full_attention(q, k, v, causal=True, scale=None):
+    """Plain (unsharded) softmax attention on (B, H, T, D) — the exact
+    reference every parallel strategy here must match; also the local
+    math TPSelfAttention reuses."""
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -147,7 +151,7 @@ def ulysses_attention(q, k, v, mesh=None, sp_axis="sp", causal=True,
     mesh = mesh if mesh is not None else current_mesh()
     raw_q, raw_k, raw_v = _as_raw(q), _as_raw(k), _as_raw(v)
     if mesh is None or sp_axis not in mesh.axis_names:
-        out = _full_attention(raw_q, raw_k, raw_v, causal, scale)
+        out = full_attention(raw_q, raw_k, raw_v, causal, scale)
         return _wrap_like(out, q)
     H = raw_q.shape[1]
     sp = mesh.shape[sp_axis]
@@ -164,7 +168,7 @@ def ulysses_attention(q, k, v, mesh=None, sp_axis="sp", causal=True,
         qh = a2a(qc, False)
         kh = a2a(kc, False)
         vh = a2a(vc, False)
-        out = _full_attention(qh, kh, vh, causal, scale)
+        out = full_attention(qh, kh, vh, causal, scale)
         return a2a(out, True)  # back to seq-sharded
 
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),

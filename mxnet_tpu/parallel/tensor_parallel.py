@@ -29,13 +29,14 @@ from ..ndarray import NDArray
 from ..gluon.block import HybridBlock
 from ..gluon.nn import Dense, Embedding
 from .mesh import current_mesh
+from .ring_attention import full_attention
 
 __all__ = ["ColumnParallelDense", "RowParallelDense",
            "VocabParallelEmbedding", "TPMLP", "TPSelfAttention",
            "sharding_constraint"]
 
 
-def sharding_constraint(x, *spec, tp_axis=None):
+def sharding_constraint(x, *spec):
     """Pin an activation's PartitionSpec inside a traced/jitted region.
 
     No-op when no mesh is active (eager single-chip). Accepts NDArray or
@@ -177,8 +178,7 @@ class TPSelfAttention(HybridBlock):
         q = jnp.swapaxes(raw[:, :, 0], 1, 2)  # (B, nh, T, hd)
         k = jnp.swapaxes(raw[:, :, 1], 1, 2)
         v = jnp.swapaxes(raw[:, :, 2], 1, 2)
-        from .ring_attention import _full_attention
-        ctx = _full_attention(q, k, v, self._causal, None)
+        ctx = full_attention(q, k, v, self._causal, None)
         ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, self._h)
         ctx = sharding_constraint(ctx, None, None, self._tp_axis)
         return self.out(NDArray(ctx))

@@ -1,0 +1,84 @@
+"""GPipe pipeline parallelism ≡ sequential stage application (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import make_mesh, set_mesh
+from mxnet_tpu.parallel.pipeline import (
+    gpipe, sequential_apply, stack_stage_params)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+def _stage_fn(p, h):
+    h = jnp.tanh(h @ p["w1"] + p["b1"])
+    return h @ p["w2"] + p["b2"]
+
+
+def _make_params(n_stages, d, hidden, seed=0):
+    rs = np.random.RandomState(seed)
+    ps = [{"w1": jnp.asarray(rs.randn(d, hidden).astype(np.float32) * 0.3),
+           "b1": jnp.asarray(rs.randn(hidden).astype(np.float32) * 0.1),
+           "w2": jnp.asarray(rs.randn(hidden, d).astype(np.float32) * 0.3),
+           "b2": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+          for _ in range(n_stages)]
+    return stack_stage_params(ps)
+
+
+@pytest.fixture
+def pp_mesh():
+    m = make_mesh([4], ["pp"])
+    set_mesh(m)
+    yield m
+    set_mesh(None)
+
+
+@pytest.mark.parametrize("num_microbatches", [4, 8])
+def test_gpipe_equals_sequential(pp_mesh, num_microbatches):
+    params = _make_params(4, 8, 16)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.rand(16, 8).astype(np.float32))
+    ref = sequential_apply(_stage_fn, params, x)
+    out = gpipe(_stage_fn, params, x, num_microbatches, mesh=pp_mesh)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_grad_matches(pp_mesh):
+    params = _make_params(4, 6, 12, seed=2)
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.rand(8, 6).astype(np.float32))
+
+    def loss_pipe(p):
+        return (gpipe(_stage_fn, p, x, 4, mesh=pp_mesh) ** 2).sum()
+
+    def loss_seq(p):
+        return (sequential_apply(_stage_fn, p, x) ** 2).sum()
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in g_seq:
+        assert np.allclose(np.asarray(g_pipe[k]), np.asarray(g_seq[k]),
+                           atol=1e-3), k
+
+
+def test_gpipe_under_jit(pp_mesh):
+    params = _make_params(4, 8, 16, seed=4)
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.rand(8, 8).astype(np.float32))
+
+    out = jax.jit(lambda p, x_: gpipe(_stage_fn, p, x_, 4,
+                                      mesh=pp_mesh))(params, x)
+    ref = sequential_apply(_stage_fn, params, x)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_no_mesh_fallback():
+    set_mesh(None)
+    params = _make_params(3, 4, 8, seed=6)
+    x = jnp.asarray(np.random.RandomState(7).rand(6, 4).astype(np.float32))
+    out = gpipe(_stage_fn, params, x, 2, mesh=None)
+    ref = sequential_apply(_stage_fn, params, x)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-6)

@@ -14,7 +14,7 @@ from mxnet_tpu.parallel.tensor_parallel import (
     ColumnParallelDense, RowParallelDense, TPMLP, TPSelfAttention,
     VocabParallelEmbedding)
 from mxnet_tpu.parallel.ring_attention import (
-    ring_attention, ulysses_attention, _full_attention)
+    ring_attention, ulysses_attention, full_attention)
 from mxnet_tpu.parallel.data_parallel import FusedTrainStep, ShardedForward
 
 pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
@@ -110,7 +110,7 @@ def test_ring_attention_exact(sp_mesh, causal):
     k = jnp.asarray(rs.rand(2, 4, 32, 8).astype(np.float32))
     v = jnp.asarray(rs.rand(2, 4, 32, 8).astype(np.float32))
     out = ring_attention(q, k, v, mesh=sp_mesh, causal=causal)
-    ref = _full_attention(q, k, v, causal, None)
+    ref = full_attention(q, k, v, causal, None)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
@@ -123,7 +123,7 @@ def test_ring_attention_grad(sp_mesh):
 
     g_ring = jax.grad(lambda q_: ring_attention(
         q_, k, v, mesh=sp_mesh, causal=True).sum())(q)
-    g_full = jax.grad(lambda q_: _full_attention(
+    g_full = jax.grad(lambda q_: full_attention(
         q_, k, v, True, None).sum())(q)
     assert np.allclose(np.asarray(g_ring), np.asarray(g_full), atol=1e-4)
 
@@ -135,7 +135,7 @@ def test_ulysses_attention_exact(sp_mesh, causal):
     k = jnp.asarray(rs.rand(2, 8, 32, 4).astype(np.float32))
     v = jnp.asarray(rs.rand(2, 8, 32, 4).astype(np.float32))
     out = ulysses_attention(q, k, v, mesh=sp_mesh, causal=causal)
-    ref = _full_attention(q, k, v, causal, None)
+    ref = full_attention(q, k, v, causal, None)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
@@ -149,5 +149,5 @@ def test_ring_attention_in_jit(sp_mesh):
         return ring_attention(q_, q_, q_, mesh=sp_mesh, causal=True)
 
     out = f(q)
-    ref = _full_attention(q, q, q, True, None)
+    ref = full_attention(q, q, q, True, None)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
