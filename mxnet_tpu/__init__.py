@@ -34,6 +34,8 @@ from . import models
 from . import amp
 from . import profiler
 from . import parallel
+from . import io
+from . import runtime
 
 # reference-style module aliases
 sym = None  # symbolic API is subsumed by hybridize/jit (SURVEY §1)

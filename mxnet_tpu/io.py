@@ -1,0 +1,319 @@
+"""mx.io — data iterators (reference: mxnet/io.py, src/io/iter_*.cc).
+
+NDArrayIter batches in-memory arrays; ImageRecordIter streams packed
+image records from RecordIO files through the C++ host runtime
+(runtime/cc/recordio.cc) with background prefetch on the dependency
+engine — the TPU-side analogue of the reference's multithreaded
+iter_image_recordio_2.cc pipeline.
+"""
+from __future__ import annotations
+
+import collections
+from typing import List, Optional, Sequence
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ImageRecordIter", "ResizeIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc",
+                                      ["name", "shape", "dtype",
+                                       "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+
+class DataBatch:
+    """One iteration's data + labels (+ pad for the final ragged batch)."""
+
+    def __init__(self, data: Sequence[NDArray],
+                 label: Optional[Sequence[NDArray]] = None, pad: int = 0,
+                 index=None, provide_data=None, provide_label=None):
+        self.data = list(data)
+        self.label = list(label) if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [d.shape for d in self.data]
+        return f"DataBatch: data shapes {shapes} pad={self.pad}"
+
+
+class DataIter:
+    """Iterator protocol (reference parity: reset/next/iter_next)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+
+def _as_name_arrays(data, default_name):
+    """Normalize data= inputs to an ordered list of (name, ndarray)."""
+    if data is None:
+        return []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = {default_name: data}
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{i if i else ''}": d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        arr = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+        out.append((k, arr))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Batch iterator over in-memory arrays (reference: io.NDArrayIter).
+
+    Supports shuffle, `last_batch_handle` in {'pad', 'discard',
+    'roll_over'}, and multiple named data/label arrays.
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = _as_name_arrays(data, data_name)
+        self._label = _as_name_arrays(label, label_name)
+        self._n = self._data[0][1].shape[0]
+        for _, a in self._data + self._label:
+            assert a.shape[0] == self._n, "row-count mismatch"
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self._order = _np.arange(self._n)
+        self._queue = self._order
+        self._cursor = 0
+        self._rolled = 0
+        self.reset()
+
+    def reset(self):
+        # roll_over: the previous epoch's unvisited tail (captured
+        # BEFORE any reshuffle) leads the new epoch
+        leftover = self._queue[len(self._queue) - self._rolled:].copy() \
+            if self._rolled else None
+        if self._shuffle:
+            _np.random.shuffle(self._order)
+        self._queue = self._order if leftover is None else \
+            _np.concatenate([leftover, self._order])
+        self._cursor = 0
+        self._rolled = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + a.shape[1:], a.dtype)
+                for k, a in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + a.shape[1:], a.dtype)
+                for k, a in self._label]
+
+    def _take(self, arr, idx):
+        return array(arr[idx])
+
+    def next(self) -> DataBatch:
+        qn = len(self._queue)
+        if self._cursor >= qn:
+            raise StopIteration
+        start = self._cursor
+        stop = start + self.batch_size
+        self._cursor = stop
+        if stop <= qn:
+            idx = self._queue[start:stop]
+            pad = 0
+        else:
+            if self._last == "discard":
+                raise StopIteration
+            if self._last == "roll_over":
+                self._rolled = qn - start
+                self._cursor = start  # keep tail visible for reset()
+                raise StopIteration
+            pad = stop - qn
+            idx = _np.concatenate([self._queue[start:],
+                                   self._queue[:pad]])
+        data = [self._take(a, idx) for _, a in self._data]
+        label = [self._take(a, idx) for _, a in self._label]
+        return DataBatch(data, label, pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageRecordIter(DataIter):
+    """Streams (image, label) batches from a RecordIO file written with
+    `runtime.recordio.pack_img` (reference: ImageRecordIter).
+
+    Decode + batch assembly runs on the host dependency engine with a
+    bounded prefetch window, overlapping with device steps.
+    """
+
+    def __init__(self, path_imgrec, batch_size, data_shape,
+                 shuffle=False, preprocess_threads=2, prefetch_buffer=4,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0,
+                 std_g=1.0, std_b=1.0, seed=0, path_imgidx=None,
+                 layout="NCHW"):
+        super().__init__(batch_size)
+        from .runtime import recordio as rio
+        self._rio = rio
+        self._path = path_imgrec
+        self._shape = tuple(data_shape)  # (C, H, W)
+        self._layout = layout
+        self._shuffle = shuffle
+        self._rs = _np.random.RandomState(seed)
+        self._mean = _np.array([mean_r, mean_g, mean_b],
+                               _np.float32)[:self._shape[0]]
+        self._std = _np.array([std_r, std_g, std_b],
+                              _np.float32)[:self._shape[0]]
+        self._offsets = rio.list_record_offsets(path_imgrec)
+        self._threads = preprocess_threads
+        self._prefetch = prefetch_buffer
+        self._order = _np.arange(len(self._offsets))
+        self.reset()
+
+    def __len__(self):
+        return len(self._offsets) // self.batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        if self._shuffle:
+            self._rs.shuffle(self._order)
+        self._cursor = 0
+        self._window = collections.deque()
+        self._next_submit = 0
+
+    def _decode(self, raw):
+        header, img = self._rio.unpack_img(raw)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        chw = img.astype(_np.float32).transpose(2, 0, 1) / 255.0
+        chw = (chw - self._mean[:, None, None]) / self._std[:, None, None]
+        label = float(header.label if _np.isscalar(header.label)
+                      else _np.asarray(header.label).ravel()[0])
+        return chw, label
+
+    def _load_batch(self, indices):
+        # each worker opens its own reader: seek+read are not
+        # thread-safe on a shared handle
+        reader = self._rio.MXRecordIO(self._path, "r")
+        try:
+            imgs = _np.empty((len(indices),) + self._shape, _np.float32)
+            labels = _np.empty((len(indices),), _np.float32)
+            for i, j in enumerate(indices):
+                reader._seek(self._offsets[j])
+                imgs[i], labels[i] = self._decode(reader.read())
+        finally:
+            reader.close()
+        if self._layout == "NHWC":
+            imgs = imgs.transpose(0, 2, 3, 1)
+        return DataBatch([array(imgs)], [array(labels)],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _submit(self):
+        """Queue one batch's decode on the host engine."""
+        import threading
+        start = self._next_submit
+        if start + self.batch_size > len(self._offsets):
+            return False
+        idx = self._order[start:start + self.batch_size]
+        self._next_submit = start + self.batch_size
+        ev = threading.Event()
+        slot = []
+
+        def work(idx=idx, ev=ev, slot=slot):
+            try:
+                slot.append(self._load_batch(idx))
+            except Exception as e:
+                slot.append(e)
+            finally:
+                ev.set()
+
+        self._engine().push(work)
+        self._window.append((ev, slot))
+        return True
+
+    def _engine(self):
+        # shared per-thread-count pool (same registry the DataLoader
+        # uses) — iterators come and go, engines live for the process
+        from .gluon.data.dataloader import _shared_engine
+        return _shared_engine(self._threads)
+
+    def next(self) -> DataBatch:
+        while len(self._window) < self._prefetch:
+            if not self._submit():
+                break
+        if not self._window:
+            raise StopIteration
+        ev, slot = self._window.popleft()
+        if not ev.wait(120):
+            raise TimeoutError("ImageRecordIter decode timed out")
+        self._submit()
+        item = slot[0]
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class ResizeIter(DataIter):
+    """Caps an iterator at `size` batches per epoch (reference parity)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._it = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._count = 0
+
+    def reset(self):
+        self._count = 0
+        if self._reset_internal:
+            self._it.reset()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def next(self):
+        if self._count >= self._size:
+            raise StopIteration
+        self._count += 1
+        try:
+            return self._it.next()
+        except StopIteration:
+            self._it.reset()
+            return self._it.next()

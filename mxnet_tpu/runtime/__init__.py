@@ -4,3 +4,4 @@ loaded via ctypes; every component has a pure-Python fallback so the
 framework works before `python -m mxnet_tpu.runtime.build` compiles them.
 """
 from . import recordio  # noqa: F401
+from . import engine  # noqa: F401

@@ -1,0 +1,49 @@
+"""Build the native host runtime: `python -m mxnet_tpu.runtime.build`.
+
+Compiles runtime/cc/{engine,recordio}.cc into libmxtpu_runtime.so with
+g++ (no external deps). Called lazily on first native use; safe to call
+concurrently (compiles to a temp name, atomic rename)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+_CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
+_SO = os.path.join(_CC_DIR, "libmxtpu_runtime.so")
+_SRCS = ["engine.cc", "recordio.cc"]
+
+
+def build(force: bool = False, quiet: bool = True) -> str | None:
+    """Compile (if needed) and return the .so path, or None on failure."""
+    if os.path.exists(_SO) and not force:
+        srcs_mtime = max(os.path.getmtime(os.path.join(_CC_DIR, s))
+                         for s in _SRCS)
+        if os.path.getmtime(_SO) >= srcs_mtime:
+            return _SO
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CC_DIR)
+        os.close(fd)
+        cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-Wall",
+               "-shared", "-o", tmp] + \
+              [os.path.join(_CC_DIR, s) for s in _SRCS]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+        if res.returncode != 0:
+            if not quiet:
+                print(res.stderr)
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, _SO)  # atomic on POSIX
+        return _SO
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except Exception:
+            pass
+        return None
+
+
+if __name__ == "__main__":
+    out = build(force=True, quiet=False)
+    print(out if out else "BUILD FAILED")

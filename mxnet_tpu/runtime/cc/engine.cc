@@ -1,0 +1,320 @@
+// Threaded dependency engine — host-side op scheduler.
+//
+// Reference parity: MXNet's ThreadedEngine (src/engine/threaded_engine.cc):
+// ops declare read/write dependencies on versioned variables; reads run
+// concurrently, writes are exclusive and ordered; a thread pool executes
+// ops once every dependency is granted. On TPU the device-side engine is
+// XLA's async runtime, so this engine schedules the HOST pipeline: data
+// loading, decode, prefetch, checkpoint IO.
+//
+// Race detection (reference: versioned vars + ENGINE_DEBUG asserts): every
+// variable carries a version bumped on each completed write; readers
+// capture the version at grant time and assert it is unchanged at
+// completion — a torn write would trip it. A watchdog thread flags ops
+// exceeding a configurable wall-time budget (failure detection for hung
+// IO), readable from mxtpu_engine_watchdog_count.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*mxtpu_fn)(void *ctx);
+}
+
+namespace {
+
+struct Op;
+
+struct Var {
+  std::deque<Op *> queue;          // ops waiting on this var, FIFO
+  int running_reads = 0;           // granted, still-running readers
+  bool writer_active = false;      // granted, still-running writer
+  std::atomic<int64_t> version{0}; // bumped per completed write
+  int64_t id = 0;
+};
+
+struct Op {
+  mxtpu_fn fn;
+  void *ctx;
+  std::vector<int64_t> reads, writes;
+  std::atomic<int> pending{0};        // ungranted dependencies
+  // race detection snapshots: (var id, version at grant time)
+  std::vector<std::pair<int64_t, int64_t>> read_versions;
+  std::chrono::steady_clock::time_point start;
+  bool started = false;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads, int watchdog_sec)
+      : watchdog_sec_(watchdog_sec) {
+    if (num_threads < 1) num_threads = 1;
+    for (int i = 0; i < num_threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+    watchdog_ = std::thread([this] { WatchdogLoop(); });
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    for (auto &t : workers_) t.join();
+    watchdog_.join();
+  }
+
+  int64_t NewVar() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    Var *v = new Var();
+    v->id = id;
+    vars_.emplace(id, v);
+    return id;
+  }
+
+  void Push(mxtpu_fn fn, void *ctx, const int64_t *reads, int n_reads,
+            const int64_t *writes, int n_writes) {
+    Op *op = new Op();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->reads.assign(reads, reads + n_reads);
+    op->writes.assign(writes, writes + n_writes);
+    std::unique_lock<std::mutex> lk(mu_);
+    ++inflight_;
+    int blocked = 0;
+    // enqueue on every dependency var; a var grants ops FIFO
+    for (int64_t v : op->reads) {
+      Var *var = vars_.at(v);
+      if (var->writer_active || !var->queue.empty()) {
+        var->queue.push_back(op);
+        ++blocked;
+      } else {
+        ++var->running_reads;
+        op->read_versions.emplace_back(v, var->version.load());
+      }
+    }
+    for (int64_t v : op->writes) {
+      Var *var = vars_.at(v);
+      if (var->writer_active || var->running_reads > 0 ||
+          !var->queue.empty()) {
+        var->queue.push_back(op);
+        ++blocked;
+      } else {
+        var->writer_active = true;
+      }
+    }
+    op->pending.store(blocked);
+    if (blocked == 0) Ready(op);
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return inflight_ == 0; });
+  }
+
+  void WaitVar(int64_t v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    Var *var = vars_.at(v);
+    done_cv_.wait(lk, [var] {
+      return var->queue.empty() && var->running_reads == 0 &&
+             !var->writer_active;
+    });
+  }
+
+  int64_t VarVersion(int64_t v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return vars_.at(v)->version.load();
+  }
+
+  int Pending() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return inflight_;
+  }
+
+  int64_t RaceCount() { return races_.load(); }
+  int64_t WatchdogCount() { return watchdog_hits_.load(); }
+
+ private:
+  // mu_ held
+  void Ready(Op *op) {
+    ready_.push_back(op);
+    cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op *op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+        op->start = std::chrono::steady_clock::now();
+        op->started = true;
+        running_.push_back(op);
+      }
+      op->fn(op->ctx);
+      Complete(op);
+    }
+  }
+
+  void Complete(Op *op) {
+    std::vector<Op *> newly_ready;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      for (size_t i = 0; i < running_.size(); ++i)
+        if (running_[i] == op) {
+          running_.erase(running_.begin() + i);
+          break;
+        }
+      // race detection: read-snapshot versions must be unchanged
+      for (auto &rv : op->read_versions) {
+        Var *var = vars_.at(rv.first);
+        if (var->version.load() != rv.second) {
+          races_.fetch_add(1);
+          std::fprintf(stderr,
+                       "[mxtpu-engine] RACE: var %lld version moved "
+                       "%lld -> %lld during read\n",
+                       (long long)rv.first, (long long)rv.second,
+                       (long long)var->version.load());
+        }
+      }
+      for (int64_t v : op->reads) {
+        Var *var = vars_.at(v);
+        --var->running_reads;
+        Grant(var, &newly_ready);
+      }
+      for (int64_t v : op->writes) {
+        Var *var = vars_.at(v);
+        var->writer_active = false;
+        var->version.fetch_add(1);
+        Grant(var, &newly_ready);
+      }
+      --inflight_;
+      for (Op *r : newly_ready) Ready(r);
+    }
+    done_cv_.notify_all();
+    delete op;
+  }
+
+  // mu_ held: grant queued ops on var in FIFO order (readers batch)
+  void Grant(Var *var, std::vector<Op *> *out) {
+    while (!var->queue.empty()) {
+      Op *head = var->queue.front();
+      bool is_write = false;
+      for (int64_t w : head->writes)
+        if (vars_.at(w) == var) is_write = true;
+      if (is_write) {
+        if (var->running_reads > 0 || var->writer_active) break;
+        var->queue.pop_front();
+        var->writer_active = true;
+        if (head->pending.fetch_sub(1) == 1) out->push_back(head);
+        break;  // writer is exclusive; stop granting
+      } else {
+        if (var->writer_active) break;
+        var->queue.pop_front();
+        ++var->running_reads;
+        head->read_versions.emplace_back(var->id, var->version.load());
+        if (head->pending.fetch_sub(1) == 1) out->push_back(head);
+        // keep granting readers
+      }
+    }
+  }
+
+  void WatchdogLoop() {
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (shutdown_) return;
+        auto now = std::chrono::steady_clock::now();
+        for (Op *op : running_) {
+          if (!op->started) continue;
+          auto sec = std::chrono::duration_cast<std::chrono::seconds>(
+                         now - op->start)
+                         .count();
+          if (sec >= watchdog_sec_) {
+            watchdog_hits_.fetch_add(1);
+            std::fprintf(stderr,
+                         "[mxtpu-engine] WATCHDOG: op running %llds "
+                         "(budget %ds)\n",
+                         (long long)sec, watchdog_sec_);
+            op->start = now;  // report once per budget window
+          }
+        }
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::deque<Op *> ready_;
+  std::vector<Op *> running_;
+  std::unordered_map<int64_t, Var *> vars_;
+  std::vector<std::thread> workers_;
+  std::thread watchdog_;
+  int64_t next_var_ = 1;
+  int inflight_ = 0;
+  bool shutdown_ = false;
+  int watchdog_sec_;
+  std::atomic<int64_t> races_{0};
+  std::atomic<int64_t> watchdog_hits_{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxtpu_engine_create(int num_threads, int watchdog_sec) {
+  return new Engine(num_threads, watchdog_sec > 0 ? watchdog_sec : 300);
+}
+
+void mxtpu_engine_shutdown(void *eng) { delete static_cast<Engine *>(eng); }
+
+int64_t mxtpu_engine_new_var(void *eng) {
+  return static_cast<Engine *>(eng)->NewVar();
+}
+
+void mxtpu_engine_push(void *eng, mxtpu_fn fn, void *ctx,
+                       const int64_t *reads, int n_reads,
+                       const int64_t *writes, int n_writes) {
+  static_cast<Engine *>(eng)->Push(fn, ctx, reads, n_reads, writes,
+                                   n_writes);
+}
+
+void mxtpu_engine_wait_all(void *eng) {
+  static_cast<Engine *>(eng)->WaitAll();
+}
+
+void mxtpu_engine_wait_var(void *eng, int64_t var) {
+  static_cast<Engine *>(eng)->WaitVar(var);
+}
+
+int64_t mxtpu_engine_var_version(void *eng, int64_t var) {
+  return static_cast<Engine *>(eng)->VarVersion(var);
+}
+
+int mxtpu_engine_pending(void *eng) {
+  return static_cast<Engine *>(eng)->Pending();
+}
+
+int64_t mxtpu_engine_race_count(void *eng) {
+  return static_cast<Engine *>(eng)->RaceCount();
+}
+
+int64_t mxtpu_engine_watchdog_count(void *eng) {
+  return static_cast<Engine *>(eng)->WatchdogCount();
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+// RecordIO reader/writer — MXNet wire format, byte-compatible.
+//
+// Reference parity: src/recordio.cc + python/mxnet/recordio.py. Format:
+//   [u32 magic=0xced7230a | u32 lrecord | payload | pad to 4 bytes]
+//   lrecord = (cflag << 29) | length   (cflag used by the reference for
+//   multi-part records; single-part here, cflag = 0)
+// This is the hot path for ImageRecordIter-style input pipelines: buffered
+// sequential reads, offset indexing for random access, all without the
+// Python interpreter in the loop (Python threads call in via ctypes and
+// release the GIL for the duration).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Handle {
+  FILE *fp = nullptr;
+  bool writing = false;
+  std::vector<uint8_t> buf;  // last read record payload
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxtpu_recio_open(const char *path, int writing) {
+  FILE *fp = std::fopen(path, writing ? "wb" : "rb");
+  if (!fp) return nullptr;
+  Handle *h = new Handle();
+  h->fp = fp;
+  h->writing = writing != 0;
+  return h;
+}
+
+void mxtpu_recio_close(void *hp) {
+  Handle *h = static_cast<Handle *>(hp);
+  if (h->fp) std::fclose(h->fp);
+  delete h;
+}
+
+// Returns the record's file offset, or -1 on error.
+int64_t mxtpu_recio_write(void *hp, const uint8_t *data, int64_t len) {
+  Handle *h = static_cast<Handle *>(hp);
+  if (!h->writing || len < 0 || (uint64_t)len > kLenMask) return -1;
+  int64_t off = std::ftell(h->fp);
+  uint32_t head[2] = {kMagic, (uint32_t)len & kLenMask};
+  if (std::fwrite(head, sizeof(head), 1, h->fp) != 1) return -1;
+  if (len > 0 && std::fwrite(data, 1, (size_t)len, h->fp) != (size_t)len)
+    return -1;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  size_t pad = (size_t)((4 - (len % 4)) % 4);
+  if (pad && std::fwrite(zeros, 1, pad, h->fp) != pad) return -1;
+  return off;
+}
+
+// Reads the next record; returns its length (>=0), -1 at EOF, -2 on a
+// corrupt stream. *data stays valid until the next call on this handle.
+int64_t mxtpu_recio_next(void *hp, const uint8_t **data) {
+  Handle *h = static_cast<Handle *>(hp);
+  uint32_t head[2];
+  if (std::fread(head, sizeof(head), 1, h->fp) != 1) return -1;  // EOF
+  if (head[0] != kMagic) return -2;
+  size_t len = head[1] & kLenMask;
+  h->buf.resize(len);
+  if (len && std::fread(h->buf.data(), 1, len, h->fp) != len) return -2;
+  size_t pad = (4 - (len % 4)) % 4;
+  if (pad) std::fseek(h->fp, (long)pad, SEEK_CUR);
+  *data = h->buf.data();
+  return (int64_t)len;
+}
+
+int64_t mxtpu_recio_read_at(void *hp, int64_t offset,
+                            const uint8_t **data) {
+  Handle *h = static_cast<Handle *>(hp);
+  if (std::fseek(h->fp, (long)offset, SEEK_SET) != 0) return -2;
+  return mxtpu_recio_next(hp, data);
+}
+
+void mxtpu_recio_seek(void *hp, int64_t offset) {
+  std::fseek(static_cast<Handle *>(hp)->fp, (long)offset, SEEK_SET);
+}
+
+void mxtpu_recio_reset(void *hp) {
+  std::fseek(static_cast<Handle *>(hp)->fp, 0, SEEK_SET);
+}
+
+int64_t mxtpu_recio_tell(void *hp) {
+  return std::ftell(static_cast<Handle *>(hp)->fp);
+}
+
+void mxtpu_recio_flush(void *hp) {
+  std::fflush(static_cast<Handle *>(hp)->fp);
+}
+
+// Scan the whole file collecting record offsets (index build); returns
+// the number of records, writing up to cap offsets.
+int64_t mxtpu_recio_scan_offsets(const char *path, int64_t *offsets,
+                                 int64_t cap) {
+  FILE *fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  int64_t n = 0;
+  for (;;) {
+    int64_t off = std::ftell(fp);
+    uint32_t head[2];
+    if (std::fread(head, sizeof(head), 1, fp) != 1) break;
+    if (head[0] != kMagic) {
+      n = -2;
+      break;
+    }
+    size_t len = head[1] & kLenMask;
+    size_t skip = len + (4 - (len % 4)) % 4;
+    if (std::fseek(fp, (long)skip, SEEK_CUR) != 0) {
+      n = -2;
+      break;
+    }
+    if (n < cap) offsets[n] = off;
+    ++n;
+  }
+  std::fclose(fp);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,296 @@
+"""Dependency-engine bindings (reference: mxnet.engine / ThreadedEngine).
+
+`create(num_threads)` returns the C++ engine (runtime/cc/engine.cc via
+ctypes, built lazily) or a pure-Python fallback with identical
+semantics: ops declare read/write vars; reads run concurrently, writes
+are exclusive and FIFO-ordered; `wait_all()` drains. DataLoader
+prefetch, RecordIO pipelines, and checkpoint IO schedule through this.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Sequence
+
+__all__ = ["create", "NativeEngine", "PyEngine"]
+
+_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _load():
+    from .build import build
+    so = build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.mxtpu_engine_create.restype = ctypes.c_void_p
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_engine_shutdown.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_new_var.restype = ctypes.c_int64
+    lib.mxtpu_engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_push.argtypes = [
+        ctypes.c_void_p, _FN, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.mxtpu_engine_wait_all.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_wait_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxtpu_engine_var_version.restype = ctypes.c_int64
+    lib.mxtpu_engine_var_version.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int64]
+    lib.mxtpu_engine_pending.restype = ctypes.c_int
+    lib.mxtpu_engine_pending.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_race_count.restype = ctypes.c_int64
+    lib.mxtpu_engine_race_count.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_watchdog_count.restype = ctypes.c_int64
+    lib.mxtpu_engine_watchdog_count.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_LIB = None
+_LIB_TRIED = False
+_LIB_LOCK = threading.Lock()
+
+
+def _lib():
+    global _LIB, _LIB_TRIED
+    with _LIB_LOCK:
+        if not _LIB_TRIED:
+            _LIB = _load()
+            _LIB_TRIED = True
+    return _LIB
+
+
+def _dedup_deps(read, write):
+    """A var may appear once, and write wins over read — a var in both
+    lists would deadlock against its own never-completing read (the
+    reference requires const/mutable vars disjoint too)."""
+    write = list(dict.fromkeys(write))
+    ws = set(write)
+    read = [r for r in dict.fromkeys(read) if r not in ws]
+    return read, write
+
+
+class NativeEngine:
+    """C++ threaded dependency engine (ctypes).
+
+    One persistent CFUNCTYPE trampoline dispatches every op, with the
+    op id in the ctx pointer. Per-op closures must NOT be per-op
+    CFUNCTYPE objects: dropping the last reference inside the running
+    callback frees the libffi closure mid-call (use-after-free). The
+    single trampoline outlives all calls; only plain Python callables
+    are popped from the job table inside it."""
+
+    def __init__(self, num_threads: int = 4, watchdog_sec: int = 300):
+        lib = _lib()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.mxtpu_engine_create(num_threads, watchdog_sec)
+        self._jobs = {}  # op id -> python callable
+        self._next = 0
+        self._mu = threading.Lock()
+        self._cb = _FN(self._dispatch)  # persistent for engine lifetime
+
+    def _dispatch(self, ctx):
+        op_id = ctx or 0
+        with self._mu:
+            fn = self._jobs.pop(op_id, None)
+        if fn is not None:
+            fn()
+
+    def new_var(self) -> int:
+        return self._lib.mxtpu_engine_new_var(self._h)
+
+    def push(self, fn, read: Sequence[int] = (),
+             write: Sequence[int] = ()):
+        read, write = _dedup_deps(read, write)
+        with self._mu:
+            self._next += 1
+            op_id = self._next  # 1-based: ctx NULL means id 0 is unused
+            self._jobs[op_id] = fn
+        r = (ctypes.c_int64 * len(read))(*read)
+        w = (ctypes.c_int64 * len(write))(*write)
+        self._lib.mxtpu_engine_push(self._h, self._cb,
+                                    ctypes.c_void_p(op_id), r, len(read),
+                                    w, len(write))
+
+    def wait_all(self):
+        self._lib.mxtpu_engine_wait_all(self._h)
+
+    def wait_var(self, var: int):
+        self._lib.mxtpu_engine_wait_var(self._h, var)
+
+    def var_version(self, var: int) -> int:
+        return self._lib.mxtpu_engine_var_version(self._h, var)
+
+    def pending(self) -> int:
+        return self._lib.mxtpu_engine_pending(self._h)
+
+    def race_count(self) -> int:
+        return self._lib.mxtpu_engine_race_count(self._h)
+
+    def watchdog_count(self) -> int:
+        return self._lib.mxtpu_engine_watchdog_count(self._h)
+
+    def shutdown(self):
+        if self._h:
+            self._lib.mxtpu_engine_shutdown(self._h)
+            self._h = None
+
+    @property
+    def is_native(self):
+        return True
+
+
+class PyEngine:
+    """Pure-Python fallback with the same dependency semantics."""
+
+    class _Var:
+        __slots__ = ("queue", "running_reads", "writer_active", "version")
+
+        def __init__(self):
+            self.queue = []
+            self.running_reads = 0
+            self.writer_active = False
+            self.version = 0
+
+    def __init__(self, num_threads: int = 4, watchdog_sec: int = 300):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._ready = []
+        self._vars = {}
+        self._next_var = 1
+        self._inflight = 0
+        self._shutdown = False
+        self._threads = [threading.Thread(target=self._worker,
+                                          daemon=True)
+                         for _ in range(max(1, num_threads))]
+        for t in self._threads:
+            t.start()
+
+    def new_var(self) -> int:
+        with self._mu:
+            v = self._next_var
+            self._next_var += 1
+            self._vars[v] = self._Var()
+            return v
+
+    def push(self, fn, read: Sequence[int] = (),
+             write: Sequence[int] = ()):
+        read, write = _dedup_deps(read, write)
+        op = {"fn": fn, "read": read, "write": write,
+              "pending": 0}
+        with self._cv:
+            self._inflight += 1
+            blocked = 0
+            for v in op["read"]:
+                var = self._vars[v]
+                if var.writer_active or var.queue:
+                    var.queue.append(op)
+                    blocked += 1
+                else:
+                    var.running_reads += 1
+            for v in op["write"]:
+                var = self._vars[v]
+                if var.writer_active or var.running_reads > 0 or var.queue:
+                    var.queue.append(op)
+                    blocked += 1
+                else:
+                    var.writer_active = True
+            op["pending"] = blocked
+            if blocked == 0:
+                self._ready.append(op)
+                self._cv.notify()
+
+    def _grant(self, var):
+        out = []
+        while var.queue:
+            head = var.queue[0]
+            if any(self._vars[w] is var for w in head["write"]):
+                if var.running_reads > 0 or var.writer_active:
+                    break
+                var.queue.pop(0)
+                var.writer_active = True
+                head["pending"] -= 1
+                if head["pending"] == 0:
+                    out.append(head)
+                break
+            else:
+                if var.writer_active:
+                    break
+                var.queue.pop(0)
+                var.running_reads += 1
+                head["pending"] -= 1
+                if head["pending"] == 0:
+                    out.append(head)
+        return out
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._ready and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._ready:
+                    return
+                op = self._ready.pop(0)
+            try:
+                op["fn"]()
+            finally:
+                with self._cv:
+                    newly = []
+                    for v in op["read"]:
+                        var = self._vars[v]
+                        var.running_reads -= 1
+                        newly += self._grant(var)
+                    for v in op["write"]:
+                        var = self._vars[v]
+                        var.writer_active = False
+                        var.version += 1
+                        newly += self._grant(var)
+                    self._inflight -= 1
+                    self._ready.extend(newly)
+                    self._cv.notify_all()
+
+    def wait_all(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._inflight == 0)
+
+    def wait_var(self, var: int):
+        v = self._vars[var]
+        with self._cv:
+            self._cv.wait_for(lambda: not v.queue and
+                              v.running_reads == 0 and
+                              not v.writer_active)
+
+    def var_version(self, var: int) -> int:
+        with self._mu:
+            return self._vars[var].version
+
+    def pending(self) -> int:
+        with self._mu:
+            return self._inflight
+
+    def race_count(self) -> int:
+        return 0
+
+    def watchdog_count(self) -> int:
+        return 0
+
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    @property
+    def is_native(self):
+        return False
+
+
+def create(num_threads: int = 4, watchdog_sec: int = 300,
+           force_python: bool = False):
+    """Engine factory: native C++ when the .so builds, else PyEngine."""
+    if not force_python and _lib() is not None:
+        return NativeEngine(num_threads, watchdog_sec)
+    return PyEngine(num_threads, watchdog_sec)

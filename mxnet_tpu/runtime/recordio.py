@@ -24,31 +24,100 @@ _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
 def _load_native():
-    so = os.path.join(os.path.dirname(__file__), "cc", "libmxtpu_runtime.so")
-    if os.path.exists(so):
-        try:
-            return ctypes.CDLL(so)
-        except OSError:
+    try:
+        from .build import build
+        so = build()
+        if so is None:
             return None
-    return None
+        lib = ctypes.CDLL(so)
+    except Exception:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    lib.mxtpu_recio_open.restype = ctypes.c_void_p
+    lib.mxtpu_recio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.mxtpu_recio_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recio_write.restype = ctypes.c_int64
+    lib.mxtpu_recio_write.argtypes = [ctypes.c_void_p, u8p,
+                                      ctypes.c_int64]
+    lib.mxtpu_recio_next.restype = ctypes.c_int64
+    lib.mxtpu_recio_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(u8p)]
+    lib.mxtpu_recio_read_at.restype = ctypes.c_int64
+    lib.mxtpu_recio_read_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.POINTER(u8p)]
+    lib.mxtpu_recio_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxtpu_recio_reset.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recio_tell.restype = ctypes.c_int64
+    lib.mxtpu_recio_tell.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recio_flush.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recio_scan_offsets.restype = ctypes.c_int64
+    lib.mxtpu_recio_scan_offsets.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    return lib
 
 
-_NATIVE = _load_native()
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native():
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE = _load_native()
+        _NATIVE_TRIED = True
+    return _NATIVE
+
+
+def list_record_offsets(path):
+    """Offsets of every record in `path` (native fast scan when built)."""
+    lib = _native()
+    if lib is not None:
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_int64 * cap)()
+            n = lib.mxtpu_recio_scan_offsets(path.encode(), buf, cap)
+            if n == -1:
+                raise FileNotFoundError(path)
+            if n < 0:
+                raise IOError(f"corrupt RecordIO file {path}")
+            if n <= cap:
+                return list(buf[:n])
+            cap = n
+    offsets = []
+    with MXRecordIO(path, "r") as r:
+        while True:
+            off = r.tell()
+            if r.read() is None:
+                break
+            offsets.append(off)
+    return offsets
 
 
 class MXRecordIO:
-    """Sequential record reader/writer."""
+    """Sequential record reader/writer (C++ fast path via ctypes)."""
 
     def __init__(self, uri: str, flag: str):
         self.uri = uri
         self.flag = flag
         self._fp = None
+        self._h = None
         self.open()
 
     def open(self):
+        lib = _native()
+        if lib is not None:
+            self._lib = lib
+            self._h = lib.mxtpu_recio_open(self.uri.encode(),
+                                           1 if self.flag == "w" else 0)
+            if not self._h:
+                raise IOError(f"cannot open {self.uri}")
+            return
         self._fp = open(self.uri, "wb" if self.flag == "w" else "rb")
 
     def close(self):
+        if self._h:
+            self._lib.mxtpu_recio_close(self._h)
+            self._h = None
         if self._fp:
             self._fp.close()
             self._fp = None
@@ -60,13 +129,31 @@ class MXRecordIO:
         self.close()
 
     def reset(self):
-        self._fp.seek(0)
+        if self._h:
+            self._lib.mxtpu_recio_reset(self._h)
+        else:
+            self._fp.seek(0)
 
     def tell(self):
+        if self._h:
+            return self._lib.mxtpu_recio_tell(self._h)
         return self._fp.tell()
+
+    def _seek(self, offset):
+        if self._h:
+            self._lib.mxtpu_recio_seek(self._h, offset)
+        else:
+            self._fp.seek(offset)
 
     def write(self, buf: bytes):
         assert self.flag == "w"
+        if self._h:
+            arr = (ctypes.c_ubyte * len(buf)).from_buffer_copy(buf) \
+                if buf else None
+            off = self._lib.mxtpu_recio_write(self._h, arr, len(buf))
+            if off < 0:
+                raise IOError(f"RecordIO write failed on {self.uri}")
+            return
         lrec = len(buf) & _LMASK
         self._fp.write(struct.pack("<II", _MAGIC, lrec))
         self._fp.write(buf)
@@ -76,6 +163,14 @@ class MXRecordIO:
 
     def read(self) -> Optional[bytes]:
         assert self.flag == "r"
+        if self._h:
+            ptr = ctypes.POINTER(ctypes.c_ubyte)()
+            n = self._lib.mxtpu_recio_next(self._h, ctypes.byref(ptr))
+            if n == -1:
+                return None
+            if n < 0:
+                raise IOError(f"corrupt RecordIO stream in {self.uri}")
+            return ctypes.string_at(ptr, n)
         head = self._fp.read(8)
         if len(head) < 8:
             return None
@@ -116,7 +211,7 @@ class IndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx_key):
-        self._fp.seek(self.idx[idx_key])
+        self._seek(self.idx[idx_key])
 
     def read_idx(self, idx_key) -> bytes:
         self.seek(idx_key)

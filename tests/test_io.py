@@ -1,0 +1,156 @@
+"""mx.io iterators: NDArrayIter semantics, ImageRecordIter over RecordIO
+(SURVEY §2 'mx.io')."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (NDArrayIter, ImageRecordIter, ResizeIter,
+                          DataBatch)
+from mxnet_tpu.runtime import recordio as rio
+
+
+def test_ndarrayiter_basic():
+    X = np.arange(40, dtype=np.float32).reshape(20, 2)
+    Y = np.arange(20, dtype=np.float32)
+    it = NDArrayIter(X, Y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert np.allclose(got, X)
+    assert all(b.pad == 0 for b in batches)
+    # reset → same data again
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarrayiter_pad_and_discard():
+    X = np.arange(14, dtype=np.float32).reshape(7, 2)
+    it = NDArrayIter(X, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    assert batches[-1].data[0].shape == (3, 2)  # padded to full batch
+    it2 = NDArrayIter(X, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarrayiter_roll_over():
+    X = np.arange(7, dtype=np.float32)
+    it = NDArrayIter(X, batch_size=3, last_batch_handle="roll_over")
+    assert len(list(it)) == 2  # 6 rows used, 1 rolls
+    it.reset()
+    b = list(it)
+    # rolled row leads the next epoch: 1 + 7 = 8 rows → 2 full batches
+    assert len(b) == 2
+    first = b[0].data[0].asnumpy()
+    assert first[0] == 6.0  # the rolled-over row
+
+
+def test_ndarrayiter_roll_over_shuffle_carries_tail():
+    """With shuffle, the unvisited tail must lead the next epoch (no
+    duplicates within it, no skipped samples across two epochs)."""
+    np.random.seed(5)
+    X = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(X, batch_size=4, shuffle=True,
+                     last_batch_handle="roll_over")
+    seen1 = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert len(seen1) == 8
+    unvisited = set(X) - set(seen1)  # 2 rows
+    it.reset()
+    b = list(it)
+    epoch2 = np.concatenate([x.data[0].asnumpy() for x in b])
+    assert len(epoch2) == 12  # 2 rolled + 10 new, 3 full batches
+    assert set(epoch2[:2]) == unvisited  # tail leads
+    # the new epoch's own pass still covers every sample
+    assert set(epoch2[2:]) == set(X)
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    X = np.arange(16, dtype=np.float32)
+    it = NDArrayIter(X, batch_size=4, shuffle=True)
+    got = np.sort(np.concatenate([b.data[0].asnumpy() for b in it]))
+    assert np.allclose(got, X)
+
+
+def test_ndarrayiter_provide_data_desc():
+    it = NDArrayIter(np.zeros((8, 3, 4, 4), np.float32),
+                     np.zeros(8, np.float32), batch_size=2)
+    d = it.provide_data[0]
+    assert d.shape == (2, 3, 4, 4) and d.name == "data"
+    assert it.provide_label[0].name == "softmax_label"
+
+
+@pytest.fixture
+def rec_file(tmp_path):
+    p = str(tmp_path / "imgs.rec")
+    rs = np.random.RandomState(0)
+    w = rio.MXRecordIO(p, "w")
+    imgs = []
+    for i in range(24):
+        img = rs.randint(0, 256, (8, 8, 3), dtype=np.uint8)
+        imgs.append(img)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % 10), i, 0), img))
+    w.close()
+    return p, imgs
+
+
+def test_image_record_iter(rec_file):
+    path, imgs = rec_file
+    it = ImageRecordIter(path, batch_size=8, data_shape=(3, 8, 8))
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert b0.data[0].shape == (8, 3, 8, 8)
+    assert b0.label[0].shape == (8,)
+    # first image decodes to its pixel values / 255
+    expect = imgs[0].astype(np.float32).transpose(2, 0, 1) / 255.0
+    assert np.allclose(b0.data[0].asnumpy()[0], expect, atol=1e-6)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert np.allclose(labels, np.arange(24) % 10)
+
+
+def test_image_record_iter_shuffle_epoch(rec_file):
+    path, _ = rec_file
+    it = ImageRecordIter(path, batch_size=8, data_shape=(3, 8, 8),
+                         shuffle=True, seed=3)
+    l1 = np.concatenate([b.label[0].asnumpy() for b in it])
+    it.reset()
+    l2 = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert len(l1) == len(l2) == 24
+    assert not np.allclose(l1, l2)  # reshuffled between epochs
+
+
+def test_resize_iter(rec_file):
+    path, _ = rec_file
+    base = ImageRecordIter(path, batch_size=8, data_shape=(3, 8, 8))
+    it = ResizeIter(base, size=5)
+    assert len(list(it)) == 5  # wraps around the 3-batch epoch
+
+
+def test_lenet_trains_from_ndarrayiter():
+    """Classic mx.io training loop drives a Gluon model end-to-end."""
+    mx.random.seed(0)
+    rs = np.random.RandomState(1)
+    X = rs.rand(64, 1, 8, 8).astype(np.float32)
+    Y = (X.mean(axis=(1, 2, 3)) > 0.5).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=16, shuffle=True)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(4, 3, activation="relu"),
+            mx.gluon.nn.GlobalAvgPool2D(),
+            mx.gluon.nn.Dense(2))
+    net.initialize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.01})
+    epoch_means = []
+    for _ in range(6):
+        it.reset()
+        losses = []
+        for batch in it:
+            with mx.autograd.record():
+                l = loss_fn(net(batch.data[0]), batch.label[0]).mean()
+            l.backward()
+            tr.step(1)
+            losses.append(float(l.asscalar()))
+        epoch_means.append(np.mean(losses))
+    assert epoch_means[-1] < epoch_means[0], epoch_means
