@@ -15,15 +15,9 @@ def register_model(name):
 def get_model(name, **kwargs):
     name = name.lower()
     # populate registry lazily
-    from . import lenet, resnet, mobilenet  # noqa: F401
-    try:
-        from . import vgg, alexnet, squeezenet, densenet  # noqa: F401
-    except ImportError:
-        pass
-    try:
-        from . import bert, transformer, llama, fm  # noqa: F401
-    except ImportError:
-        pass
+    from . import (lenet, mlp, resnet, mobilenet, vgg, alexnet,  # noqa: F401
+                   squeezenet, densenet, bert, transformer, llama, fm,
+                   word_embedding)
     if name not in _FACTORIES:
         raise ValueError(f"unknown model {name}; have "
                          f"{sorted(_FACTORIES)}")

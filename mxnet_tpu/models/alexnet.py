@@ -1,0 +1,45 @@
+"""AlexNet (reference: mxnet/gluon/model_zoo/vision/alexnet.py).
+
+NHWC by default; the large early kernels (11x11, 5x5) lower to XLA conv
+with implicit im2col on the MXU.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from . import register_model
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(
+            nn.Conv2D(64, kernel_size=11, strides=4, padding=2,
+                      activation="relu", layout=layout),
+            nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+            nn.Conv2D(192, kernel_size=5, padding=2, activation="relu",
+                      layout=layout),
+            nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+            nn.Conv2D(384, kernel_size=3, padding=1, activation="relu",
+                      layout=layout),
+            nn.Conv2D(256, kernel_size=3, padding=1, activation="relu",
+                      layout=layout),
+            nn.Conv2D(256, kernel_size=3, padding=1, activation="relu",
+                      layout=layout),
+            nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+            nn.Flatten(),
+            nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
+            nn.Dense(4096, activation="relu"), nn.Dropout(0.5),
+        )
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+@register_model("alexnet")
+def alexnet(**kwargs):
+    return AlexNet(**kwargs)

@@ -1,0 +1,105 @@
+"""DenseNet 121/161/169/201 (reference:
+mxnet/gluon/model_zoo/vision/densenet.py).
+
+Dense blocks concatenate every layer's features on the channel axis;
+NHWC keeps those concats on the lane dimension so XLA fuses the
+BN-ReLU-Conv chains around them.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from . import register_model
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+# num_init_features, growth_rate, block layers
+_SPEC = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+class _DenseLayer(HybridBlock):
+    """BN-ReLU-Conv1x1 (bottleneck) -> BN-ReLU-Conv3x3, output concatenated
+    with the input."""
+
+    def __init__(self, growth_rate, bn_size, dropout, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        ax = layout.index("C")
+        self._ax = ax
+        self.body = HybridSequential()
+        self.body.add(nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      nn.Conv2D(bn_size * growth_rate, kernel_size=1,
+                                use_bias=False, layout=layout),
+                      nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                      nn.Conv2D(growth_rate, kernel_size=3, padding=1,
+                                use_bias=False, layout=layout))
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
+
+    def forward(self, x):
+        from .. import nd
+        return nd.concat(x, self.body(x), dim=self._ax)
+
+
+def _transition(channels, layout):
+    ax = layout.index("C")
+    out = HybridSequential()
+    out.add(nn.BatchNorm(axis=ax), nn.Activation("relu"),
+            nn.Conv2D(channels, kernel_size=1, use_bias=False,
+                      layout=layout),
+            nn.AvgPool2D(pool_size=2, strides=2, layout=layout))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0.0, classes=1000, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        ax = layout.index("C")
+        self.features = HybridSequential()
+        self.features.add(
+            nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                      padding=3, use_bias=False, layout=layout),
+            nn.BatchNorm(axis=ax), nn.Activation("relu"),
+            nn.MaxPool2D(pool_size=3, strides=2, padding=1, layout=layout))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = HybridSequential()
+            for _ in range(num_layers):
+                block.add(_DenseLayer(growth_rate, bn_size, dropout,
+                                      layout=layout))
+            self.features.add(block)
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_transition(num_features, layout))
+        self.features.add(nn.BatchNorm(axis=ax), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(layout=layout), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _make(num_layers):
+    init_f, growth, blocks = _SPEC[num_layers]
+
+    @register_model(f"densenet{num_layers}")
+    def factory(**kw):
+        return DenseNet(init_f, growth, blocks, **kw)
+
+    factory.__name__ = f"densenet{num_layers}"
+    return factory
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)

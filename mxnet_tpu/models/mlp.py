@@ -1,0 +1,23 @@
+"""Multi-layer perceptron (reference: example/image-classification
+train_mnist.py --network mlp)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from . import register_model
+
+__all__ = ["MLP", "mlp"]
+
+
+class MLP(nn.HybridSequential):
+    def __init__(self, classes=10, hidden=(128, 64), activation="relu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.add(nn.Flatten())
+        for h in hidden:
+            self.add(nn.Dense(h, activation=activation))
+        self.add(nn.Dense(classes))
+
+
+@register_model("mlp")
+def mlp(classes=10, **kwargs):
+    return MLP(classes=classes, **kwargs)

@@ -1,0 +1,84 @@
+"""SqueezeNet 1.0/1.1 (reference: mxnet/gluon/model_zoo/vision/squeezenet.py).
+
+Fire modules = 1x1 squeeze + parallel 1x1/3x3 expand, concatenated on the
+channel axis. NHWC default so the concat is on the innermost (lane) dim.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from . import register_model
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(HybridBlock):
+    def __init__(self, squeeze, expand1x1, expand3x3, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._ax = layout.index("C")
+        self.squeeze = nn.Conv2D(squeeze, kernel_size=1, activation="relu",
+                                 layout=layout)
+        self.expand1x1 = nn.Conv2D(expand1x1, kernel_size=1,
+                                   activation="relu", layout=layout)
+        self.expand3x3 = nn.Conv2D(expand3x3, kernel_size=3, padding=1,
+                                   activation="relu", layout=layout)
+
+    def forward(self, x):
+        from .. import nd
+        s = self.squeeze(x)
+        return nd.concat(self.expand1x1(s), self.expand3x3(s),
+                         dim=self._ax)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version="1.0", classes=1000, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"unsupported SqueezeNet version {version}")
+        self.features = HybridSequential()
+        if version == "1.0":
+            self.features.add(
+                nn.Conv2D(96, kernel_size=7, strides=2, activation="relu",
+                          layout=layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(16, 64, 64, layout), Fire(16, 64, 64, layout),
+                Fire(32, 128, 128, layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(32, 128, 128, layout), Fire(48, 192, 192, layout),
+                Fire(48, 192, 192, layout), Fire(64, 256, 256, layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(64, 256, 256, layout))
+        else:
+            self.features.add(
+                nn.Conv2D(64, kernel_size=3, strides=2, activation="relu",
+                          layout=layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(16, 64, 64, layout), Fire(16, 64, 64, layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(32, 128, 128, layout), Fire(32, 128, 128, layout),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=layout),
+                Fire(48, 192, 192, layout), Fire(48, 192, 192, layout),
+                Fire(64, 256, 256, layout), Fire(64, 256, 256, layout))
+        self.features.add(nn.Dropout(0.5))
+        # classifier: 1x1 conv to `classes` maps, then global average
+        self.output = HybridSequential()
+        self.output.add(
+            nn.Conv2D(classes, kernel_size=1, activation="relu",
+                      layout=layout),
+            nn.GlobalAvgPool2D(layout=layout),
+            nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+@register_model("squeezenet1.0")
+def squeezenet1_0(**kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+@register_model("squeezenet1.1")
+def squeezenet1_1(**kwargs):
+    return SqueezeNet("1.1", **kwargs)

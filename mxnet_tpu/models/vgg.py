@@ -1,0 +1,72 @@
+"""VGG 11/13/16/19 ± BatchNorm (reference: mxnet/gluon/model_zoo/vision/vgg.py).
+
+TPU-first: default layout NHWC so the 3x3 conv stacks tile straight onto
+the MXU; BN axis follows the layout.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from . import register_model
+
+__all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
+
+# (layers-per-stage, channels-per-stage)
+_SPEC = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        ax = layout.index("C")
+        self.features = HybridSequential()
+        for num, ch in zip(layers, filters):
+            for _ in range(num):
+                self.features.add(nn.Conv2D(ch, kernel_size=3, padding=1,
+                                            layout=layout))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm(axis=ax))
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(strides=2, layout=layout))
+        self.features.add(nn.Flatten(),
+                          nn.Dense(4096, activation="relu"),
+                          nn.Dropout(0.5),
+                          nn.Dense(4096, activation="relu"),
+                          nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers, **kwargs):
+    layers, filters = _SPEC[num_layers]
+    return VGG(layers, filters, **kwargs)
+
+
+def _make(num_layers, batch_norm):
+    suffix = "_bn" if batch_norm else ""
+
+    @register_model(f"vgg{num_layers}{suffix}")
+    def factory(**kw):
+        return get_vgg(num_layers, batch_norm=batch_norm, **kw)
+
+    factory.__name__ = f"vgg{num_layers}{suffix}"
+    return factory
+
+
+vgg11 = _make(11, False)
+vgg13 = _make(13, False)
+vgg16 = _make(16, False)
+vgg19 = _make(19, False)
+vgg11_bn = _make(11, True)
+vgg13_bn = _make(13, True)
+vgg16_bn = _make(16, True)
+vgg19_bn = _make(19, True)

@@ -1,0 +1,62 @@
+"""Word embeddings: skip-gram with negative sampling (reference:
+example/gluon/word_language_model + GluonNLP word_embeddings/train_sg.py).
+
+TPU-first: negatives are sampled on host and the whole step is one
+batched embedding-gather + batched dot (MXU) under the fused train step —
+no sparse scatter in the hot loop; the embedding grads can still route
+through the row-sparse optimizer path via ``sparse_grad=True``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from . import register_model
+
+__all__ = ["SkipGramNet", "skipgram", "sample_negatives"]
+
+
+class SkipGramNet(HybridBlock):
+    """Center/context embedding pair scored by dot product.
+
+    ``forward(center, context)`` returns logits of shape
+    (batch, 1 + num_negatives) where column 0 is the positive pair —
+    train against [1, 0, ..., 0] with SigmoidBinaryCrossEntropyLoss.
+    """
+
+    def __init__(self, vocab_size, embed_dim=128, sparse_grad=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.center_embed = nn.Embedding(vocab_size, embed_dim,
+                                         sparse_grad=sparse_grad)
+        self.context_embed = nn.Embedding(vocab_size, embed_dim,
+                                          sparse_grad=sparse_grad)
+
+    def forward(self, center, context):
+        # center: (B,)  context: (B, 1+K) — col 0 positive, rest negatives
+        c = self.center_embed(center)               # (B, D)
+        ctx = self.context_embed(context)           # (B, 1+K, D)
+        c = c.expand_dims(axis=2)                   # (B, D, 1)
+        return nd.batch_dot(ctx, c).reshape(ctx.shape[0], ctx.shape[1])
+
+    def embedding(self):
+        """The trained center-word embedding matrix as an NDArray."""
+        return self.center_embed.weight.data()
+
+
+def sample_negatives(context_pos, num_negatives, vocab_size, rng=None):
+    """Host-side unigram negative sampling → (B, 1+K) int32 index array
+    with the positive context in column 0."""
+    rng = rng or np.random.default_rng(0)
+    pos = np.asarray(context_pos).reshape(-1, 1)
+    neg = rng.integers(0, vocab_size, size=(pos.shape[0], num_negatives))
+    return np.concatenate([pos, neg], axis=1).astype(np.int32)
+
+
+@register_model("skipgram")
+def skipgram(vocab_size=10000, embed_dim=128, **kwargs):
+    return SkipGramNet(vocab_size, embed_dim, **kwargs)

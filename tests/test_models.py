@@ -170,3 +170,67 @@ def test_rnn_grad_flows():
     w = layer.collect_params()
     g = w["l0_i2h_weight"].grad().asnumpy()
     assert np.abs(g).sum() > 0
+
+
+def test_vgg11_bn_tiny():
+    net = mx.models.get_model("vgg11_bn", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 32, 32, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_alexnet_forward():
+    net = mx.models.get_model("alexnet", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 67, 67, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_squeezenet_forward():
+    net = mx.models.get_model("squeezenet1.1", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 64, 64, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_densenet121_tiny():
+    net = mx.models.get_model("densenet121", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 32, 32, 3)))
+    assert out.shape == (1, 10)
+
+
+def test_mlp_forward():
+    net = mx.models.get_model("mlp", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(4, 1, 28, 28)))
+    assert out.shape == (4, 10)
+
+
+def test_skipgram_trains():
+    from mxnet_tpu.models.word_embedding import SkipGramNet, \
+        sample_negatives
+    rs = np.random.default_rng(0)
+    vocab, dim, batch, k = 40, 16, 32, 5
+    net = SkipGramNet(vocab, dim)
+    net.initialize()
+    center = rs.integers(0, vocab, size=batch)
+    # make word i co-occur with word (i+1) % vocab
+    pos = (center + 1) % vocab
+    ctx = sample_negatives(pos, k, vocab, rng=rs)
+    label = np.zeros((batch, 1 + k), np.float32)
+    label[:, 0] = 1.0
+    bce = mx.gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+    c, x, y = nd.array(center, dtype="int32"), nd.array(ctx, dtype="int32"), \
+        nd.array(label)
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            l = bce(net(c, x), y).mean()
+        l.backward()
+        tr.step(batch)
+        losses.append(l.asscalar())
+    assert losses[-1] < losses[0] * 0.5
+    assert net.embedding().shape == (vocab, dim)
