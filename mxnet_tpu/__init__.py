@@ -32,6 +32,7 @@ from .kvstore import create as _kv_create  # noqa: F401
 from . import gluon
 from . import models
 from . import amp
+from . import checkpoint
 from . import profiler
 from . import parallel
 from . import io

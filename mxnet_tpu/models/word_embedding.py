@@ -48,10 +48,13 @@ class SkipGramNet(HybridBlock):
         return self.center_embed.weight.data()
 
 
+_NEG_RNG = np.random.default_rng(0)  # shared: varies batch-to-batch
+
+
 def sample_negatives(context_pos, num_negatives, vocab_size, rng=None):
     """Host-side unigram negative sampling → (B, 1+K) int32 index array
     with the positive context in column 0."""
-    rng = rng or np.random.default_rng(0)
+    rng = rng or _NEG_RNG
     pos = np.asarray(context_pos).reshape(-1, 1)
     neg = rng.integers(0, vocab_size, size=(pos.shape[0], num_negatives))
     return np.concatenate([pos, neg], axis=1).astype(np.int32)

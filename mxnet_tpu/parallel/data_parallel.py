@@ -188,6 +188,14 @@ class FusedTrainStep:
                         for i, n in enumerate(self._tr_names)}
         for i, n in enumerate(self._tr_names):
             self.optimizer.idx2name[i] = n
+        if getattr(self, "_pending_restore", None) is not None:
+            # checkpoint.Checkpointer.restore ran before the first step
+            slots, step_count = self._pending_restore
+            if slots is not None:
+                self._states = jax.tree_util.tree_map(jnp.asarray, slots)
+            if step_count is not None:
+                self._step_count = step_count
+            self._pending_restore = None
 
     def sync_to_params(self):
         """Write device weights back into the Parameters (checkpointing /
@@ -269,6 +277,7 @@ class FusedTrainStep:
                          for n, v in self._aux.items()}
             self._states = jax.device_put(self._states, st_sh)
             self._batch_sh = batch_sh
+            self._tr_sh, self._aux_sh, self._st_sh = tr_sh, aux_sh, st_sh
         else:
             self._compiled = jax.jit(
                 step, donate_argnums=(0, 2) if self.donate else ())

@@ -1,0 +1,125 @@
+"""Multi-host (TPU pod / multi-process) wiring.
+
+TPU-first replacement for the reference's distributed launch plumbing
+(kvstore dist_* modes: parameter-server `ps-lite` bootstrap + NCCL
+communicators). On TPU there is no rendezvous server to run: every host
+calls :func:`initialize` once, JAX's coordination service forms the
+global device view, and from then on *the same* SPMD program (psum /
+all_gather over a Mesh) spans all hosts — the DCN hops are just slower
+mesh axes.
+
+Design notes (scaling-book recipe):
+- ICI axes (within a pod slice) carry the high-traffic collectives
+  (tensor-parallel all_gather/psum); DCN (between slices) should only
+  carry low-frequency traffic (data-parallel gradient reduce).
+- ``hybrid_device_mesh`` therefore puts the DCN axis *outermost* and the
+  ICI axes innermost, via ``mesh_utils.create_hybrid_device_mesh``.
+- Checkpointing and logging are gated on :func:`is_primary` (process 0),
+  matching the reference's "rank 0 saves" convention.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as _np
+
+import jax
+
+__all__ = [
+    "initialize", "is_initialized", "is_primary", "process_index",
+    "process_count", "local_devices", "hybrid_device_mesh",
+    "sync_global_devices", "broadcast_from_primary",
+]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None, **kwargs):
+    """Join the multi-host job: wrap ``jax.distributed.initialize``.
+
+    All arguments default to auto-detection (TPU metadata / env vars
+    ``MXNET_TPU_COORDINATOR``, ``MXNET_TPU_NUM_PROCS``,
+    ``MXNET_TPU_PROC_ID``), so single-host runs may simply never call
+    this. Safe to call twice (second call is a no-op). Replaces the
+    reference's ``DMLC_PS_ROOT_URI``/scheduler bootstrap.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "MXNET_TPU_COORDINATOR")
+    if num_processes is None and "MXNET_TPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["MXNET_TPU_NUM_PROCS"])
+    if process_id is None and "MXNET_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["MXNET_TPU_PROC_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on process 0 — gate checkpoint writes / logging on this."""
+    return jax.process_index() == 0
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def hybrid_device_mesh(ici_shape: Sequence[int],
+                       dcn_shape: Sequence[int],
+                       axis_names: Sequence[str],
+                       devices=None) -> "jax.sharding.Mesh":
+    """DCN×ICI hybrid mesh: ``dcn_shape`` axes span pod slices (slow
+    network, put dp here), ``ici_shape`` axes span chips within a slice
+    (fast ICI, put tp/sp here). Axis ``i`` has total size
+    ``dcn_shape[i] * ici_shape[i]``.
+
+    Example for 2 slices × 16 chips, dp over DCN and tp over ICI::
+
+        mesh = hybrid_device_mesh(ici_shape=[2, 8], dcn_shape=[2, 1],
+                                  axis_names=["dp", "tp"])
+    """
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+    n = int(_np.prod(ici_shape)) * int(_np.prod(dcn_shape))
+    devices = list(devices if devices is not None else jax.devices())[:n]
+    if int(_np.prod(dcn_shape)) == 1:
+        arr = mesh_utils.create_device_mesh(tuple(ici_shape),
+                                            devices=devices)
+    else:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devices)
+    return Mesh(arr, tuple(axis_names))
+
+
+def sync_global_devices(name: str = "barrier"):
+    """Cross-host barrier (reference: ``kv.barrier()``)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_primary(tree):
+    """Broadcast host-local values from process 0 to all processes
+    (reference: PS init broadcast of fresh weights)."""
+    if jax.process_count() <= 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(tree)

@@ -1,0 +1,141 @@
+"""Checkpoint/resume (SURVEY §2 aux subsystems): full training-state
+snapshot via orbax; deterministic bit-exact continuation after restore."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import Checkpointer, latest_step
+
+
+def _make_net(seed=0):
+    mx.random.seed(seed)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"))
+    net.add(mx.gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _data(n=8):
+    rs = np.random.RandomState(42)
+    X = mx.nd.array(rs.rand(n, 10).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, n), dtype="int32")
+    return X, Y
+
+
+def _train_steps(net, trainer, X, Y, k):
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(k):
+        with mx.autograd.record():
+            l = loss_fn(net(X), Y).mean()
+        l.backward()
+        trainer.step(1)
+        losses.append(float(l.asscalar()))
+    return losses
+
+
+def test_trainer_resume_bitexact(tmp_path):
+    X, Y = _data()
+    net = _make_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    _train_steps(net, tr, X, Y, 3)
+    ck = Checkpointer(str(tmp_path / "run"))
+    ck.save(3, net=net, trainer=tr, extra={"epoch": 1})
+    ref = _train_steps(net, tr, X, Y, 2)  # ground-truth continuation
+    ck.close()
+
+    net2 = _make_net(seed=7)  # different init — restore must overwrite
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+    ck2 = Checkpointer(str(tmp_path / "run"))
+    meta = ck2.restore(net=net2, trainer=tr2)
+    ck2.close()
+    assert meta["step"] == 3 and meta["extra"]["epoch"] == 1
+    got = _train_steps(net2, tr2, X, Y, 2)
+    np.testing.assert_array_equal(np.float32(ref), np.float32(got))
+
+
+def test_fused_step_resume(tmp_path):
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    X, Y = _data()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net = _make_net()
+    step = FusedTrainStep(net, loss_fn,
+                          mx.optimizer.Adam(learning_rate=1e-2))
+    for _ in range(3):
+        l = step(X, Y)
+    ck = Checkpointer(str(tmp_path / "fused"))
+    ck.save(3, fused_step=step)
+    ref = [float(step(X, Y).asscalar()) for _ in range(2)]
+    ck.close()
+
+    net2 = _make_net(seed=9)
+    step2 = FusedTrainStep(net2, loss_fn,
+                           mx.optimizer.Adam(learning_rate=1e-2))
+    ck2 = Checkpointer(str(tmp_path / "fused"))
+    meta = ck2.restore(net=net2, fused_step=step2)
+    ck2.close()
+    assert meta["step"] == 3
+    got = [float(step2(X, Y).asscalar()) for _ in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=1e-6)
+
+
+def test_max_to_keep_and_latest(tmp_path):
+    net = _make_net()
+    d = str(tmp_path / "keep")
+    ck = Checkpointer(d, max_to_keep=2)
+    for s in (1, 2, 3):
+        ck.save(s, net=net)
+    assert ck.latest_step() == 3
+    assert ck.all_steps() == [2, 3]
+    ck.close()
+    assert latest_step(d) == 3
+
+
+def test_rng_state_roundtrip(tmp_path):
+    net = _make_net()
+    mx.random.seed(123)
+    mx.nd.random.uniform(shape=(4,))  # advance the global key
+    ck = Checkpointer(str(tmp_path / "rng"))
+    ck.save(0, net=net)
+    a = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    ck.restore(net=net, step=0)
+    b = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    ck.close()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_fused_save_before_first_step(tmp_path):
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    net = _make_net()
+    step = FusedTrainStep(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    ck = Checkpointer(str(tmp_path / "pre"))
+    ck.save(0, fused_step=step)  # must not crash pre-first-step
+    assert ck.latest_step() == 0
+    ck.close()
+
+
+def test_async_save(tmp_path):
+    net = _make_net()
+    ck = Checkpointer(str(tmp_path / "async"), async_save=True)
+    ck.save(1, net=net)
+    ck.wait()
+    assert ck.latest_step() == 1
+    ck.close()
+
+
+def test_multihost_helpers():
+    import jax
+    from mxnet_tpu.parallel import multihost as mh
+    assert mh.is_primary() and mh.process_count() == 1
+    assert mh.broadcast_from_primary({"a": 1})["a"] == 1
+    mh.sync_global_devices("t")
+    n = len(jax.devices())
+    if n >= 4:
+        mesh = mh.hybrid_device_mesh(ici_shape=[2, 2], dcn_shape=[1, 1],
+                                     axis_names=["dp", "tp"])
+        assert mesh.shape == {"dp": 2, "tp": 2}
