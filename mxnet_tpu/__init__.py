@@ -34,6 +34,7 @@ from . import models
 from . import amp
 from . import checkpoint
 from . import profiler
+from . import tracing
 from . import parallel
 from . import io
 from . import runtime

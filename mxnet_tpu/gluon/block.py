@@ -183,7 +183,7 @@ class Block:
 
 class _CacheEntry:
     __slots__ = ("jit_fn", "raw_fn", "tr_names", "aux_names", "tensor_pos",
-                 "out_treedef", "n_out")
+                 "out_treedef", "n_out", "_example_avals")
 
     def __init__(self, jit_fn, tr_names, aux_names, tensor_pos):
         self.jit_fn = jit_fn
@@ -193,6 +193,7 @@ class _CacheEntry:
         self.tensor_pos = tensor_pos
         self.out_treedef = None
         self.n_out = None
+        self._example_avals = None  # recorded on first call (tracing.py)
 
 
 class HybridBlock(Block):
@@ -233,11 +234,10 @@ class HybridBlock(Block):
         if not self._jit_cache:
             raise RuntimeError("call the hybridized block once before "
                                "export()")
+        from .. import tracing as _tracing
         entry = next(iter(self._jit_cache.values()))
-        lowered = getattr(entry, "_last_lowered", None)
-        text = lowered if lowered else "<compiled; rerun with dump enabled>"
         with open(f"{path}-symbol.txt", "w") as f:
-            f.write(text)
+            f.write(_tracing.lower_text(entry))
         self.save_parameters(f"{path}-{epoch:04d}.params")
         return f"{path}-symbol.txt"
 
@@ -271,7 +271,8 @@ class HybridBlock(Block):
                 key_parts.append(("static", repr(a)))
         cache_key = tuple(key_parts)
         entry = self._jit_cache.get(cache_key)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build(tuple(tensor_pos), args, training, params)
             self._jit_cache[cache_key] = entry
 
@@ -279,6 +280,17 @@ class HybridBlock(Block):
         aux = {n: params[n].data()._data for n in entry.aux_names}
         rng = _random.next_key()
         tensor_raw = [args[i]._data for i in entry.tensor_pos]
+
+        from .. import tracing as _tracing
+        if fresh:
+            sds = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+            entry._example_avals = (sds(tr), sds(aux), sds(rng),
+                                    *[sds(t) for t in tensor_raw])
+            _tracing.record_compile(self.name or type(self).__name__,
+                                    entry)
+        else:
+            _tracing.record_hit()
 
         if autograd.is_recording():
             f = lambda tr_, *ins: entry.jit_fn(tr_, aux, rng, *ins)

@@ -291,12 +291,16 @@ class ParameterDict:
             key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
                 else name
             data[key] = _np.asarray(jax.device_get(p._data._data))
-        _np.savez(filename, **data)
+        with open(filename, "wb") as f:  # exact filename (no .npz suffix)
+            _np.savez(f, **data)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        loaded = _np.load(filename if filename.endswith(".npz")
-                          else filename + ".npz", allow_pickle=False)
+        import os as _os
+        if not _os.path.exists(filename) and \
+                _os.path.exists(filename + ".npz"):
+            filename += ".npz"  # files written by older np.savez path
+        loaded = _np.load(filename, allow_pickle=False)
         keys = {restore_prefix + k: k for k in loaded.files}
         for name, p in self._params.items():
             if name in keys:

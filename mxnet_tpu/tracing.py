@@ -1,0 +1,100 @@
+"""Tracing / graph-dump subsystem (SURVEY §2 aux: jaxpr/HLO dump,
+compile-cache stats).
+
+The reference exposes its graph through ``symbol.json`` exports and env
+switches like ``MXNET_EXEC_*``/graph-pass dumps; the XLA-native
+equivalents are the jaxpr (front-end trace) and StableHLO (compiler
+input). This module records every HybridBlock compilation, serves
+cache-hit statistics (the CachedOp hit-rate analogue), and — when
+``MXNET_TPU_DUMP_HLO=<dir>`` is set — writes each freshly compiled
+graph's StableHLO to that directory as it is built.
+
+API:
+    cache_stats() / reset_cache_stats()
+    lower_text(entry)  — StableHLO of a compiled _CacheEntry
+    jaxpr_text(entry)  — jaxpr of the same
+    dump_dir()         — active MXNET_TPU_DUMP_HLO directory or None
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["cache_stats", "reset_cache_stats", "record_hit",
+           "record_compile", "lower_text", "jaxpr_text", "dump_dir",
+           "maybe_dump"]
+
+_lock = threading.Lock()
+_stats = {"compiles": 0, "hits": 0}
+
+
+def cache_stats() -> dict:
+    """Compile-cache statistics across all HybridBlocks: `compiles` =
+    distinct (shape, dtype, mode) entries built, `hits` = calls served
+    from cache, `hit_rate` in [0, 1]."""
+    with _lock:
+        total = _stats["compiles"] + _stats["hits"]
+        return {**_stats,
+                "hit_rate": (_stats["hits"] / total) if total else 0.0}
+
+
+def reset_cache_stats():
+    with _lock:
+        _stats["compiles"] = 0
+        _stats["hits"] = 0
+
+
+def record_hit():
+    with _lock:
+        _stats["hits"] += 1
+
+
+def record_compile(name: str, entry) -> None:
+    with _lock:
+        _stats["compiles"] += 1
+        n = _stats["compiles"]
+    d = dump_dir()
+    if d:
+        try:
+            text = lower_text(entry)
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, f"{name}-{n:03d}.stablehlo.mlir"),
+                      "w") as f:
+                f.write(text)
+        except Exception as e:  # dumping must never break training
+            import warnings
+            warnings.warn(f"MXNET_TPU_DUMP_HLO failed for {name}: {e}")
+
+
+def dump_dir() -> Optional[str]:
+    return os.environ.get("MXNET_TPU_DUMP_HLO") or None
+
+
+def _abstract_args(entry):
+    if getattr(entry, "_example_avals", None) is None:
+        raise RuntimeError("block has not been called yet — no example "
+                           "shapes recorded to lower with")
+    return entry._example_avals
+
+
+def lower_text(entry) -> str:
+    """StableHLO text for a compiled _CacheEntry (what XLA compiles)."""
+    avals = _abstract_args(entry)
+    return entry.jit_fn.lower(*avals).as_text()
+
+
+def jaxpr_text(entry) -> str:
+    """jaxpr for a compiled _CacheEntry (the functional trace)."""
+    avals = _abstract_args(entry)
+    return str(jax.make_jaxpr(entry.raw_fn)(*avals))
+
+
+def maybe_dump(name: str, text: str, suffix: str = "txt"):
+    d = dump_dir()
+    if d:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"{name}.{suffix}"), "w") as f:
+            f.write(text)

@@ -1,0 +1,59 @@
+"""Tracing subsystem (SURVEY §2 aux): HLO/jaxpr dump, compile-cache
+stats, MXNET_TPU_DUMP_HLO env hook."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import tracing
+
+
+def _net():
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    # fixed in_units: no deferred init, so the first call compiles
+    net.add(mx.gluon.nn.Dense(8, in_units=3, activation="relu"),
+            mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_cache_stats_hit_miss():
+    tracing.reset_cache_stats()
+    net = _net()
+    x = mx.nd.ones((4, 3))
+    net(x)                      # compile
+    net(x)                      # hit
+    net(x)                      # hit
+    net(mx.nd.ones((2, 3)))     # new shape -> compile
+    s = tracing.cache_stats()
+    assert s["compiles"] == 2 and s["hits"] == 2
+    assert 0 < s["hit_rate"] < 1
+
+
+def test_export_writes_stablehlo(tmp_path):
+    net = _net()
+    net(mx.nd.ones((4, 3)))
+    out = net.export(str(tmp_path / "m"), epoch=3)
+    text = open(out).read()
+    assert "stablehlo" in text or "module" in text  # MLIR module text
+    assert os.path.exists(tmp_path / "m-0003.params")
+
+
+def test_jaxpr_text():
+    net = _net()
+    net(mx.nd.ones((4, 3)))
+    entry = next(iter(net._jit_cache.values()))
+    jx = tracing.jaxpr_text(entry)
+    assert "lambda" in jx and "dot_general" in jx
+
+
+def test_dump_hlo_env(tmp_path, monkeypatch):
+    d = str(tmp_path / "hlo")
+    monkeypatch.setenv("MXNET_TPU_DUMP_HLO", d)
+    tracing.reset_cache_stats()
+    net = _net()
+    net(mx.nd.ones((4, 3)))
+    files = os.listdir(d)
+    assert any(f.endswith(".stablehlo.mlir") for f in files)
