@@ -232,12 +232,17 @@ class transforms:
             return x
 
     class ToTensor:
-        """HWC uint8 [0,255] -> CHW float32 [0,1] (reference semantics)."""
+        """HWC uint8 [0,255] -> float32 [0,1]. Default layout "CHW"
+        matches the reference; pass layout="NHWC" (or "HWC") to keep
+        channels-last — the natural layout for TPU convolutions."""
+
+        def __init__(self, layout="CHW"):
+            self._chw = layout.upper().lstrip("N") == "CHW"
 
         def __call__(self, x):
             a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
             a = a.astype(_np.float32) / 255.0
-            return array(_np.moveaxis(a, -1, 0))
+            return array(_np.moveaxis(a, -1, 0) if self._chw else a)
 
     class Normalize:
         def __init__(self, mean=0.0, std=1.0):
