@@ -40,7 +40,10 @@ from . import io
 from . import runtime
 
 # reference-style module aliases
-sym = None  # symbolic API is subsumed by hybridize/jit (SURVEY §1)
+from . import symbol
+from . import symbol as sym          # mx.sym.* (lazy DAG over mx.nd)
+from . import module
+from . import module as mod          # mx.mod.Module
 
 
 def test_utils():

@@ -66,7 +66,14 @@ def create(metric, *args, **kwargs):
         return m
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
-    return _REGISTRY[metric.lower()](*args, **kwargs)
+    # reference short names (mxnet/metric.py create aliases)
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "nll_loss": "negativeloglikelihood",
+               "top_k_accuracy": "topkaccuracy",
+               "top_k_acc": "topkaccuracy",
+               "pearsonr": "pearsoncorrelation"}
+    key = metric.lower()
+    return _REGISTRY[aliases.get(key, key)](*args, **kwargs)
 
 
 def _listify(x):

@@ -1,0 +1,310 @@
+"""mx.mod — the Module API over the symbolic path.
+
+Reference parity: mxnet/module/module.py (BaseModule/Module): the
+classic bind → init_params → init_optimizer → forward/backward/update
+training shell around a Symbol, plus `fit`/`score`/`predict`. Here the
+executor evaluates the symbol DAG through the same jitted nd ops the
+imperative API uses, and the update step reuses mx.optimizer; KVStore
+'local'/'tpu_sync' slots in exactly like the reference's kvstore arg.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from . import initializer as _initmod
+from . import io as _io
+from . import metric as _metric
+from . import optimizer as _optmod
+from .ndarray import NDArray
+from .symbol import Executor, Symbol
+
+__all__ = ["Module", "BaseModule"]
+
+
+def _as_desc_list(shapes):
+    out = []
+    for s in shapes or []:
+        if isinstance(s, _io.DataDesc):
+            out.append(s)
+        elif isinstance(s, tuple) and isinstance(s[0], str):
+            out.append(_io.DataDesc(s[0], tuple(s[1])))
+        else:
+            raise TypeError(f"bad shape spec {s}")
+    return out
+
+
+class BaseModule:
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+
+class Module(BaseModule):
+    """Module(symbol, data_names, label_names) — reference signature."""
+
+    def __init__(self, symbol: Symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None,
+                 context=None):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._logger = logger or logging.getLogger("mxnet_tpu.module")
+        self._exec: Optional[Executor] = None
+        self._optimizer = None
+        self._kvstore = None
+        self._opt_states: Dict[int, object] = {}
+        self._param_names: List[str] = []
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             grad_req="write", **_):
+        data_shapes = _as_desc_list(data_shapes)
+        label_shapes = _as_desc_list(label_shapes)
+        shape_env = {d.name: tuple(d.shape) for d in data_shapes}
+        shape_env.update({d.name: tuple(d.shape) for d in label_shapes})
+        args = self._symbol.list_arguments()
+        self._param_names = [a for a in args
+                             if a not in shape_env]
+        # parameters: infer their shapes by probing with data shapes
+        # only is impossible in general — require explicit shapes via
+        # Variable(shape=...) attr, else infer from common conventions
+        # is fragile; instead run reference behavior: shape inference
+        # needs every arg, so collect parameter shapes from var attrs.
+        missing = {}
+        for node in self._symbol._topo():
+            if node._kind == "var" and node._name in self._param_names \
+                    and "__shape__" in node._attr:
+                missing[node._name] = node._attr["__shape__"]
+        unknown = [a for a in self._param_names if a not in missing]
+        if unknown:
+            raise ValueError(
+                f"cannot infer shapes for parameters {unknown}: give "
+                "them Variable(name, shape=...) or pass their shapes "
+                "in data_shapes")
+        shape_env.update(missing)
+        for a in self._symbol.list_auxiliary_states():
+            if a not in shape_env:
+                node = next(n for n in self._symbol._topo()
+                            if n._kind == "var" and n._name == a)
+                if "__shape__" not in node._attr:
+                    raise ValueError(f"aux state {a} needs shape=")
+                shape_env[a] = node._attr["__shape__"]
+        self._exec = self._symbol.simple_bind(
+            grad_req=grad_req if for_training else "null", **shape_env)
+        self._shape_env = shape_env
+        self._batch_size = data_shapes[0].shape[0]
+        self.binded = True
+        self.for_training = for_training
+        return self
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, **_):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "bind before init_params"
+        if arg_params is None and getattr(self, "_preloaded", None):
+            # Module.load stashed checkpointed params — consume them
+            arg_params, aux_params = self._preloaded
+        if arg_params is not None and not allow_missing:
+            lost = [n for n in self._param_names if n not in arg_params]
+            if lost:
+                raise RuntimeError(
+                    f"set_params: missing parameters {lost} "
+                    "(pass allow_missing=True to re-initialize them)")
+        init = _initmod.create(initializer)
+        for name in self._param_names:
+            if arg_params and name in arg_params:
+                self._exec.arg_dict[name] = arg_params[name]
+                continue
+            shape = self._shape_env[name]
+            arr = NDArray(jnp.zeros(shape, jnp.float32))
+            init(_initmod.InitDesc(name), arr)
+            self._exec.arg_dict[name] = arr
+        for name in self._symbol.list_auxiliary_states():
+            if aux_params and name in aux_params:
+                self._exec.aux_dict[name] = aux_params[name]
+                continue
+            shape = self._shape_env[name]
+            fill = jnp.ones if name.endswith(("moving_var",
+                                              "running_var")) \
+                else jnp.zeros
+            self._exec.aux_dict[name] = NDArray(fill(shape, jnp.float32))
+        self.params_initialized = True
+        return self
+
+    def get_params(self) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+        return ({n: self._exec.arg_dict[n] for n in self._param_names},
+                dict(self._exec.aux_dict))
+
+    def set_params(self, arg_params, aux_params=None, **kw):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         force_init=True, **kw)
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            # reference Module.init_optimizer defaults rescale_grad to
+            # 1/batch_size (grads come summed over the batch)
+            params.setdefault("rescale_grad",
+                              1.0 / getattr(self, "_batch_size", 1))
+            optimizer = _optmod.create(optimizer, **params)
+        self._optimizer = optimizer
+        if isinstance(kvstore, str) and kvstore:
+            from . import kvstore as _kv
+            self._kvstore = _kv.create(kvstore)
+            for i, n in enumerate(self._param_names):
+                self._kvstore.init(i, self._exec.arg_dict[n])
+        self._opt_states = {
+            i: self._optimizer.create_state(
+                i, self._exec.arg_dict[n])
+            for i, n in enumerate(self._param_names)}
+        for i, n in enumerate(self._param_names):
+            self._optimizer.idx2name[i] = n
+        self.optimizer_initialized = True
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, data_batch: "_io.DataBatch", is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            labels = data_batch.label if isinstance(
+                data_batch.label, (list, tuple)) else [data_batch.label]
+            for name, arr in zip(self._label_names, labels):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        for i, n in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            if self._kvstore is not None:
+                # sync store: allreduce grads across workers, then the
+                # local optimizer applies them (reference dist_sync path)
+                self._kvstore.pushpull(i, g, out=g)
+            self._opt_states[i] = self._optimizer.update(
+                i, self._exec.arg_dict[n], g, self._opt_states[i])
+
+    def get_outputs(self) -> List[NDArray]:
+        return self._exec.outputs
+
+    def update_metric(self, eval_metric, labels):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        for l, o in zip(labels, self._exec.outputs):
+            eval_metric.update(l, o)
+
+    # -- high-level loops ---------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, kvstore="local",
+            batch_end_callback=None, epoch_end_callback=None,
+            arg_params=None, aux_params=None, **_):
+        if not self.binded:
+            self.bind([(d.name, d.shape)
+                       for d in train_data.provide_data],
+                      [(d.name, d.shape)
+                       for d in train_data.provide_label])
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    batch_end_callback(epoch, nbatch, eval_metric)
+            name, value = eval_metric.get()
+            self._logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                              value)
+            if eval_data is not None:
+                res = self.score(eval_data, eval_metric)
+                self._logger.info("Epoch[%d] Validation: %s", epoch, res)
+            if epoch_end_callback:
+                arg_p, aux_p = self.get_params()
+                epoch_end_callback(epoch, self._symbol, arg_p, aux_p)
+        return self
+
+    def score(self, eval_data, eval_metric, num_batch=None):
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get()
+
+    def predict(self, eval_data, num_batch=None) -> NDArray:
+        outs = []
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs.append(self._exec.outputs[0].asnumpy())
+        from .ndarray import array
+        return array(_np.concatenate(outs, axis=0))
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch):
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg_p, aux_p = self.get_params()
+        blob = {f"arg:{k}": _np.asarray(v.asnumpy())
+                for k, v in arg_p.items()}
+        blob.update({f"aux:{k}": _np.asarray(v.asnumpy())
+                     for k, v in aux_p.items()})
+        with open(f"{prefix}-{epoch:04d}.params", "wb") as f:
+            _np.savez(f, **blob)
+
+    @staticmethod
+    def load_params_file(fname):
+        loaded = _np.load(fname, allow_pickle=False)
+        arg_p, aux_p = {}, {}
+        from .ndarray import array
+        for k in loaded.files:
+            kind, name = k.split(":", 1)
+            (arg_p if kind == "arg" else aux_p)[name] = array(loaded[k])
+        return arg_p, aux_p
+
+    @classmethod
+    def load(cls, prefix, epoch, **kwargs):
+        from .symbol import load_json
+        sym = load_json(f"{prefix}-symbol.json")
+        mod = cls(sym, **kwargs)
+        arg_p, aux_p = cls.load_params_file(
+            f"{prefix}-{epoch:04d}.params")
+        mod._preloaded = (arg_p, aux_p)
+        return mod, arg_p, aux_p

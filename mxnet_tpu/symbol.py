@@ -1,0 +1,464 @@
+"""mx.sym — the symbolic API, rebuilt as a lazy expression DAG over the
+`mx.nd` operator namespace.
+
+Reference parity: mxnet/symbol/symbol.py + the NNVM graph. There the
+symbolic path is a separate C++ graph IR bound/compiled by the executor;
+here a Symbol is a lightweight Python DAG whose nodes name `mx.nd` ops.
+Evaluation traces the DAG into the exact same jax functions the
+imperative API uses, so `bind` + `forward` runs through one `jax.jit`
+per shape signature — the executor IS the XLA executable (the NNVM
+graph-compile step is subsumed by jit; SURVEY §1 layer map).
+
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight")
+    b = mx.sym.Variable("fc_bias")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, w, b, num_hidden=10),
+        mx.sym.Variable("softmax_label"), name="softmax")
+    ex = out.simple_bind(data=(32, 784), softmax_label=(32,))
+    ex.forward(is_train=True, data=batch)
+    ex.backward()
+
+Every `mx.nd` operator has a symbolic twin (`mx.sym.<op>`), generated on
+first attribute access.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from . import nd as _nd
+from .ndarray import NDArray
+
+__all__ = ["Symbol", "Variable", "var", "Group", "Executor", "load_json"]
+
+_AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean",
+                 "running_var")
+
+
+class Symbol:
+    """A node in the lazy op DAG: a free variable, an op application, an
+    output-selection, or a group (multi-output)."""
+
+    def __init__(self, kind, name=None, fn_name=None, inputs=(),
+                 kwargs=None, index=None, attr=None):
+        self._kind = kind          # 'var' | 'op' | 'item' | 'group'
+        self._name = name
+        self._fn_name = fn_name
+        self._inputs = list(inputs)
+        self._kwargs = dict(kwargs or {})
+        self._index = index
+        self._attr = dict(attr or {})
+
+    # -- construction helpers ------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attr.get(key)
+
+    def list_attr(self):
+        return dict(self._attr)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            for j, out in enumerate(self.list_outputs()):
+                if out == i:
+                    return Symbol("item", name=i, inputs=[self], index=j)
+            raise ValueError(f"no output named {i}")
+        return Symbol("item", name=f"{self._name}[{i}]", inputs=[self],
+                      index=i)
+
+    def __iter__(self):
+        return iter([self[i] for i in range(len(self.list_outputs()))])
+
+    # -- graph queries -------------------------------------------------------
+    def _walk(self, seen, order):
+        if id(self) in seen:
+            return
+        seen.add(id(self))
+        for a in self._inputs:
+            if isinstance(a, Symbol):
+                a._walk(seen, order)
+        order.append(self)
+
+    def _topo(self) -> List["Symbol"]:
+        seen, order = set(), []
+        self._walk(seen, order)
+        return order
+
+    def _all_vars(self) -> List[str]:
+        names, out = set(), []
+        for n in self._topo():
+            if n._kind == "var" and n._name not in names:
+                names.add(n._name)
+                out.append(n._name)
+        return out
+
+    def list_arguments(self) -> List[str]:
+        """Free variables, aux states excluded (reference semantics)."""
+        return [n for n in self._all_vars()
+                if not n.endswith(_AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n for n in self._all_vars() if n.endswith(_AUX_SUFFIXES)]
+
+    def list_outputs(self) -> List[str]:
+        if self._kind == "group":
+            return [o for s in self._inputs for o in s.list_outputs()]
+        n = self._name or "out"
+        nout = self._n_outputs()
+        if nout == 1:
+            return [f"{n}_output"]
+        return [f"{n}_output{i}" for i in range(nout)]
+
+    def _n_outputs(self) -> int:
+        if self._kind == "group":
+            return sum(s._n_outputs() for s in self._inputs)
+        if self._kind == "op":
+            if not hasattr(self, "_nout_cache"):
+                out = self._shape_eval_outputs()
+                self._nout_cache = len(out) if isinstance(out, tuple) \
+                    else 1
+            return self._nout_cache
+        return 1
+
+    def get_internals(self):
+        return Group([n for n in self._topo() if n._kind in ("op", "var")])
+
+    # -- evaluation ----------------------------------------------------------
+    def _eval(self, env: Dict[str, NDArray], memo: Dict[int, object]):
+        if id(self) in memo:
+            return memo[id(self)]
+        if self._kind == "var":
+            if self._name not in env:
+                raise ValueError(f"unbound variable {self._name}")
+            r = env[self._name]
+        elif self._kind == "item":
+            base = self._inputs[0]._eval(env, memo)
+            r = base[self._index] if isinstance(base, tuple) else base
+        elif self._kind == "group":
+            r = tuple(s._eval(env, memo) for s in self._inputs)
+        else:  # op
+            fn = getattr(_nd, self._fn_name)
+            args = [a._eval(env, memo) if isinstance(a, Symbol) else a
+                    for a in self._inputs]
+            r = fn(*args, **self._kwargs)
+            if isinstance(r, list):  # multi-output ops (split, ...)
+                r = tuple(r)
+        memo[id(self)] = r
+        return r
+
+    def eval(self, ctx=None, **bindings) -> List[NDArray]:
+        """Evaluate eagerly with NDArray bindings (reference:
+        Symbol.eval)."""
+        out = self._eval(dict(bindings), {})
+        flat = out if isinstance(out, tuple) else (out,)
+        return [o if isinstance(o, NDArray) else NDArray(jnp.asarray(o))
+                for o in flat]
+
+    def _shape_eval_outputs(self):
+        """Count this op's outputs by abstract evaluation
+        (jax.eval_shape — nothing runs on device) of the whole
+        subtree, using Variable(shape=...) attrs when present and
+        (4, 4) float32 placeholders otherwise. Best effort: ops whose
+        placeholder shapes don't typecheck report one output (give
+        their Variables explicit shapes to make this exact)."""
+        names = self._all_vars()
+        shape_of = {}
+        for n in self._topo():
+            if n._kind == "var":
+                shape_of[n._name] = n._attr.get("__shape__", (4, 4))
+
+        def f(*arrs):
+            env = {nm: NDArray(a) for nm, a in zip(names, arrs)}
+            out = self._eval(env, {})
+            flat = out if isinstance(out, tuple) else (out,)
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in flat)
+        try:
+            with autograd.pause():
+                outs = jax.eval_shape(f, *[
+                    jax.ShapeDtypeStruct(tuple(shape_of[n]), jnp.float32)
+                    for n in names])
+            return outs
+        except Exception:
+            return (None,)
+
+    # -- shape inference -----------------------------------------------------
+    def infer_shape(self, **shapes) -> Tuple[List[Tuple], List[Tuple],
+                                             List[Tuple]]:
+        """(arg_shapes, out_shapes, aux_shapes) given input shapes
+        (reference: symbolic shape inference; here via jax.eval_shape —
+        abstract evaluation, nothing runs on device)."""
+        args = self.list_arguments()
+        aux = self.list_auxiliary_states()
+        missing = [a for a in args + aux if a not in shapes]
+        if missing:
+            raise ValueError(f"infer_shape needs shapes for {missing} "
+                             "(partial inference: pass every variable)")
+        names = args + aux
+
+        def f(*arrs):
+            env = {n: NDArray(a) for n, a in zip(names, arrs)}
+            with autograd.pause():
+                out = self._eval(env, {})
+            flat = out if isinstance(out, tuple) else (out,)
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in flat)
+
+        specs = [jax.ShapeDtypeStruct(tuple(shapes[n]), jnp.float32)
+                 for n in names]
+        outs = jax.eval_shape(f, *specs)
+        return ([tuple(shapes[a]) for a in args],
+                [tuple(o.shape) for o in outs],
+                [tuple(shapes[a]) for a in aux])
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None) -> "Executor":
+        return Executor(self, args or {}, grad_req=grad_req,
+                        aux_states=aux_states or {})
+
+    def simple_bind(self, ctx=None, grad_req="write",
+                    **shapes) -> "Executor":
+        """Allocate zeroed argument arrays from inferred shapes and
+        bind."""
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = {n: NDArray(jnp.zeros(s, jnp.float32))
+                for n, s in zip(self.list_arguments(), arg_shapes)}
+        aux = {n: NDArray(jnp.zeros(s, jnp.float32))
+               for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
+        return Executor(self, args, grad_req=grad_req, aux_states=aux)
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self) -> str:
+        order = self._topo()
+        idx = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "kind": n._kind, "name": n._name, "op": n._fn_name,
+                "index": n._index, "attr": n._attr,
+                "kwargs": {k: list(v) if isinstance(v, tuple) else v
+                           for k, v in n._kwargs.items()},
+                "inputs": [idx[id(a)] if isinstance(a, Symbol) else
+                           ["#lit", a] for a in n._inputs],
+            })
+        return json.dumps({"nodes": nodes, "head": idx[id(self)],
+                           "format": "mxnet_tpu-symbol-v1"})
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators -----------------------------------------------------------
+    def _binop(self, other, op, scalar_op, rev=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rev else (self, other)
+            return _apply(op, [a, b])
+        a, b = (other, self) if rev else (self, other)
+        return _apply(scalar_op, [a, b])
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "add")
+
+    def __radd__(self, o):
+        return self._binop(o, "broadcast_add", "add", rev=True)
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", "subtract", rev=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "multiply")
+
+    def __rmul__(self, o):
+        return self._binop(o, "broadcast_mul", "multiply", rev=True)
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", "divide", rev=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "power")
+
+    def __neg__(self):
+        return _apply("negative", [self])
+
+    # method-style ops (subset mirroring NDArray methods)
+    def reshape(self, shape):
+        return _apply("reshape", [self], {"shape": shape})
+
+    def transpose(self, axes=None):
+        return _apply("transpose", [self], {"axes": axes})
+
+    def sum(self, axis=None, keepdims=False):
+        return _apply("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _apply("mean", [self],
+                      {"axis": axis, "keepdims": keepdims})
+
+    def __repr__(self):
+        return f"<Symbol {self._name or self._fn_name}>"
+
+
+def _apply(fn_name, inputs, kwargs=None, name=None):
+    if not hasattr(_nd, fn_name):
+        raise AttributeError(f"mx.sym.{fn_name}: no such operator in "
+                             "mx.nd")
+    name = name or f"{fn_name.lower()}{_NameCounter.next(fn_name)}"
+    return Symbol("op", name=name, fn_name=fn_name, inputs=inputs,
+                  kwargs=kwargs or {})
+
+
+class _NameCounter:
+    _c: Dict[str, int] = {}
+
+    @classmethod
+    def next(cls, key):
+        cls._c[key] = cls._c.get(key, 0) + 1
+        return cls._c[key] - 1
+
+
+def Variable(name, shape=None, init=None, dtype=None, **attr):
+    if not isinstance(name, str):
+        raise TypeError("Variable name must be a string")
+    a = dict(attr)
+    if shape is not None:
+        a["__shape__"] = tuple(shape)
+    if dtype is not None:
+        a["__dtype__"] = str(dtype)
+    return Symbol("var", name=name, attr=a)
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    return Symbol("group", name="group", inputs=list(symbols))
+
+
+def load_json(text_or_file: str) -> Symbol:
+    """Rebuild a Symbol DAG from tojson() output."""
+    try:
+        blob = json.loads(text_or_file)
+    except json.JSONDecodeError:
+        with open(text_or_file) as f:
+            blob = json.load(f)
+    nodes: List[Symbol] = []
+    for spec in blob["nodes"]:
+        inputs = []
+        for ref in spec["inputs"]:
+            if isinstance(ref, list) and ref and ref[0] == "#lit":
+                inputs.append(ref[1])
+            else:
+                inputs.append(nodes[ref])
+        kwargs = {k: tuple(v) if isinstance(v, list) else v
+                  for k, v in spec["kwargs"].items()}
+        nodes.append(Symbol(spec["kind"], name=spec["name"],
+                            fn_name=spec["op"], inputs=inputs,
+                            kwargs=kwargs, index=spec["index"],
+                            attr=spec["attr"]))
+    return nodes[blob["head"]]
+
+
+load = load_json
+
+
+class Executor:
+    """Bound symbol: argument arrays + compiled-on-demand forward.
+
+    Reference: the graph executor (simple_bind → GraphExecutor). Here
+    `forward(is_train=True)` runs the DAG eagerly under the autograd
+    tape (each nd op is jitted; XLA still fuses within ops), and
+    `backward()` pulls gradients into `grad_dict` — the tape is the
+    backward graph pass."""
+
+    def __init__(self, sym: Symbol, args: Dict[str, NDArray],
+                 grad_req="write", aux_states=None):
+        self._sym = sym
+        self.arg_dict = dict(args)
+        self.aux_dict = dict(aux_states or {})
+        self.grad_req = grad_req
+        self.grad_dict: Dict[str, Optional[NDArray]] = {
+            n: None for n in self.arg_dict}
+        self.outputs: List[NDArray] = []
+        self._recorded = None
+
+    def forward(self, is_train=False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            arr = v if isinstance(v, NDArray) else NDArray(
+                jnp.asarray(v))
+            (self.aux_dict if k in self.aux_dict
+             else self.arg_dict)[k] = arr
+        env = {**self.arg_dict, **self.aux_dict}
+        if is_train and self.grad_req != "null":
+            for n, a in self.arg_dict.items():
+                # don't re-attach (it zeroes the buffer): grad_req='add'
+                # must accumulate across forward/backward pairs
+                if a._grad is None or a._grad_req != self.grad_req:
+                    a.attach_grad(self.grad_req)
+            with autograd.record():
+                out = self._sym._eval(env, {})
+        else:
+            with autograd.pause():
+                out = self._sym._eval(env, {})
+        flat = out if isinstance(out, tuple) else (out,)
+        self.outputs = [o if isinstance(o, NDArray)
+                        else NDArray(jnp.asarray(o)) for o in flat]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        heads = [o for o in self.outputs if o._node is not None] \
+            if out_grads is None else self.outputs
+        if not heads:
+            return
+        autograd.backward(heads, head_grads=out_grads)
+        for n, a in self.arg_dict.items():
+            self.grad_dict[n] = a.grad
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict[n] for n in self._sym.list_arguments()]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._sym.list_arguments()]
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k] = v
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k] = v
+
+
+def __getattr__(name):
+    """mx.sym.<op>: symbolic twin of any mx.nd operator."""
+    if name.startswith("_"):
+        raise AttributeError(name)
+    target = getattr(_nd, name, None)
+    if target is None or not callable(target):
+        raise AttributeError(f"mx.sym.{name}")
+
+    def sym_op(*args, name=None, **kwargs):
+        return _apply(_fn_name, list(args), kwargs, name=name)
+
+    _fn_name = name
+    sym_op.__name__ = name
+    sym_op.__doc__ = f"Symbolic twin of mx.nd.{name}"
+    return sym_op

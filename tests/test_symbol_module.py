@@ -1,0 +1,176 @@
+"""mx.sym symbolic API + mx.mod.Module (reference: symbol.py /
+module/module.py — classic pre-Gluon workflow on the TPU-native DAG)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    w1 = sym.Variable("fc1_weight", shape=(32, 16))
+    b1 = sym.Variable("fc1_bias", shape=(32,))
+    w2 = sym.Variable("fc2_weight", shape=(4, 32))
+    b2 = sym.Variable("fc2_bias", shape=(4,))
+    h = sym.Activation(sym.FullyConnected(data, w1, b1, num_hidden=32),
+                       act_type="relu")
+    return sym.SoftmaxOutput(
+        sym.FullyConnected(h, w2, b2, num_hidden=4), label,
+        name="softmax")
+
+
+def test_symbol_arguments_outputs():
+    out = _mlp_symbol()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias",
+                                    "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp_symbol()
+    arg_s, out_s, _ = out.infer_shape(
+        data=(8, 16), fc1_weight=(32, 16), fc1_bias=(32,),
+        fc2_weight=(4, 32), fc2_bias=(4,), softmax_label=(8,))
+    assert out_s == [(8, 4)]
+
+
+def test_executor_forward_backward_softmaxoutput_grad():
+    out = _mlp_symbol()
+    ex = out.simple_bind(data=(8, 16), fc1_weight=(32, 16),
+                         fc1_bias=(32,), fc2_weight=(4, 32),
+                         fc2_bias=(4,), softmax_label=(8,))
+    rs = np.random.RandomState(0)
+    for k in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[k] = mx.nd.array(
+            rs.randn(*ex.arg_dict[k].shape).astype(np.float32) * 0.1)
+    X = mx.nd.array(rs.rand(8, 16).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, 8).astype(np.float32))
+    (p,) = ex.forward(is_train=True, data=X, softmax_label=Y)
+    np.testing.assert_allclose(p.asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)
+    ex.backward()
+    # d(loss)/d(logits) = p - onehot  =>  d/d(data) = that @ W2 @ relu'...
+    assert ex.grad_dict["fc1_weight"] is not None
+    assert float(np.abs(ex.grad_dict["data"].asnumpy()).sum()) > 0
+
+
+def test_symbol_operators_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    z = (a * 2.0 + b).sum()
+    (r,) = z.eval(a=mx.nd.ones((2, 2)), b=mx.nd.ones((2, 2)))
+    assert float(r.asscalar()) == 12.0
+
+
+def test_symbol_json_roundtrip():
+    out = _mlp_symbol()
+    out2 = sym.load_json(out.tojson())
+    assert out2.list_arguments() == out.list_arguments()
+    rs = np.random.RandomState(1)
+    binds = {"data": mx.nd.array(rs.rand(4, 16).astype(np.float32)),
+             "softmax_label": mx.nd.zeros((4,))}
+    for n, s in (("fc1_weight", (32, 16)), ("fc1_bias", (32,)),
+                 ("fc2_weight", (4, 32)), ("fc2_bias", (4,))):
+        binds[n] = mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+    (r1,) = out.eval(**binds)
+    (r2,) = out2.eval(**binds)
+    np.testing.assert_allclose(r1.asnumpy(), r2.asnumpy(), rtol=1e-6)
+
+
+def test_group_multi_output():
+    a = sym.Variable("a")
+    g = sym.Group([a * 2.0, a + 1.0])
+    r = g.eval(a=mx.nd.ones((2,)))
+    assert len(r) == 2
+    np.testing.assert_allclose(r[0].asnumpy(), [2.0, 2.0])
+    np.testing.assert_allclose(r[1].asnumpy(), [2.0, 2.0])
+
+
+def test_multi_output_through_op_chain():
+    x = sym.Variable("x", shape=(4, 6))
+    s = sym.split(sym.relu(x), num_outputs=2, axis=1)
+    assert len(s.list_outputs()) == 2
+    a, b = list(s)
+    ra = a.eval(x=mx.nd.ones((4, 6)))[0]
+    assert ra.shape == (4, 3)
+    rb = b.eval(x=mx.nd.ones((4, 6)))[0]
+    assert rb.shape == (4, 3)
+
+
+def test_grad_req_add_accumulates():
+    x = sym.Variable("x")
+    z = (x * x).sum()
+    ex = z.bind(args={"x": mx.nd.array([2.0, 3.0])}, grad_req="add")
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               [8.0, 12.0])  # 2 passes of 2x
+
+
+def test_set_params_missing_raises(tmp_path):
+    out = _mlp_symbol()
+    mod = mx.mod.Module(out)
+    mod.bind([("data", (4, 16))], [("softmax_label", (4,))])
+    mod.init_params()
+    import pytest
+    with pytest.raises(RuntimeError, match="missing parameters"):
+        mod.set_params({"fc1_weight": mx.nd.zeros((32, 16))})
+    # allow_missing re-initializes the rest without raising
+    mod.set_params({"fc1_weight": mx.nd.zeros((32, 16))},
+                   allow_missing=True)
+
+
+def _fit_problem():
+    rs = np.random.RandomState(0)
+    X = rs.rand(256, 16).astype(np.float32)
+    W = rs.randn(16, 4)
+    Y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X, Y
+
+
+def test_module_fit_score_predict(tmp_path):
+    X, Y = _fit_problem()
+    out = _mlp_symbol()
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, eval_metric="acc", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), num_epoch=10)
+    name, acc = mod.score(it, "acc")
+    assert acc > 0.85, acc
+
+    pred = mod.predict(it)
+    assert pred.shape == (256, 4)
+
+    prefix = str(tmp_path / "mod")
+    mod.save_checkpoint(prefix, 10)
+    mod2, arg_p, aux_p = mx.mod.Module.load(
+        prefix, 10, data_names=("data",),
+        label_names=("softmax_label",))
+    mod2.bind([("data", (32, 16))], [("softmax_label", (32,))],
+              for_training=False)
+    mod2.init_params()  # consumes the checkpointed params from load()
+    _, acc2 = mod2.score(it, "acc")
+    assert abs(acc2 - acc) < 1e-6
+
+
+def test_module_batchnorm_aux_states():
+    data = sym.Variable("data")
+    gamma = sym.Variable("bn_gamma", shape=(16,))
+    beta = sym.Variable("bn_beta", shape=(16,))
+    mmean = sym.Variable("bn_moving_mean", shape=(16,))
+    mvar = sym.Variable("bn_moving_var", shape=(16,))
+    out = sym.BatchNorm(data, gamma, beta, mmean, mvar)
+    assert out.list_auxiliary_states() == ["bn_moving_mean",
+                                           "bn_moving_var"]
+    assert "bn_moving_mean" not in out.list_arguments()
+    ex = out.simple_bind(data=(4, 16), bn_gamma=(16,), bn_beta=(16,),
+                         bn_moving_mean=(16,), bn_moving_var=(16,))
+    (r,) = ex.forward(data=mx.nd.random.normal(shape=(4, 16)))
+    assert r.shape == (4, 16)
