@@ -21,7 +21,8 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "L2Normalization", "Dropout", "Activation", "LeakyReLU",
            "softmax", "log_softmax", "softmin", "SoftmaxOutput",
            "softmax_cross_entropy", "gelu", "silu", "swish", "selu", "elu",
-           "prelu", "relu6", "log_sigmoid", "mish"]
+           "prelu", "relu6", "log_sigmoid", "mish", "RNN",
+           "rnn_param_size"]
 
 
 # -- dense ------------------------------------------------------------------
@@ -442,3 +443,105 @@ def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
 
     _so.defvjp(_fwd, _bwd)
     return invoke(_so, [data, label])
+
+
+# -- fused RNN (reference: src/operator/rnn.cc, the cuDNN-style fused op) ----
+
+_RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    """Length of the flat `parameters` vector RNN expects (reference:
+    rnn_param_size in rnn-inl.h). Packing: all weights first — per
+    layer, per direction: W_i2h (G*H, in), W_h2h (G*H, H) — then all
+    biases in the same order: b_i2h (G*H), b_h2h (G*H)."""
+    g = _RNN_GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    total = 0
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else h * d
+        total += d * (g * h * inp + g * h * h)  # weights
+    total += num_layers * d * 2 * g * h          # biases
+    return total
+
+
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, **kw):
+    """Fused multi-layer RNN over a flat packed parameter vector
+    (reference: the sym.RNN / cuDNN fused operator). data is TNC;
+    state (and state_cell for LSTM) is (L*D, N, H). TPU-first: one
+    `lax.scan` per layer/direction — XLA unrolls the gate matmuls onto
+    the MXU; the flat parameter vector keeps optimizer updates to a
+    single fused kernel like the reference's single-blob design."""
+    if state_size is None or num_layers is None:
+        raise ValueError("state_size and num_layers are required")
+    g = _RNN_GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    layers = num_layers
+    nstate = 2 if mode == "lstm" else 1
+    from ..gluon.rnn import _MODES  # late import (gluon imports nd)
+    step_fn, _, _, act = _MODES[mode]
+    training = autograd.is_training()
+    drop_key = _random.next_key() if (p and training and layers > 1) \
+        else None
+
+    def fused(x, flat, *states):
+        T, N, input_size = x.shape
+        # unpack the parameter blob
+        off = 0
+        wih, whh = {}, {}
+        for l in range(layers):
+            inp = input_size if l == 0 else h * d
+            for dd in range(d):
+                wih[(l, dd)] = lax.dynamic_slice_in_dim(
+                    flat, off, g * h * inp).reshape(g * h, inp)
+                off += g * h * inp
+                whh[(l, dd)] = lax.dynamic_slice_in_dim(
+                    flat, off, g * h * h).reshape(g * h, h)
+                off += g * h * h
+        bih, bhh = {}, {}
+        for l in range(layers):
+            for dd in range(d):
+                bih[(l, dd)] = lax.dynamic_slice_in_dim(flat, off, g * h)
+                off += g * h
+                bhh[(l, dd)] = lax.dynamic_slice_in_dim(flat, off, g * h)
+                off += g * h
+
+        out = x
+        finals = [[] for _ in range(nstate)]
+        for l in range(layers):
+            outs_dir = []
+            for dd in range(d):
+                s0 = tuple(states[j][l * d + dd] for j in range(nstate))
+                xs = out if dd == 0 else jnp.flip(out, axis=0)
+                w_i, w_h = wih[(l, dd)], whh[(l, dd)]
+                b_i, b_h = bih[(l, dd)], bhh[(l, dd)]
+
+                def sc(carry, xt):
+                    _, new = step_fn(xt, carry, w_i, w_h, b_i, b_h, act)
+                    return new, new[0]
+
+                fin, ys = lax.scan(sc, s0, xs)
+                if dd == 1:
+                    ys = jnp.flip(ys, axis=0)
+                outs_dir.append(ys)
+                for j in range(nstate):
+                    finals[j].append(fin[j])
+            out = outs_dir[0] if d == 1 else \
+                jnp.concatenate(outs_dir, axis=-1)
+            if p and training and l < layers - 1 and drop_key is not None:
+                k = jax.random.fold_in(drop_key, l)
+                keep = jax.random.bernoulli(k, 1 - p, out.shape)
+                out = jnp.where(keep, out / (1 - p), 0.0)
+        packed = [jnp.stack(s) for s in finals]
+        return tuple([out] + packed)
+
+    states = [state] if state_cell is None else [state, state_cell]
+    res = invoke(fused, [data, parameters] + states, n_out=1 + nstate)
+    if state_outputs:
+        return list(res)
+    return res[0]
