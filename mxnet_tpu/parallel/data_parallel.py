@@ -16,6 +16,7 @@ import numpy as _np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
@@ -230,23 +231,52 @@ class FusedTrainStep:
         n_in = self.n_model_inputs
         treedef_box = entry
 
+        accum = self.grad_accum
+
         def step(tr, aux, states, hyper, key, *batch):
-            def loss_of(tr_):
-                flat, new_aux = entry.raw_fn(tr_, aux, key, *[
-                    b for b in batch[:n_in]])
+            def loss_of(tr_, aux_, key_, batch_):
+                flat, new_aux = entry.raw_fn(tr_, aux_, key_,
+                                             *batch_[:n_in])
                 outs = jax.tree_util.tree_unflatten(
                     treedef_box.out_treedef,
                     [NDArray(f) for f in flat])
                 with autograd._mode(False, True), _random.trace_key(
-                        jax.random.fold_in(key, 7)):
-                    labels = [NDArray(b) for b in batch[n_in:]]
+                        jax.random.fold_in(key_, 7)):
+                    labels = [NDArray(b) for b in batch_[n_in:]]
                     l = loss_fn(outs, *labels) if not isinstance(
                         outs, tuple) else loss_fn(*outs, *labels)
                     l = l.mean()
                 return l._data.astype(jnp.float32), new_aux
 
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(tr)
+            if accum <= 1:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(tr, aux, key, batch)
+            else:
+                # microbatch scan: split the batch dim by `accum`,
+                # accumulate grads in fp32, one optimizer update at the
+                # end — the remat-friendly way to grow effective batch
+                # without growing activation memory
+                micro = tuple(
+                    b.reshape(accum, b.shape[0] // accum, *b.shape[1:])
+                    for b in batch)
+                keys = jax.random.split(key, accum)
+
+                def body(carry, xs):
+                    aux_c, gacc, lacc = carry
+                    key_i, mb = xs
+                    (l, new_aux_c), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(tr, aux_c, key_i, mb)
+                    gacc = jax.tree_util.tree_map(
+                        lambda a, b_: a + b_.astype(a.dtype), gacc, g)
+                    return (new_aux_c, gacc, lacc + l), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda w: jnp.zeros(w.shape, jnp.float32), tr)
+                (new_aux, gsum, lsum), _ = lax.scan(
+                    body, (aux, g0, jnp.float32(0.0)), (keys, micro))
+                grads = jax.tree_util.tree_map(lambda g_: g_ / accum,
+                                               gsum)
+                loss = lsum / accum
             new_tr, new_states = {}, {}
             for n in tr_names:
                 new_tr[n], new_states[n] = opt._step(

@@ -174,3 +174,42 @@ def test_graft_entry_dryrun():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
+
+
+def test_fused_step_grad_accum_matches_full_batch():
+    """grad_accum=A over batch B must match one step over the full
+    batch (same update when BN-free and loss is a mean)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    X = mx.nd.array(rs.rand(16, 10).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, 16), dtype="int32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make():
+        mx.random.seed(0)
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(8, in_units=10, activation="relu"),
+                mx.gluon.nn.Dense(4, in_units=8))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    net_a = make()
+    step_a = FusedTrainStep(net_a, loss_fn,
+                            mx.optimizer.SGD(learning_rate=0.1))
+    la = [float(step_a(X, Y).asscalar()) for _ in range(3)]
+
+    net_b = make()
+    step_b = FusedTrainStep(net_b, loss_fn,
+                            mx.optimizer.SGD(learning_rate=0.1),
+                            grad_accum=4)
+    lb = [float(step_b(X, Y).asscalar()) for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+    step_a.sync_to_params(); step_b.sync_to_params()
+    for (n, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
