@@ -46,6 +46,4 @@ from . import module
 from . import module as mod          # mx.mod.Module
 
 
-def test_utils():
-    from . import test_utils as t
-    return t
+from . import test_utils

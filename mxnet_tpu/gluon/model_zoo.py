@@ -3,16 +3,29 @@ from mxnet_tpu.models."""
 from __future__ import annotations
 
 
-class vision:
-    """Factory namespace; resolves lazily to models/*."""
+class _Vision:
+    """Factory namespace; `vision.resnet18_v1(...)` etc. resolve lazily
+    to the registered model factories (reference:
+    gluon.model_zoo.vision module functions)."""
 
     @staticmethod
     def get_model(name, **kwargs):
         from .. import models
         return models.get_model(name, **kwargs)
 
-    def __class_getattr__(cls, name):  # pragma: no cover
-        raise AttributeError(name)
+    def __getattr__(self, name):
+        from .. import models
+        factories = models._ensure_registry()
+        if name in factories:
+            return factories[name]
+        raise AttributeError(f"model_zoo.vision.{name}")
+
+    def __dir__(self):
+        from .. import models
+        return sorted(models._ensure_registry())
+
+
+vision = _Vision()
 
 
 def __getattr__(name):

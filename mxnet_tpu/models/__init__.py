@@ -12,12 +12,22 @@ def register_model(name):
     return deco
 
 
-def get_model(name, **kwargs):
-    name = name.lower()
-    # populate registry lazily
+def _ensure_registry():
     from . import (lenet, mlp, resnet, mobilenet, vgg, alexnet,  # noqa: F401
                    squeezenet, densenet, bert, transformer, llama, fm,
                    word_embedding)
+    return _FACTORIES
+
+
+def list_models():
+    """Names accepted by get_model (reference: model_zoo get_model
+    listing)."""
+    return sorted(_ensure_registry())
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    _ensure_registry()
     if name not in _FACTORIES:
         raise ValueError(f"unknown model {name}; have "
                          f"{sorted(_FACTORIES)}")

@@ -499,3 +499,11 @@ class SGLD(Optimizer):
 
 
 Test = SGD  # reference keeps a test optimizer alias
+
+
+@register
+class DCASGD(SGD):
+    """Delay-compensated ASGD name (reference: dcasgd.py). On TPU the
+    fused synchronous step has no gradient staleness to compensate, so
+    this is SGD under the reference's name (SURVEY §2 'DCASGD-free
+    alias')."""

@@ -1,0 +1,124 @@
+"""mx.test_utils (reference: mxnet/test_utils.py) — the helpers
+reference test suites import: tolerance asserts, random tensors, and
+finite-difference gradient checking against the autograd tape."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from . import autograd
+from . import context as _context
+from .ndarray import NDArray, array
+
+__all__ = ["default_context", "set_default_context", "list_gpus",
+           "assert_almost_equal", "almost_equal", "same",
+           "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
+           "rand_shape_nd", "check_numeric_gradient", "numeric_grad"]
+
+
+def default_context():
+    return _context.current_context()
+
+
+def set_default_context(ctx):
+    stack = getattr(_context._CTX_STACK, "stack", None)
+    if stack is None:
+        _context._CTX_STACK.stack = stack = []
+    stack.clear()
+    stack.append(ctx)
+
+
+def list_gpus():
+    """Reference returns CUDA device ids; here: TPU ids (gpu→tpu alias)."""
+    return list(range(_context.num_tpus()))
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    return _np.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a_, b_ = _to_np(a), _to_np(b)
+    if not _np.allclose(a_, b_, rtol=rtol, atol=atol):
+        err = _np.max(_np.abs(a_ - b_))
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (max abs err {err}, rtol={rtol}, "
+            f"atol={atol})")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_np.random.randint(1, d + 1)
+                 for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1) for _ in range(num_dim))
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0):
+    return array((_np.random.uniform(-1, 1, shape) * scale)
+                 .astype(dtype))
+
+
+def numeric_grad(f, x: _np.ndarray, eps=1e-4) -> _np.ndarray:
+    """Central finite differences of a scalar-valued f at x."""
+    g = _np.zeros_like(x, dtype=_np.float64)
+    flat_x = x.reshape(-1)
+    flat_g = g.reshape(-1)
+    for i in range(flat_x.size):
+        orig = flat_x[i]
+        flat_x[i] = orig + eps
+        fp = float(f(x))
+        flat_x[i] = orig - eps
+        fm = float(f(x))
+        flat_x[i] = orig
+        flat_g[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_numeric_gradient(fn, inputs: Sequence[NDArray], rtol=1e-2,
+                           atol=1e-4, eps=1e-3):
+    """Compare tape gradients of scalar `fn(*inputs)` against central
+    finite differences (reference: check_numeric_gradient)."""
+    for a in inputs:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        if out.size != 1:
+            out = out.sum()
+    out.backward()
+    for idx, a in enumerate(inputs):
+        host = a.asnumpy().astype(_np.float64)
+
+        def f_at(x, _idx=idx):
+            vals = [v.asnumpy() if j != _idx else x.astype("float32")
+                    for j, v in enumerate(inputs)]
+            nds = [array(v) for v in vals]
+            with autograd.pause():
+                o = fn(*nds)
+                return o.sum().asscalar() if o.size != 1 \
+                    else o.asscalar()
+
+        expected = numeric_grad(f_at, host, eps=eps)
+        got = a.grad.asnumpy()
+        if not _np.allclose(got, expected, rtol=rtol, atol=atol):
+            err = _np.max(_np.abs(got - expected))
+            raise AssertionError(
+                f"gradient mismatch on input {idx}: max abs err {err}")

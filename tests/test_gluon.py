@@ -231,3 +231,22 @@ def test_lr_scheduler_in_trainer():
         l.backward()
         tr.step(1)
     assert tr.learning_rate < 1.0
+
+
+def test_model_zoo_vision_namespace():
+    from mxnet_tpu.gluon import model_zoo
+    import mxnet_tpu as mx
+    net = model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize()
+    assert net(mx.nd.ones((1, 32, 32, 3))).shape == (1, 10)
+    assert "resnet50_v1" in dir(model_zoo.vision)
+    assert len(mx.models.list_models()) >= 40
+
+
+def test_test_utils_numeric_gradient():
+    import mxnet_tpu as mx
+    x = mx.nd.array([[0.5, -0.3], [0.2, 0.9]])
+    mx.test_utils.check_numeric_gradient(
+        lambda a: (a * a).sum(), [x])
+    mx.test_utils.assert_almost_equal(mx.nd.ones((2,)),
+                                      mx.nd.ones((2,)))
