@@ -45,17 +45,36 @@ def main():
                     .astype(np.float32), dtype="bfloat16")
     y = mx.nd.array(np.random.randint(0, 1000, batch), dtype="int32")
 
-    # warmup (compile)
-    step(x, y).wait_to_read()
-    step(x, y).wait_to_read()
+    # warmup (compile + first exec)
+    float(step(x, y).asscalar())
+    float(step(x, y).asscalar())
 
+    # async-chained timing: each step consumes the previous step's
+    # donated params, so forcing the final loss to host bounds the
+    # whole chain (the reference benchmarks the same way: enqueue,
+    # sync once)
     t0 = time.perf_counter()
     for _ in range(steps):
         l = step(x, y)
-    l.wait_to_read()
+    float(l.asscalar())  # device->host: cannot complete early
     dt = time.perf_counter() - t0
-
     ips = batch * steps / dt
+
+    # cross-check: block every step (pays sync latency; slower but
+    # immune to async-timing artifacts). Report the conservative
+    # number if the chained figure is implausible for one chip.
+    t0 = time.perf_counter()
+    for _ in range(max(3, steps // 4)):
+        float(step(x, y).asscalar())
+    dt_sync = time.perf_counter() - t0
+    ips_sync = batch * max(3, steps // 4) / dt_sync
+
+    # ResNet-50 training is ~12.3 GFLOP/image; one v5e chip peaks at
+    # ~197 bf16 TFLOP/s => hard ceiling ~16k img/s
+    ceiling = 197e12 / 12.3e9
+    if ips > ceiling and ips_sync < ips:
+        ips = ips_sync
+
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
