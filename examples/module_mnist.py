@@ -1,0 +1,75 @@
+"""Classic pre-Gluon workflow (reference: example/image-classification
+train_mnist.py with the Module API): symbolic MLP + mx.mod.Module.fit.
+
+Usage: python examples/module_mnist.py [--epochs 2] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data.vision import MNIST
+
+    # the canonical 784-256-64-10 MLP, written symbolically
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = data
+    for i, n in enumerate((256, 64), 1):
+        w = mx.sym.Variable(f"fc{i}_weight", shape=(n, 784 if i == 1
+                                                    else 256))
+        b = mx.sym.Variable(f"fc{i}_bias", shape=(n,))
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(h, w, b, num_hidden=n),
+            act_type="relu")
+    w3 = mx.sym.Variable("fc3_weight", shape=(10, 64))
+    b3 = mx.sym.Variable("fc3_bias", shape=(10,))
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, w3, b3, num_hidden=10), label,
+        name="softmax")
+
+    def flat(split):
+        ds = MNIST(train=split)
+        X = np.stack([np.asarray(d).reshape(-1) / 255.0
+                      for d, _ in ds]).astype(np.float32)
+        Y = np.asarray([int(l) for _, l in ds], dtype=np.float32)
+        return X, Y
+
+    Xtr, Ytr = flat(True)
+    Xte, Yte = flat(False)
+    train_iter = mx.io.NDArrayIter(Xtr, Ytr, batch_size=args.batch_size,
+                                   shuffle=True,
+                                   label_name="softmax_label")
+    test_iter = mx.io.NDArrayIter(Xte, Yte, batch_size=args.batch_size,
+                                  label_name="softmax_label")
+
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train_iter, eval_data=test_iter, eval_metric="acc",
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", args.lr),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(), num_epoch=args.epochs)
+    print("test accuracy:", mod.score(test_iter, "acc"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""AMP (SURVEY §2: bf16/fp16 casting policy, DynamicLossScaler,
+multi-precision optimizer integration)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp
+
+
+@pytest.fixture(autouse=True)
+def _reset_amp():
+    yield
+    amp._STATE.update({"enabled": False, "dtype": jnp.bfloat16,
+                       "scaler": None})
+
+
+def _net():
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, in_units=8, activation="relu"),
+            mx.gluon.nn.BatchNorm(),
+            mx.gluon.nn.Dense(4, in_units=16))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def test_convert_block_bf16_keeps_norm_params_fp32():
+    net = _net()
+    amp.init("bfloat16")
+    amp.convert_block(net)
+    net(mx.nd.ones((2, 8), dtype="bfloat16"))  # materialize deferred BN
+    ps = net.collect_params()
+    dtypes = {n: p.data()._data.dtype for n, p in ps.items()}
+    for n, dt in dtypes.items():
+        leaf = n.rsplit(".", 1)[-1]
+        if leaf in ("gamma", "beta", "running_mean", "running_var"):
+            assert dt == jnp.float32, (n, dt)
+        else:
+            assert dt == jnp.bfloat16, (n, dt)
+    out = net(mx.nd.ones((2, 8), dtype="bfloat16"))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_bf16_training_decreases_loss():
+    net = _net()
+    amp.init("bfloat16")
+    amp.convert_block(net)
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9,
+                           "multi_precision": True})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    X = mx.nd.array(rs.rand(32, 8).astype(np.float32),
+                    dtype="bfloat16")
+    Y = mx.nd.array(rs.randint(0, 4, 32), dtype="int32")
+    losses = []
+    for _ in range(15):
+        with mx.autograd.record():
+            l = loss_fn(net(X), Y).mean()
+        l.backward()
+        tr.step(1)
+        losses.append(float(l.asscalar()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_dynamic_loss_scaler_backoff_and_growth():
+    s = amp.DynamicLossScaler(init_scale=1024, scale_factor=2.0,
+                              scale_window=3)
+    s.update_scale(overflow=True)
+    assert s.loss_scale == 512
+    for _ in range(3):
+        s.update_scale(overflow=False)
+    assert s.loss_scale == 1024
+    # floor at 1.0
+    for _ in range(20):
+        s.update_scale(overflow=True)
+    assert s.loss_scale == 1.0
+
+
+def test_fp16_scale_loss_and_unscale_overflow_detection():
+    net = _net()
+    amp.init("float16")
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.01,
+                           "multi_precision": True})
+    amp.init_trainer(tr)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    X = mx.nd.array(rs.rand(8, 8).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, 8), dtype="int32")
+    with mx.autograd.record():
+        l = loss_fn(net(X), Y).mean()
+        with amp.scale_loss(l, tr) as scaled:
+            scaled.backward()
+    overflow = amp.unscale(tr)
+    assert overflow is False
+    # grads carry the scale; trainer._scale divides it back out
+    assert tr._scale == pytest.approx(1.0 / tr._amp_scaler.loss_scale)
+    tr.step(1)  # applies rescale_grad = _scale / batch
+
+    # force an overflow: poison a gradient, scaler must back off
+    p = next(iter(net.collect_params().values()))
+    g = p.grad()
+    g._data = g._data.at[(0,) * g._data.ndim].set(jnp.inf)
+    before = tr._amp_scaler.loss_scale
+    overflow = amp.unscale(tr)
+    assert overflow is True
+    assert tr._amp_scaler.loss_scale == before / 2
