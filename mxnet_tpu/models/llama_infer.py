@@ -1,0 +1,221 @@
+"""Autoregressive decoding with a static-shape KV cache for the Llama
+decoder (reference analogue: GluonNLP's sequence sampler / beam search
+over cached decoder states).
+
+TPU-first: one jitted prefill (prompt forward that fills the cache) and
+one jitted `lax.scan` over decode steps — static shapes throughout (the
+cache is allocated at `max_len` up front), so the whole generation loop
+is exactly two XLA executables regardless of prompt/output length.
+Greedy or temperature/top-k sampling via functional RNG keys.
+
+    net = mx.models.get_model("llama_tiny"); net.initialize()
+    out = generate(net, prompt_ids, max_new_tokens=32, temperature=0.8)
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray import NDArray
+
+__all__ = ["generate", "build_decoder"]
+
+
+def _params_tree(net):
+    """Collect the decoder weights into a plain pytree keyed by role."""
+    cfg = net.model.cfg
+    ps = {n: p.data()._data for n, p in net.collect_params().items()}
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        layers.append({
+            "ln1": ps[pre + "input_layernorm.gamma"],
+            "wq": ps[pre + "self_attn.q_proj.weight"],
+            "wk": ps[pre + "self_attn.k_proj.weight"],
+            "wv": ps[pre + "self_attn.v_proj.weight"],
+            "wo": ps[pre + "self_attn.o_proj.weight"],
+            "ln2": ps[pre + "post_attention_layernorm.gamma"],
+            "gate": ps[pre + "mlp.gate_proj.weight"],
+            "up": ps[pre + "mlp.up_proj.weight"],
+            "down": ps[pre + "mlp.down_proj.weight"],
+        })
+    return {"embed": ps["model.embed_tokens.weight"],
+            "norm": ps["model.norm.gamma"],
+            "head": ps["lm_head.weight"],
+            "layers": layers}
+
+
+def _rms(x, g, eps):
+    xf = x.astype(jnp.float32)
+    r = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (r * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_at(x, positions, base):
+    """RoPE for (B, T, H, d) at absolute `positions` (B, T) or (T,)."""
+    d = x.shape[-1]
+    half = d // 2
+    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.asarray(positions, jnp.float32)
+    if pos.ndim == 1:
+        pos = pos[None, :]
+    ang = pos[..., None] * inv  # (B, T, half)
+    sin = jnp.sin(ang)[:, :, None, :]
+    cos = jnp.cos(ang)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin],
+                           axis=-1).astype(x.dtype)
+
+
+def _attend(q, k_cache, v_cache, valid_len, cfg):
+    """q: (B, Tq, H, d); caches (B, S, K, d); attend to [0, valid_len)."""
+    rep = cfg.num_heads // cfg.num_kv_heads
+    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    S = k.shape[1]
+    mask = jnp.arange(S)[None, :] < valid_len[:, None]  # (B, S)
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
+    return out
+
+
+def build_decoder(net, max_len: int):
+    """Returns (params, prefill, step).
+
+    prefill(params, ids, valid_len) -> (cache, last_logits): runs the
+    prompt (right-padded to the jit shape) and fills the KV cache.
+    step(params, cache, pos, tok) -> (cache, logits): one decode step.
+    cache: per layer {k, v} of (B, max_len, K, d).
+    """
+    cfg = net.model.cfg
+    params = _params_tree(net)
+
+    def layer_fwd(lp, x, positions):
+        B, T, D = x.shape
+        h = _rms(x, lp["ln1"], cfg.rms_eps)
+        q = (h @ lp["wq"].T).reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"].T).reshape(B, T, cfg.num_kv_heads,
+                                     cfg.head_dim)
+        v = (h @ lp["wv"].T).reshape(B, T, cfg.num_kv_heads,
+                                     cfg.head_dim)
+        q = _rope_at(q, positions, cfg.rope_base)
+        k = _rope_at(k, positions, cfg.rope_base)
+        return q, k, v
+
+    def prefill(params, ids, valid_len):
+        B, T = ids.shape
+        x = params["embed"][ids]
+        positions = jnp.arange(T)
+        cache = []
+        for lp in params["layers"]:
+            q, k, v = layer_fwd(lp, x, positions)
+            k_c = jnp.zeros((B, max_len, cfg.num_kv_heads,
+                             cfg.head_dim), x.dtype)
+            v_c = jnp.zeros_like(k_c)
+            k_c = lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0))
+            v_c = lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0))
+            # causal within the prompt: token t sees <= t and < valid
+            S = max_len
+            pos_q = positions[None, :]
+            pos_k = jnp.arange(S)[None, :]
+            causal = pos_k[:, None, :] <= pos_q[:, :, None]  # (1,T,S)
+            vmask = pos_k[:, None, :] < valid_len[:, None, None]
+            rep = cfg.num_heads // cfg.num_kv_heads
+            kf = jnp.repeat(k_c, rep, axis=2) if rep > 1 else k_c
+            vf = jnp.repeat(v_c, rep, axis=2) if rep > 1 else v_c
+            scale = 1.0 / math.sqrt(cfg.head_dim)
+            s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                           kf.astype(jnp.float32)) * scale
+            m = (causal & vmask)[:, None, :, :]
+            s = jnp.where(m, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bhts,bshd->bthd", p.astype(vf.dtype), vf)
+            x = x + att.reshape(B, T, -1) @ lp["wo"].T
+            h2 = _rms(x, lp["ln2"], cfg.rms_eps)
+            x = x + (jax.nn.silu(h2 @ lp["gate"].T) *
+                     (h2 @ lp["up"].T)) @ lp["down"].T
+            cache.append({"k": k_c, "v": v_c})
+        x = _rms(x, params["norm"], cfg.rms_eps)
+        # logits at each batch row's last valid position
+        idx = jnp.maximum(valid_len - 1, 0)
+        last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        return cache, last @ params["head"].T
+
+    def step(params, cache, pos, tok):
+        """pos: (B,) absolute position of `tok` (B,) being fed."""
+        B = tok.shape[0]
+        x = params["embed"][tok][:, None, :]  # (B, 1, D)
+        new_cache = []
+        for lp, c in zip(params["layers"], cache):
+            q, k, v = layer_fwd(lp, x, pos[:, None])
+            k_c = jax.vmap(
+                lambda buf, kk, p: lax.dynamic_update_slice(
+                    buf, kk, (p, 0, 0)))(c["k"], k, pos)
+            v_c = jax.vmap(
+                lambda buf, vv, p: lax.dynamic_update_slice(
+                    buf, vv, (p, 0, 0)))(c["v"], v, pos)
+            att = _attend(q, k_c, v_c, pos + 1, cfg)
+            x = x + att.reshape(B, 1, -1) @ lp["wo"].T
+            h2 = _rms(x, lp["ln2"], cfg.rms_eps)
+            x = x + (jax.nn.silu(h2 @ lp["gate"].T) *
+                     (h2 @ lp["up"].T)) @ lp["down"].T
+            new_cache.append({"k": k_c, "v": v_c})
+        x = _rms(x, params["norm"], cfg.rms_eps)
+        return new_cache, (x @ params["head"].T)[:, 0]
+
+    return params, prefill, step
+
+
+def generate(net, prompt_ids, max_new_tokens: int, temperature=0.0,
+             top_k: int = 0, seed: int = 0,
+             max_len: Optional[int] = None):
+    """Autoregressive generation. prompt_ids: (B, T) NDArray/array of
+    int32 (right-pad shorter rows with any token and pass
+    `valid_len`-style ragged prompts as equal lengths for now).
+    temperature 0 = greedy. Returns (B, T + max_new_tokens) numpy."""
+    ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
+        else jnp.asarray(prompt_ids)
+    ids = ids.astype(jnp.int32)
+    B, T = ids.shape
+    cfg = net.model.cfg
+    max_len = max_len or min(cfg.max_seq_len, T + max_new_tokens)
+    assert T + max_new_tokens <= max_len, "max_len too small"
+    params, prefill, step = build_decoder(net, max_len)
+    valid = jnp.full((B,), T, jnp.int32)
+    cache, logits = jax.jit(prefill)(params, ids, valid)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    key = jax.random.PRNGKey(seed)
+
+    def scan_body(carry, key_i):
+        cache, logits, pos = carry
+        tok = pick(logits, key_i)
+        cache, logits = step(params, cache, pos, tok)
+        return (cache, logits, pos + 1), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    scan = jax.jit(partial(lax.scan, scan_body))
+    (_, _, _), toks = scan((cache, logits, valid), keys)
+    out = jnp.concatenate([ids, toks.T], axis=1)
+    return _np.asarray(out)
