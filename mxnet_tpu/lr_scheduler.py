@@ -4,7 +4,8 @@ from __future__ import annotations
 import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
-           "PolyScheduler", "CosineScheduler"]
+           "PolyScheduler", "CosineScheduler", "ConstantScheduler",
+           "LinearWarmUp"]
 
 
 class LRScheduler:
@@ -96,3 +97,31 @@ class CosineScheduler(LRScheduler):
         frac = (num_update - self.warmup_steps) / max(self.max_steps, 1)
         return self.final_lr + (self.base_lr - self.final_lr) * \
             (1 + math.cos(math.pi * frac)) / 2
+
+
+class ConstantScheduler(LRScheduler):
+    """Flat lr after (optional) warmup (reference: 'constant' mode)."""
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self.base_lr
+
+
+class LinearWarmUp(LRScheduler):
+    """Composition wrapper: linear warmup for `warmup_steps`, then
+    delegate to `schedule` (GluonNLP-style composition; the reference
+    also exposes warmup via LRScheduler ctor args — both work here)."""
+
+    def __init__(self, schedule: LRScheduler, warmup_steps,
+                 warmup_begin_lr=0.0):
+        base = schedule.base_lr if isinstance(schedule, LRScheduler) \
+            else 0.01
+        super().__init__(base_lr=base, warmup_steps=warmup_steps,
+                         warmup_begin_lr=warmup_begin_lr)
+        self.schedule = schedule
+
+    def __call__(self, num_update):
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self.schedule(num_update)

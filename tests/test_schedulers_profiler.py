@@ -1,0 +1,74 @@
+"""LR schedulers + profiler (SURVEY §2)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import lr_scheduler as lrs
+from mxnet_tpu import profiler
+
+
+def test_factor_scheduler():
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_multifactor_scheduler():
+    s = lrs.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(6) == pytest.approx(0.1)
+    assert s(11) == pytest.approx(0.01)
+
+
+def test_poly_and_cosine_endpoints():
+    p = lrs.PolyScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert p(0) == pytest.approx(1.0)
+    assert p(100) == pytest.approx(0.1)
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(50) == pytest.approx(0.5)
+    assert c(100) == pytest.approx(0.0)
+
+
+def test_warmup_and_composition():
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0,
+                            warmup_steps=10)
+    assert s(0) == pytest.approx(0.0)
+    assert s(5) == pytest.approx(0.5)
+    w = lrs.LinearWarmUp(lrs.ConstantScheduler(base_lr=0.8),
+                         warmup_steps=4)
+    assert w(2) == pytest.approx(0.4)
+    assert w(50) == pytest.approx(0.8)
+
+
+def test_scheduler_drives_optimizer():
+    opt = mx.optimizer.SGD(
+        learning_rate=1.0,
+        lr_scheduler=lrs.FactorScheduler(step=1, factor=0.5,
+                                         base_lr=1.0))
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    st = opt.create_state(0, w)
+    for _ in range(3):
+        st = opt.update(0, w, g, st)
+    assert opt.learning_rate < 1.0
+
+
+def test_profiler_scope_and_dump(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "prof.json"))
+    profiler.set_state("run")
+    with profiler.scope("matmul_block"):
+        (mx.nd.ones((64, 64)) @ mx.nd.ones((64, 64))).wait_to_read()
+    with profiler.Timer("named_timer"):
+        mx.nd.ones((8, 8)).sum().wait_to_read()
+    profiler.set_state("stop")
+    s = profiler.summary()
+    assert "matmul_block" in s and "named_timer" in s
+    fname = profiler.dump()
+    blob = json.load(open(fname))
+    names = {e["name"] for e in blob["traceEvents"]}
+    assert "matmul_block" in names
+    assert "matmul_block" in profiler.dumps(reset=True)
