@@ -1,0 +1,63 @@
+"""KVStore allreduce bandwidth (SURVEY §6: GB/s).
+
+Measures the 'tpu_sync' gradient-sync path: psum over the dp mesh axis
+inside one jitted step (single chip: measures the fused add/identity
+path; multi-chip: ICI collective bandwidth). One JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+REFERENCE_GBPS = 130.0  # NCCL allreduce on 8xV100 NVLink (bus BW)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh([n], ["dp"])
+    mb = int(os.environ.get("BENCH_MB", 64))
+    size = mb * 1024 * 1024 // 4  # fp32 elements
+    reps = int(os.environ.get("BENCH_REPS", 10))
+
+    x = jnp.ones((n, size // n), jnp.float32)
+    sh = NamedSharding(mesh, P("dp", None))
+    x = jax.device_put(x, sh)
+
+    from jax.experimental.shard_map import shard_map
+
+    def psum_fn(v):
+        return jax.lax.psum(v, "dp")
+
+    f = jax.jit(shard_map(psum_fn, mesh=mesh, in_specs=P("dp", None),
+                          out_specs=P("dp", None)))
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(reps):
+        y = f(y)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    # ring allreduce moves 2*(n-1)/n of the buffer per rep
+    bytes_moved = 2 * (n - 1) / max(n, 1) * size * 4 * reps \
+        if n > 1 else size * 4 * reps
+    gbps = bytes_moved / dt / 1e9
+    print(json.dumps({
+        "metric": "kvstore_allreduce_gbps",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / REFERENCE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

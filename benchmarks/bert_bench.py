@@ -1,0 +1,78 @@
+"""BERT-base pretraining throughput (SURVEY §6: samples/sec).
+
+Runs the fused train step (fwd+bwd+AdamW in one XLA executable) on
+synthetic MLM+NSP batches, bf16. One JSON line like bench.py.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+REFERENCE_SAMPLES_PER_SEC = 107.0  # ptrendx MXNet BERT-base V100 AMP
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.models.bert import BERTForPretraining
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 4))
+    seq = int(os.environ.get("BENCH_SEQ", 128 if on_tpu else 32))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+    vocab = 30522
+
+    mx.random.seed(0)
+    net = BERTForPretraining(vocab_size=vocab)
+    net.initialize(init=mx.init.Normal(0.02))
+    if on_tpu:
+        amp.init("bfloat16")
+        amp.convert_block(net)
+
+    mlm_ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    nsp_ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(mlm, nsp, labels, mask, nsp_labels):
+        per = mlm_ce(mlm.reshape(-1, vocab), labels.reshape(-1))
+        m = mask.reshape(-1).astype("float32")
+        l1 = (per * m).sum() / mx.nd.maximum(m.sum(),
+                                             mx.nd.array([1.0]))
+        return l1 + nsp_ce(nsp, nsp_labels).mean()
+
+    opt = mx.optimizer.AdamW(learning_rate=1e-4, wd=0.01,
+                             multi_precision=True)
+    step = FusedTrainStep(net, loss_fn, opt)
+
+    rs = np.random.RandomState(0)
+    ids = mx.nd.array(rs.randint(4, vocab, (batch, seq)), dtype="int32")
+    labels = mx.nd.array(rs.randint(4, vocab, (batch, seq)),
+                         dtype="int32")
+    mask = mx.nd.array((rs.rand(batch, seq) < 0.15)
+                       .astype(np.float32))
+    nsp = mx.nd.array(rs.randint(0, 2, batch), dtype="int32")
+
+    float(step(ids, labels, mask, nsp).asscalar())
+    float(step(ids, labels, mask, nsp).asscalar())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = step(ids, labels, mask, nsp)
+    float(l.asscalar())
+    dt = time.perf_counter() - t0
+    sps = batch * steps / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
