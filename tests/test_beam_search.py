@@ -1,0 +1,52 @@
+"""Beam search (reference: gluon-nlp sequence_sampler) — greedy parity
+at beam_size=1, shapes, scorer monotonicity."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.beam_search import (BeamSearchScorer,
+                                          beam_search_translate)
+
+
+@pytest.fixture(scope="module")
+def net_src():
+    mx.random.seed(0)
+    net = mx.models.get_model("transformer_tiny")
+    net.initialize()
+    rs = np.random.RandomState(0)
+    src = mx.nd.array(rs.randint(3, 100, (2, 7)), dtype="int32")
+    net(src, mx.nd.array(rs.randint(3, 100, (2, 5)), dtype="int32"))
+    return net, src
+
+
+def test_beam_one_equals_greedy(net_src):
+    net, src = net_src
+    out1 = beam_search_translate(net, src, bos_id=1, eos_id=2,
+                                 beam_size=1, max_len=10)
+    ids = np.full((2, 10), 2, np.int32)
+    ids[:, 0] = 1
+    for t in range(1, 10):
+        with mx.autograd.pause():
+            logits = net(src, mx.nd.array(ids, dtype="int32")).asnumpy()
+        nxt = logits[:, t - 1].argmax(-1)
+        done = (ids[:, :t] == 2).any(axis=1)
+        ids[:, t] = np.where(done, 2, nxt)
+    np.testing.assert_array_equal(out1, ids)
+
+
+def test_beam_search_shapes_and_bos(net_src):
+    net, src = net_src
+    out = beam_search_translate(net, src, bos_id=1, eos_id=2,
+                                beam_size=4, max_len=12)
+    assert out.shape == (2, 12)
+    assert (out[:, 0] == 1).all()
+    assert (out >= 0).all() and (out < 100).all()
+
+
+def test_scorer_length_penalty():
+    sc = BeamSearchScorer(alpha=1.0)
+    # same raw logp, longer sequence ranks higher with alpha>0
+    assert sc(-10.0, 10.0) > sc(-10.0, 2.0)
+    # alpha=0 disables the penalty
+    sc0 = BeamSearchScorer(alpha=0.0)
+    assert sc0(-10.0, 10.0) == sc0(-10.0, 2.0)
