@@ -1,10 +1,17 @@
-"""gluon.contrib (reference: mxnet/gluon/contrib) — sparse embedding +
-misc blocks."""
+"""gluon.contrib (reference: mxnet/gluon/contrib) — sparse embedding,
+concurrent containers, pixel shuffle, SyncBatchNorm."""
 from __future__ import annotations
 
-from .nn.basic_layers import Embedding as _Embedding
+import jax.numpy as jnp
 
-__all__ = ["SparseEmbedding"]
+from .block import HybridBlock
+from .nn.basic_layers import BatchNorm as _BatchNorm
+from .nn.basic_layers import Embedding as _Embedding
+from ..ndarray import NDArray, invoke
+
+__all__ = ["SparseEmbedding", "Concurrent", "HybridConcurrent",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "SyncBatchNorm"]
 
 
 class SparseEmbedding(_Embedding):
@@ -13,3 +20,81 @@ class SparseEmbedding(_Embedding):
     def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
         super().__init__(input_dim, output_dim, dtype=dtype,
                          sparse_grad=True, **kwargs)
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input, concat outputs (reference:
+    gluon.contrib.nn.HybridConcurrent; Inception-style branches)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x):
+        from ..nd import concat
+        outs = [c(x) for c in self._children.values()]
+        return concat(*outs, dim=self.axis)
+
+
+Concurrent = HybridConcurrent  # eager/hybrid identical here
+
+
+class _PixelShuffle(HybridBlock):
+    _ndim = 2
+
+    def __init__(self, factor, **kw):
+        super().__init__(**kw)
+        if isinstance(factor, int):
+            factor = (factor,) * self._ndim
+        self._factor = tuple(factor)
+
+    def forward(self, x):
+        f = self._factor
+        nd_ = self._ndim
+
+        def shuf(a):
+            # NCHW-family layout (reference semantics): split channels
+            # into the upscale factors, interleave into spatial dims
+            N, C = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            import math as _m
+            ftot = _m.prod(f)
+            Cout = C // ftot
+            a = a.reshape(N, Cout, *f, *spatial)
+            # interleave: (N, Cout, f1.., s1..) -> (N, Cout, s1, f1, ...)
+            perm = [0, 1]
+            for i in range(nd_):
+                perm += [2 + nd_ + i, 2 + i]
+            a = a.transpose(perm)
+            out_sp = [s * fi for s, fi in zip(spatial, f)]
+            return a.reshape(N, Cout, *out_sp)
+        return invoke(shuf, [x])
+
+
+class PixelShuffle1D(_PixelShuffle):
+    _ndim = 1
+
+
+class PixelShuffle2D(_PixelShuffle):
+    _ndim = 2
+
+
+class PixelShuffle3D(_PixelShuffle):
+    _ndim = 3
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Cross-device BatchNorm (reference: contrib.nn.SyncBatchNorm over
+    NCCL). Under GSPMD data parallelism the batch axis is one global
+    array, so ordinary batch statistics ARE the synchronized statistics
+    — XLA inserts the cross-chip reduction for the mean/var when the
+    batch is sharded over 'dp'. This subclass exists for API parity;
+    `num_devices` is accepted and ignored."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)

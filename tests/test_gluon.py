@@ -250,3 +250,29 @@ def test_test_utils_numeric_gradient():
         lambda a: (a * a).sum(), [x])
     mx.test_utils.assert_almost_equal(mx.nd.ones((2,)),
                                       mx.nd.ones((2,)))
+
+
+def test_contrib_concurrent_and_pixelshuffle():
+    import numpy as np
+    from mxnet_tpu.gluon import contrib, nn as gnn
+
+    c = contrib.HybridConcurrent(axis=-1)
+    c.add(gnn.Dense(4, in_units=8), gnn.Dense(6, in_units=8))
+    c.initialize()
+    assert c(mx.nd.ones((2, 8))).shape == (2, 10)
+
+    ps = contrib.PixelShuffle2D(2)
+    x = mx.nd.array(np.arange(8 * 9).reshape(1, 8, 3, 3)
+                    .astype(np.float32))
+    y = ps(x)
+    assert y.shape == (1, 2, 6, 6)
+    import torch
+    ref = torch.nn.functional.pixel_shuffle(
+        torch.from_numpy(x.asnumpy().copy()), 2).numpy()
+    np.testing.assert_allclose(y.asnumpy(), ref)
+
+    sb = contrib.SyncBatchNorm(in_channels=4)
+    sb.initialize()
+    with mx.autograd.record():
+        out = sb(mx.nd.random.normal(shape=(8, 4)))
+    assert out.shape == (8, 4)

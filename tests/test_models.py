@@ -234,3 +234,37 @@ def test_skipgram_trains():
         losses.append(l.asscalar())
     assert losses[-1] < losses[0] * 0.5
     assert net.embedding().shape == (vocab, dim)
+
+
+def test_llama_remat_matches_no_remat():
+    """cfg.remat=True (jax.checkpoint) must not change forward values."""
+    import numpy as np
+    from mxnet_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    def build(remat):
+        mx.random.seed(3)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_layers=2,
+                          num_heads=2, num_kv_heads=1, max_seq_len=16,
+                          dtype="float32", remat=remat)
+        net = LlamaForCausalLM(cfg)
+        net.initialize()
+        return net
+
+    ids = mx.nd.array(np.random.RandomState(0).randint(0, 64, (2, 8)),
+                      dtype="int32")
+    a = build(False)(ids).asnumpy()
+    b = build(True)(ids).asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    # gradients flow through the remat path
+    net = build(True)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    with mx.autograd.record():
+        l = loss_fn(net(ids).reshape(-1, 64),
+                    ids.reshape(-1)).mean()
+    l.backward()
+    tr.step(1)
+    assert np.isfinite(float(l.asscalar()))
