@@ -12,7 +12,8 @@ from ._ops_shape import one_hot  # noqa: F401 (re-export parity)
 __all__ = ["isnan", "isinf", "isfinite", "index_copy", "index_array",
            "getnnz", "arange_like", "check_numerics", "has_inf_or_nan",
            "div_sqrt_dim", "fft_stub", "boolean_mask", "allclose",
-           "interleaved_matmul_selfatt_qk", "rotary_embedding"]
+           "interleaved_matmul_selfatt_qk", "rotary_embedding",
+           "foreach", "while_loop", "cond"]
 
 
 def isnan(data):
@@ -121,3 +122,122 @@ def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
 def fft_stub(*a, **k):
     raise NotImplementedError("FFT ops: use jnp.fft via raw jax; not in the "
                               "reference's TPU-critical path")
+
+
+# -- control-flow operators (reference: src/operator/control_flow.cc ------
+# foreach / while_loop / cond). TPU-first: they lower to lax.scan /
+# masked-scan / lax.cond so the loop compiles to ONE XLA while-op
+# instead of the reference's subgraph-executor interpreter.
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (output, new_states)` along axis 0 of
+    `data` (reference: nd.contrib.foreach). Differentiable end-to-end:
+    the whole loop is one tape node whose backward is the scan's VJP."""
+    from .. import autograd as _ag
+    multi_in = isinstance(data, (list, tuple))
+    multi_state = isinstance(init_states, (list, tuple))
+    datas = _as_list(data)
+    states0 = _as_list(init_states)
+    nd_, ns_ = len(datas), len(states0)
+
+    # one probe call (paused) discovers the output arity
+    with _ag.pause():
+        probe_o, _ = body(
+            [d[0] for d in datas] if multi_in else datas[0][0],
+            list(states0) if multi_state else states0[0])
+    n_out = len(_as_list(probe_o))
+    multi_out = isinstance(probe_o, (list, tuple))
+
+    def f(*raw):
+        xs = tuple(raw[:nd_])
+        st0 = tuple(raw[nd_:])
+
+        def scan_body(st, x):
+            x_nd = [NDArray(v) for v in x]
+            st_nd = [NDArray(v) for v in st]
+            with _ag._mode(False, _ag.is_training()):
+                o, ns = body(x_nd if multi_in else x_nd[0],
+                             st_nd if multi_state else st_nd[0])
+            o_raw = tuple(v._data for v in _as_list(o))
+            ns_raw = tuple(v._data for v in _as_list(ns))
+            return ns_raw, o_raw
+
+        final, outs = jax.lax.scan(scan_body, st0, xs)
+        return (*outs, *final)
+
+    res = invoke(f, datas + states0, n_out=n_out + ns_)
+    outs = res[:n_out]
+    finals = res[n_out:]
+    return (list(outs) if multi_out else outs[0],
+            list(finals) if multi_state else finals[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations):
+    """reference: nd.contrib.while_loop. `cond(*vars)` -> scalar truth,
+    `func(*vars)` -> (step_output, new_vars). Runs as a masked lax.scan
+    of `max_iterations` steps (static shape — the TPU way): once cond
+    fails, vars pass through and outputs pad with zeros. Returns
+    (stacked_outputs, final_loop_vars)."""
+    from .. import autograd as _ag
+    lvs = _as_list(loop_vars)
+    nv = len(lvs)
+    with _ag.pause():
+        probe_o, probe_vars = func(*lvs)
+    n_out = len(_as_list(probe_o))
+    multi_out = isinstance(probe_o, (list, tuple))
+
+    def f(*raw):
+        def scan_body(carry, _):
+            vars_raw, done = carry
+            v_nd = [NDArray(v) for v in vars_raw]
+            with _ag._mode(False, _ag.is_training()):
+                keep_going = jnp.logical_and(
+                    jnp.logical_not(done),
+                    cond(*v_nd)._data.reshape(()).astype(bool))
+                o, nvars = func(*v_nd)
+            o_raw = [v._data for v in _as_list(o)]
+            nv_raw = [v._data for v in _as_list(nvars)]
+            new_vars = tuple(
+                jnp.where(keep_going, n, old)
+                for n, old in zip(nv_raw, vars_raw))
+            outs = tuple(
+                jnp.where(keep_going, v, jnp.zeros_like(v))
+                for v in o_raw)
+            return (new_vars, jnp.logical_not(keep_going)), outs
+
+        (final, _), outs = jax.lax.scan(
+            scan_body, (tuple(raw), jnp.asarray(False)), None,
+            length=max_iterations)
+        return (*outs, *final)
+
+    res = invoke(f, lvs, n_out=n_out + nv)
+    outs = res[:n_out]
+    finals = res[n_out:]
+    return (list(outs) if multi_out else outs[0], list(finals))
+
+
+def cond(pred, then_func, else_func):
+    """reference: nd.contrib.cond. Imperative semantics: evaluate the
+    predicate eagerly and run one branch (under hybridize tracing both
+    branches trace via lax.cond when the predicate is a tracer)."""
+    raw = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    import jax.core as _core
+    if isinstance(raw, jax.core.Tracer):
+        then_out = None
+
+        def wrap(fn):
+            def g(_):
+                out = fn()
+                return tuple(v._data for v in _as_list(out))
+            return g
+        outs = jax.lax.cond(raw.reshape(()).astype(bool),
+                            wrap(then_func), wrap(else_func), 0)
+        wrapped = [NDArray(o) for o in outs]
+        return wrapped if len(wrapped) > 1 else wrapped[0]
+    if bool(raw.reshape(())):
+        return then_func()
+    return else_func()

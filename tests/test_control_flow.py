@@ -1,0 +1,64 @@
+"""Control-flow operators (reference: nd.contrib.foreach/while_loop/
+cond over the subgraph executor; here one lax.scan/cond per loop)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_foreach_cumsum_and_states():
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    outs, final = nd.contrib.foreach(lambda x, s: (x + s, x + s),
+                                     data, mx.nd.zeros((3,)))
+    np.testing.assert_allclose(
+        outs.asnumpy(),
+        np.cumsum(np.arange(12).reshape(4, 3), axis=0))
+    np.testing.assert_allclose(final.asnumpy(), outs.asnumpy()[-1])
+
+
+def test_foreach_multi_state_multi_out():
+    data = [mx.nd.ones((3, 2)), mx.nd.full((3, 2), 2.0)]
+    s0 = [mx.nd.zeros((2,)), mx.nd.ones((2,))]
+
+    def body(xs, ss):
+        a, b = xs
+        s1, s2 = ss
+        return [a + s1, b * s2], [s1 + a, s2]
+
+    outs, finals = nd.contrib.foreach(body, data, s0)
+    assert len(outs) == 2 and len(finals) == 2
+    np.testing.assert_allclose(finals[0].asnumpy(), [3.0, 3.0])
+
+
+def test_foreach_gradient():
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    data.attach_grad()
+    with mx.autograd.record():
+        o, _ = nd.contrib.foreach(lambda x, s: (x * 2 + s, s + x),
+                                  data, mx.nd.zeros((3,)))
+        o.sum().backward()
+    # d/dx_t of sum = 2 + (T-1-t) appearances in later states
+    np.testing.assert_allclose(data.grad.asnumpy()[:, 0],
+                               [5.0, 4.0, 3.0, 2.0])
+
+
+def test_while_loop_masked_outputs():
+    outs, (fi, fa) = nd.contrib.while_loop(
+        cond=lambda i, a: i < 5,
+        func=lambda i, a: (i, [i + 1, a + i]),
+        loop_vars=[mx.nd.array([0.0]), mx.nd.array([0.0])],
+        max_iterations=8)
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               [0, 1, 2, 3, 4, 0, 0, 0])
+    assert float(fi.asscalar()) == 5.0
+    assert float(fa.asscalar()) == 10.0
+
+
+def test_cond_eager_branches():
+    t = nd.contrib.cond(mx.nd.array([1.0]),
+                        lambda: mx.nd.ones((2,)),
+                        lambda: mx.nd.zeros((2,)))
+    f = nd.contrib.cond(mx.nd.array([0.0]),
+                        lambda: mx.nd.ones((2,)),
+                        lambda: mx.nd.zeros((2,)))
+    assert t.asnumpy().sum() == 2.0 and f.asnumpy().sum() == 0.0
