@@ -28,6 +28,7 @@ from .optimizer import lr_scheduler
 from . import lr_scheduler as _lr_sched_alias  # noqa: F401
 from . import metric
 from . import kvstore
+from . import kvstore as kv              # reference alias: mx.kv.create
 from .kvstore import create as _kv_create  # noqa: F401
 from . import gluon
 from . import models
