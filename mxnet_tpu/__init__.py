@@ -33,7 +33,9 @@ from .kvstore import create as _kv_create  # noqa: F401
 from . import gluon
 from . import models
 from . import amp
+from . import callback
 from . import checkpoint
+from . import monitor
 from . import profiler
 from . import tracing
 from . import parallel

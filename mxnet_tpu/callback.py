@@ -1,0 +1,90 @@
+"""mx.callback (reference: mxnet/callback.py) — the Module.fit hooks:
+Speedometer, do_checkpoint, LogValidationMetricsCallback."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "ProgressBar",
+           "LogValidationMetricsCallback"]
+
+
+class Speedometer:
+    """Log throughput every `frequent` batches (reference signature:
+    called as batch_end_callback(epoch, nbatch, eval_metric))."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self._init = False
+        self._tic = 0.0
+        self._last = 0
+
+    def __call__(self, epoch, nbatch=None, eval_metric=None, *a):
+        # also accepts the reference's BatchEndParam-style single arg
+        if nbatch is None and hasattr(epoch, "nbatch"):
+            p = epoch
+            epoch, nbatch, eval_metric = p.epoch, p.nbatch, p.eval_metric
+        if not self._init:
+            self._init = True
+            self._tic = time.time()
+            self._last = nbatch
+            return
+        if nbatch - self._last >= self.frequent:
+            speed = (nbatch - self._last) * self.batch_size / \
+                (time.time() - self._tic)
+            if eval_metric is not None:
+                name, value = eval_metric.get()
+                logging.getLogger("mxnet_tpu").info(
+                    "Epoch[%d] Batch [%d] Speed: %.2f samples/sec "
+                    "%s=%f", epoch, nbatch, speed, name, value)
+                if self.auto_reset:
+                    eval_metric.reset()
+            else:
+                logging.getLogger("mxnet_tpu").info(
+                    "Epoch[%d] Batch [%d] Speed: %.2f samples/sec",
+                    epoch, nbatch, speed)
+            self._tic = time.time()
+            self._last = nbatch
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving Module checkpoints (reference:
+    callback.do_checkpoint)."""
+    def _callback(epoch, sym=None, arg_params=None, aux_params=None):
+        if (epoch + 1) % period != 0:
+            return
+        import numpy as _np
+        if sym is not None:
+            sym.save(f"{prefix}-symbol.json")
+        blob = {f"arg:{k}": _np.asarray(v.asnumpy())
+                for k, v in (arg_params or {}).items()}
+        blob.update({f"aux:{k}": _np.asarray(v.asnumpy())
+                     for k, v in (aux_params or {}).items()})
+        with open(f"{prefix}-{epoch + 1:04d}.params", "wb") as f:
+            _np.savez(f, **blob)
+    return _callback
+
+
+class ProgressBar:
+    def __init__(self, total, length=40):
+        self.total = total
+        self.length = length
+
+    def __call__(self, epoch, nbatch=None, *a):
+        if nbatch is None:
+            return
+        frac = min(nbatch / max(self.total, 1), 1.0)
+        filled = int(self.length * frac)
+        bar = "#" * filled + "-" * (self.length - filled)
+        print(f"\r[{bar}] {frac:6.1%}", end="", flush=True)
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, epoch, metric=None, *a):
+        if metric is None:
+            return
+        name, value = metric.get()
+        logging.getLogger("mxnet_tpu").info(
+            "Epoch[%d] Validation-%s=%f", epoch, name, value)

@@ -1,0 +1,92 @@
+"""mx.monitor (reference: mxnet/monitor.py) — activation/weight
+statistics watcher for debugging training (the NaN-hunt tool).
+
+Installs forward hooks on a Gluon block tree (the rebuild's analogue of
+the reference's executor output monitoring) and records a stat per
+tensor every `interval` batches.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as _np
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x: _np.ndarray):
+    return float(_np.abs(x).mean())
+
+
+class Monitor:
+    """Monitor(interval, stat_func=|x|.mean, pattern='.*', sort=False).
+
+    Usage (Gluon path):
+        mon = Monitor(10)
+        mon.install(net)
+        ...
+        mon.tic()
+        out = net(x)                # hooks record activations
+        for name, stat in mon.toc():
+            print(name, stat)
+    """
+
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = interval
+        self.stat_func = stat_func or _default_stat
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self._step = 0
+        self._active = False
+        self._records: List[Tuple[str, float]] = []
+
+    # -- gluon hook installation -------------------------------------------
+    def install(self, block):
+        """Register forward hooks over the whole block tree."""
+        def mk_hook(name):
+            def hook(blk, inputs, output):
+                if not self._active:
+                    return
+                outs = output if isinstance(output, (list, tuple)) \
+                    else [output]
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray) and \
+                            self.pattern.match(name):
+                        try:
+                            self._records.append(
+                                (f"{name}_output{i}",
+                                 self.stat_func(o.asnumpy())))
+                        except Exception:
+                            pass
+            return hook
+
+        def walk(blk, prefix):
+            blk.register_forward_hook(mk_hook(prefix or
+                                              type(blk).__name__))
+            for cname, child in blk._children.items():
+                walk(child, f"{prefix}.{cname}" if prefix else cname)
+        walk(block, "")
+        return self
+
+    def tic(self):
+        if self._step % max(self.interval, 1) == 0:
+            self._records = []
+            self._active = True
+        self._step += 1
+
+    def toc(self) -> List[Tuple[str, float]]:
+        if not self._active:
+            return []
+        self._active = False
+        recs = list(self._records)
+        if self.sort:
+            recs.sort(key=lambda kv: kv[0])
+        return recs
+
+    def toc_print(self):
+        for name, stat in self.toc():
+            print(f"{name:<60}{stat:>14.6g}")

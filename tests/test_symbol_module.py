@@ -174,3 +174,35 @@ def test_module_batchnorm_aux_states():
                          bn_moving_mean=(16,), bn_moving_var=(16,))
     (r,) = ex.forward(data=mx.nd.random.normal(shape=(4, 16)))
     assert r.shape == (4, 16)
+
+
+def test_callbacks_and_monitor(tmp_path, caplog):
+    import logging
+    X, Y = _fit_problem()
+    out = _mlp_symbol()
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(out)
+    speed = mx.callback.Speedometer(batch_size=32, frequent=2)
+    ckpt_cb = mx.callback.do_checkpoint(str(tmp_path / "cb"), period=1)
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu"):
+        mod.fit(it, eval_metric="acc", num_epoch=1,
+                optimizer_params=(("learning_rate", 0.1),),
+                batch_end_callback=speed, epoch_end_callback=ckpt_cb)
+    assert any("Speed" in r.message for r in caplog.records)
+    assert (tmp_path / "cb-0001.params").exists()
+    assert (tmp_path / "cb-symbol.json").exists()
+
+
+def test_monitor_records_activations():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+            mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.tic()
+    net(mx.nd.ones((2, 4)))
+    recs = mon.toc()
+    assert len(recs) >= 2
+    assert all(np.isfinite(v) for _, v in recs)
