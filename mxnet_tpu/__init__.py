@@ -40,6 +40,8 @@ from . import profiler
 from . import tracing
 from . import parallel
 from . import io
+from . import image
+from . import recordio
 from . import runtime
 
 # reference-style module aliases

@@ -1,0 +1,183 @@
+"""mx.image (reference: mxnet/image/image.py) — decode/resize/crop
+utilities and augmenters over NDArray images (HWC uint8/float).
+
+TPU-first notes: `imresize` uses jax.image.resize (runs on device, XLA
+fuses with downstream casts); decode rides PIL on the host like the
+reference rides OpenCV. The Gluon path (gluon.data.vision.transforms)
+is preferred for new code; this module keeps legacy scripts running.
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import Optional, Sequence
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short",
+           "fixed_crop", "center_crop", "random_crop",
+           "color_normalize", "HorizontalFlipAug", "CastAug",
+           "ResizeAug", "CenterCropAug", "RandomCropAug",
+           "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
+
+
+def imdecode(buf, to_rgb=True, flag=1, **kw) -> NDArray:
+    """Decode a compressed image buffer (JPEG/PNG) to HWC uint8."""
+    from PIL import Image
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    a = _np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if not to_rgb and a.shape[2] == 3:
+        a = a[:, :, ::-1]
+    return array(a)
+
+
+def imread(filename, flag=1, to_rgb=True) -> NDArray:
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def _raw(img):
+    return img._data if isinstance(img, NDArray) else jnp.asarray(img)
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    """Resize HWC to (h, w). interp 0=nearest else bilinear."""
+    a = _raw(src)
+    method = "nearest" if interp == 0 else "linear"
+    out = jax.image.resize(a.astype(jnp.float32),
+                           (h, w, a.shape[2]), method=method)
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255).astype(a.dtype)
+    return NDArray(out)
+
+
+def resize_short(src, size, interp=1) -> NDArray:
+    a = _raw(src)
+    H, W = a.shape[:2]
+    if H <= W:
+        nh, nw = size, int(W * size / H)
+    else:
+        nh, nw = int(H * size / W), size
+    return imresize(src, nw, nh, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1) -> NDArray:
+    a = _raw(src)[y0:y0 + h, x0:x0 + w]
+    out = NDArray(a)
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=1):
+    a = _raw(src)
+    H, W = a.shape[:2]
+    w, h = size
+    x0 = max((W - w) // 2, 0)
+    y0 = max((H - h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(w, W), min(h, H), size,
+                      interp), (x0, y0, w, h)
+
+
+def random_crop(src, size, interp=1):
+    a = _raw(src)
+    H, W = a.shape[:2]
+    w, h = size
+    x0 = int(_np.random.randint(0, max(W - w, 0) + 1))
+    y0 = int(_np.random.randint(0, max(H - h, 0) + 1))
+    return fixed_crop(src, x0, y0, min(w, W), min(h, H), size,
+                      interp), (x0, y0, w, h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    a = _raw(src).astype(jnp.float32)
+    a = a - jnp.asarray(mean, jnp.float32)
+    if std is not None:
+        a = a / jnp.asarray(std, jnp.float32)
+    return NDArray(a)
+
+
+# -- augmenter objects (reference: image.py Augmenter classes) -------------
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            return NDArray(jnp.flip(_raw(src), axis=1))
+        return src if isinstance(src, NDArray) else NDArray(_raw(src))
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        self.typ = typ
+
+    def __call__(self, src):
+        return NDArray(_raw(src).astype(self.typ))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False,
+                    rand_mirror=False, mean=None, std=None, **kw):
+    """Build the standard augmenter list (reference signature subset)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop = (data_shape[2], data_shape[1])
+    auglist.append(RandomCropAug(crop) if rand_crop
+                   else CenterCropAug(crop))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+def ImageIter(*args, **kwargs):
+    """reference: image.ImageIter — RecordIO-backed image iterator."""
+    from .io import ImageRecordIter
+    return ImageRecordIter(*args, **kwargs)

@@ -1,0 +1,9 @@
+"""mx.recordio (reference: mxnet/recordio.py) — top-level re-export of
+the C++-backed RecordIO implementation in runtime/recordio."""
+from .runtime.recordio import (IRHeader, MXRecordIO, IndexedRecordIO,
+                               pack, unpack, pack_img, unpack_img)
+
+MXIndexedRecordIO = IndexedRecordIO  # reference class name
+
+__all__ = ["IRHeader", "MXRecordIO", "MXIndexedRecordIO",
+           "IndexedRecordIO", "pack", "unpack", "pack_img", "unpack_img"]

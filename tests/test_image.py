@@ -1,0 +1,62 @@
+"""mx.image legacy utilities (reference: mxnet/image/image.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+
+
+def _jpeg_bytes(w=32, h=24):
+    from PIL import Image
+    import io
+    rs = np.random.RandomState(0)
+    img = Image.fromarray(rs.randint(0, 255, (h, w, 3), np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_imdecode_and_resize():
+    img = mimg.imdecode(_jpeg_bytes())
+    assert img.shape == (24, 32, 3)
+    out = mimg.imresize(img, 16, 8)
+    assert out.shape == (8, 16, 3)
+    short = mimg.resize_short(img, 12)
+    assert min(short.shape[:2]) == 12
+
+
+def test_crops_and_normalize():
+    img = mx.nd.array(np.arange(24 * 32 * 3)
+                      .reshape(24, 32, 3).astype(np.float32))
+    c, rect = mimg.center_crop(img, (16, 12))
+    assert c.shape == (12, 16, 3) and rect[2:] == (16, 12)
+    r, _ = mimg.random_crop(img, (8, 8))
+    assert r.shape == (8, 8, 3)
+    n = mimg.color_normalize(img, mean=[1.0, 2.0, 3.0],
+                             std=[2.0, 2.0, 2.0])
+    np.testing.assert_allclose(
+        n.asnumpy()[0, 0], (img.asnumpy()[0, 0] - [1, 2, 3]) / 2.0)
+
+
+def test_augmenter_pipeline():
+    augs = mimg.CreateAugmenter(data_shape=(3, 12, 12), resize=16,
+                                rand_crop=True, rand_mirror=True,
+                                mean=[0.0, 0.0, 0.0],
+                                std=[255.0, 255.0, 255.0])
+    img = mimg.imdecode(_jpeg_bytes())
+    for a in augs:
+        img = a(img)
+    assert img.shape == (12, 12, 3)
+    assert float(img.asnumpy().max()) <= 1.0
+
+
+def test_recordio_toplevel_alias(tmp_path):
+    from mxnet_tpu import recordio as rio
+    p = str(tmp_path / "x.rec")
+    w = rio.MXRecordIO(p, "w")
+    hdr = rio.IRHeader(0, 3.0, 7, 0)
+    w.write(rio.pack(hdr, b"payload"))
+    w.close()
+    r = rio.MXRecordIO(p, "r")
+    hdr2, body = rio.unpack(r.read())
+    r.close()
+    assert body == b"payload" and hdr2.id == 7
