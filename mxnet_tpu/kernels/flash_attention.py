@@ -39,8 +39,10 @@ def reference_attention(q, k, v, causal=True, scale=None):
     return out.astype(q.dtype)
 
 
-def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256):
-    """Online-softmax flash forward in Pallas (TPU)."""
+def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
+                    interpret=False):
+    """Online-softmax flash forward in Pallas (TPU; interpret=True runs
+    the same kernel under the Pallas interpreter for CPU testing)."""
     from jax.experimental import pallas as pl
 
     B, T, H, d = q.shape
@@ -104,6 +106,7 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256):
         out_specs=pl.BlockSpec((None, block_q, None, d),
                                lambda b, h, i: (b, i, h, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
     )(q, k, v)
     return out
 
