@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import functools
 import math
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -39,20 +41,41 @@ def reference_attention(q, k, v, causal=True, scale=None):
     return out.astype(q.dtype)
 
 
+def _pick_block(T, want):
+    """Largest block <= want that divides T (the grid uses exact
+    tiling; a non-divisor block would leave tail rows unwritten)."""
+    b = max(1, min(want, T))
+    while T % b:
+        b //= 2
+    return b
+
+
+def _mask_causal(s, qi, ki, block_q, block_k):
+    """-inf upper-triangle mask for score block (qi, ki)."""
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+
 def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
-                    interpret=False):
+                    interpret=False, return_lse=False):
     """Online-softmax flash forward in Pallas (TPU; interpret=True runs
-    the same kernel under the Pallas interpreter for CPU testing)."""
+    the same kernel under the Pallas interpreter for CPU testing).
+
+    With return_lse=True also returns the per-row log-sum-exp (B, H, T)
+    that the O(T)-memory backward needs to recompute softmax blocks."""
     from jax.experimental import pallas as pl
 
     B, T, H, d = q.shape
     Kh = k.shape[2]
     rep = H // Kh
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(T, block_k)
     n_q = T // block_q
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
         # grid: (B, H, n_q). Block of Q rows vs full K/V sweep.
         qi = pl.program_id(2)
         qblk = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
@@ -63,17 +86,13 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
 
         def body(ki, carry):
             m_, l_, acc_ = carry
-            kblk = pl.load(k_ref, (pl.dslice(ki * block_k, block_k),
-                                   slice(None))).astype(jnp.float32)
-            vblk = pl.load(v_ref, (pl.dslice(ki * block_k, block_k),
-                                   slice(None))).astype(jnp.float32)
+            kblk = k_ref[pl.dslice(ki * block_k, block_k), :] \
+                .astype(jnp.float32)
+            vblk = v_ref[pl.dslice(ki * block_k, block_k), :] \
+                .astype(jnp.float32)
             s = qblk @ kblk.T  # (block_q, block_k)
             if causal:
-                qpos = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                kpos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(qpos >= kpos, s, -jnp.inf)
+                s = _mask_causal(s, qi, ki, block_q, block_k)
             m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
             p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
@@ -90,9 +109,13 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+        # rows with no unmasked keys get lse=+inf so exp(s - lse) == 0
+        # in the backward (cannot happen for full causal blocks, but
+        # keeps the kernel total for arbitrary masks)
+        lse_ref[...] = jnp.where(l > 0, m + jnp.log(safe_l), jnp.inf)
 
     grid = (B, H, n_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -103,47 +126,243 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
             pl.BlockSpec((None, T, None, d),
                          lambda b, h, i: (b, 0, h // rep, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, None, d),
-                               lambda b, h, i: (b, i, h, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, None, d),
+                         lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
-    return out
+    return (out, lse) if return_lse else out
+
+
+def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
+                     block_q=256, block_k=256, interpret=False):
+    """O(T)-memory flash backward: dQ/dK/dV via block recomputation
+    against the saved log-sum-exp — no (T, T) score matrix is ever
+    materialized. delta is rowsum(dO * O), shape (B, H, T).
+
+    dq kernel: one Q block vs a K/V sweep (same walk as forward).
+    dkv kernel: one K block vs a Q sweep, per *query* head; the GQA
+    group-sum over the rep query heads per kv head happens outside."""
+    from jax.experimental import pallas as pl
+
+    B, T, H, d = q.shape
+    Kh = k.shape[2]
+    rep = H // Kh
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(T, block_k)
+    n_q = T // block_q
+    n_k = T // block_k
+
+    def dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+                  dq_ref):
+        qi = pl.program_id(2)
+        qblk = q_ref[...].astype(jnp.float32)          # (block_q, d)
+        doblk = do_ref[...].astype(jnp.float32)
+        lseb = lse_ref[...].astype(jnp.float32)        # (block_q,)
+        deltb = delta_ref[...].astype(jnp.float32)
+
+        def body(ki, acc_):
+            kblk = k_ref[pl.dslice(ki * block_k, block_k), :] \
+                .astype(jnp.float32)
+            vblk = v_ref[pl.dslice(ki * block_k, block_k), :] \
+                .astype(jnp.float32)
+            s = (qblk @ kblk.T) * scale
+            if causal:
+                s = _mask_causal(s, qi, ki, block_q, block_k)
+            p = jnp.exp(s - lseb[:, None])             # 0 where masked
+            dp = doblk @ vblk.T
+            ds = p * (dp - deltb[:, None])
+            return acc_ + ds @ kblk
+
+        if causal:
+            upper = jnp.minimum(
+                n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+        else:
+            upper = n_k
+        acc = jax.lax.fori_loop(
+            0, upper, body, jnp.zeros((block_q, d), jnp.float32))
+        dq_ref[...] = (acc * scale).astype(dq_ref.dtype)
+
+    def dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
+                   dk_ref, dv_ref):
+        ki = pl.program_id(2)
+        kblk = k_ref[...].astype(jnp.float32)          # (block_k, d)
+        vblk = v_ref[...].astype(jnp.float32)
+
+        def body(qi, carry):
+            dk_, dv_ = carry
+            qblk = q_ref[pl.dslice(qi * block_q, block_q), :] \
+                .astype(jnp.float32)
+            doblk = do_ref[pl.dslice(qi * block_q, block_q), :] \
+                .astype(jnp.float32)
+            lseb = lse_ref[pl.dslice(qi * block_q, block_q)] \
+                .astype(jnp.float32)
+            deltb = delta_ref[pl.dslice(qi * block_q, block_q)] \
+                .astype(jnp.float32)
+            s = (qblk @ kblk.T) * scale                # (block_q, block_k)
+            if causal:
+                s = _mask_causal(s, qi, ki, block_q, block_k)
+            p = jnp.exp(s - lseb[:, None])
+            dv_ = dv_ + p.T @ doblk
+            dp = doblk @ vblk.T
+            ds = p * (dp - deltb[:, None])
+            dk_ = dk_ + ds.T @ qblk
+            return dk_, dv_
+
+        lower = (ki * block_k) // block_q if causal else 0
+        zeros = jnp.zeros((block_k, d), jnp.float32)
+        dk, dv = jax.lax.fori_loop(lower, n_q, body, (zeros, zeros))
+        dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
+        dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    qspec = pl.BlockSpec((None, block_q, None, d),
+                         lambda b, h, i: (b, i, h, 0))
+    full_q = pl.BlockSpec((None, T, None, d), lambda b, h, i: (b, 0, h, 0))
+    full_kv = pl.BlockSpec((None, T, None, d),
+                           lambda b, h, i: (b, 0, h // rep, 0))
+    row_blk = pl.BlockSpec((None, None, block_q), lambda b, h, i: (b, h, i))
+    row_full = pl.BlockSpec((None, None, T), lambda b, h, i: (b, h, 0))
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, n_q),
+        in_specs=[qspec, full_kv, full_kv, row_blk, row_blk, qspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, lse, delta, dout)
+
+    kspec = pl.BlockSpec((None, block_k, None, d),
+                         lambda b, h, i: (b, i, h // rep, 0))
+    dkv_out = pl.BlockSpec((None, block_k, None, d),
+                           lambda b, h, i: (b, i, h, 0))
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, n_k),
+        in_specs=[full_q, kspec, kspec, row_full, row_full, full_q],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, H, d), q.dtype),
+            jax.ShapeDtypeStruct((B, T, H, d), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, lse, delta, dout)
+    # GQA: query head h reads kv head h//rep, so sum each group of rep
+    # consecutive query heads back into its kv head
+    if rep > 1:
+        dk = dk_h.reshape(B, T, Kh, rep, d).sum(axis=3).astype(k.dtype)
+        dv = dv_h.reshape(B, T, Kh, rep, d).sum(axis=3).astype(v.dtype)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, use_flash):
-    return _flash_fwd_impl(q, k, v, causal, scale, use_flash)
+def _flash_pallas(q, k, v, causal, scale, interpret):
+    out, _ = _flash_pallas_fwd(q, k, v, causal, scale, interpret)
+    return out
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, use_flash):
-    if use_flash and q.shape[1] % 128 == 0 and \
-            jax.default_backend() not in ("cpu",):
-        try:
-            return _pallas_forward(q, k, v, causal, scale)
-        except Exception:
-            pass
+def _flash_pallas_fwd(q, k, v, causal, scale, interpret):
+    out, lse = _pallas_forward(q, k, v, causal, scale,
+                               interpret=interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_pallas_bwd(causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    # delta_i = rowsum(dO_i * O_i): the softmax-jacobian correction term
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)  # (B, H, T)
+    try:
+        return _pallas_backward(q, k, v, lse, delta, g.astype(q.dtype),
+                                causal, scale, interpret=interpret)
+    except Exception as e:
+        # same contract as the forward: never let a kernel regression
+        # crash training unless the user opted into strict mode
+        if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
+            raise
+        _note_fallback(e)
+        _, vjp = jax.vjp(lambda q_, k_, v_:
+                         reference_attention(q_, k_, v_, causal, scale),
+                         q, k, v)
+        return vjp(g)
+
+
+_flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_ref(q, k, v, causal, scale):
     return reference_attention(q, k, v, causal, scale)
 
 
-def _flash_fwd(q, k, v, causal, scale, use_flash):
-    out = _flash_fwd_impl(q, k, v, causal, scale, use_flash)
-    return out, (q, k, v)
+def _flash_ref_fwd(q, k, v, causal, scale):
+    # save only q/k/v; recompute the softmax in the backward instead of
+    # storing the (B, H, T, T) probability matrix
+    return reference_attention(q, k, v, causal, scale), (q, k, v)
 
 
-def _flash_bwd(causal, scale, use_flash, res, g):
+def _flash_ref_bwd(causal, scale, res, g):
     q, k, v = res
-    # backward via recompute against the reference impl (exact softmax)
     _, vjp = jax.vjp(lambda q_, k_, v_:
                      reference_attention(q_, k_, v_, causal, scale),
                      q, k, v)
     return vjp(g)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_ref.defvjp(_flash_ref_fwd, _flash_ref_bwd)
+
+
+#: number of times the Pallas path failed and fell back to the exact
+#: reference implementation (visible to the profiler / tests).
+FALLBACK_COUNT = 0
+_warned_fallback = False
+
+
+def _note_fallback(e):
+    global FALLBACK_COUNT, _warned_fallback
+    FALLBACK_COUNT += 1
+    if not _warned_fallback:
+        _warned_fallback = True
+        warnings.warn(
+            "Pallas flash-attention kernel failed; falling back to "
+            f"exact O(T^2) attention: {type(e).__name__}: {e}",
+            RuntimeWarning, stacklevel=3)
+
+
+def _pallas_mode(T):
+    """None (use reference), 'compiled', or 'interpret' (CPU testing of
+    the real kernels, enabled via MXNET_TPU_FLASH_INTERPRET=1)."""
+    if T % 128 != 0:
+        return None
+    if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
+        return "interpret"
+    if jax.default_backend() not in ("cpu",):
+        return "compiled"
+    return None
 
 
 def flash_attention_raw(q, k, v, causal=True, scale=None, use_flash=True):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash(q, k, v, causal, scale, use_flash)
+    mode = _pallas_mode(q.shape[1]) if use_flash else None
+    if mode is not None:
+        try:
+            return _flash_pallas(q, k, v, causal, scale,
+                                 mode == "interpret")
+        except Exception as e:
+            # fail loudly: a silently-degraded flash path hides O(T^2)
+            # perf regressions. MXNET_TPU_STRICT_FLASH=1 turns the
+            # fallback into an error; otherwise warn once and count.
+            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
+                raise
+            _note_fallback(e)
+    return _flash_ref(q, k, v, causal, scale)

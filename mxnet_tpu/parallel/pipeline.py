@@ -28,7 +28,8 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import current_mesh
 
-__all__ = ["stack_stage_params", "gpipe", "sequential_apply"]
+__all__ = ["stack_stage_params", "gpipe", "sequential_apply",
+           "one_f_one_b"]
 
 
 def stack_stage_params(params_list):
@@ -52,9 +53,12 @@ def sequential_apply(stage_fn, stacked_params, x):
 
 
 def _vary(x, axis_name):
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
-    return jax.lax.pvary(x, (axis_name,))
+    try:
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))
+    except ValueError:
+        return x  # already varying over axis_name
 
 
 def _gpipe_local(params, mbatches, stage_fn, axis_name):
@@ -91,6 +95,160 @@ def _gpipe_local(params, mbatches, stage_fn, axis_name):
         tick, (state0, out0), jnp.arange(M + n - 1))
     # broadcast the last stage's results to every pp shard
     return jax.lax.psum(outputs, axis_name)
+
+
+def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
+                axis_name):
+    """Per-device 1F1B schedule body (runs inside shard_map).
+
+    One scan tick = one forward micro-step AND one backward micro-step
+    per stage (interleaved steady state). Stage `idx` forwards
+    microbatch m at tick m + idx and backprops it at tick
+    m + 2(n-1) - idx, so at most 2(n-1-idx)+1 <= 2n-1 activations are
+    ever stashed per stage — bounded by the *stage count*, independent
+    of the microbatch count M. (GPipe under jax.grad stashes all M.)
+    The backward recomputes each stage forward from the stashed INPUT
+    (recompute-vjp), the standard trade on TPU where HBM, not FLOPs,
+    is the binding constraint.
+
+    Returns (loss_sum, grad_acc): loss summed over microbatches on the
+    last stage (zeros elsewhere), grads for this stage's params.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = mbatches.shape[0]
+    S = 2 * n - 1  # stash slots: max in-flight microbatches per stage
+    perm_up = [(i, i + 1) for i in range(n - 1)]
+    perm_down = [(i + 1, i) for i in range(n - 1)]
+
+    mb_shape = mbatches.shape[1:]
+    state0 = _vary(jnp.zeros(mb_shape, mbatches.dtype), axis_name)
+    cot0 = _vary(jnp.zeros(mb_shape, mbatches.dtype), axis_name)
+    stash0 = _vary(jnp.zeros((S,) + mb_shape, mbatches.dtype), axis_name)
+    grad0 = jax.tree_util.tree_map(
+        lambda p: _vary(jnp.zeros_like(p), axis_name), params)
+
+    def mb_loss(out, y):
+        return loss_fn(out, y)
+
+    def tick(carry, t):
+        state, cot_in, stash, grads, loss_acc = carry
+
+        # ---- forward half: stage idx forwards microbatch m_f = t - idx
+        m_f = t - idx
+        valid_f = jnp.logical_and(m_f >= 0, m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        feed = jax.lax.dynamic_index_in_dim(mbatches, m_f_c, 0,
+                                            keepdims=False)
+        inp = jnp.where(idx == 0, feed, state)
+        out = stage_fn(params, inp)
+        # stash the stage INPUT for recompute in the backward half
+        upd = jax.lax.dynamic_update_index_in_dim(
+            stash, inp, m_f_c % S, 0)
+        stash = jnp.where(valid_f, upd, stash)
+
+        # last stage: loss + its cotangent for the just-forwarded mb
+        y_f = jax.lax.dynamic_index_in_dim(ybatches, m_f_c, 0,
+                                           keepdims=False)
+        lval, dout_loss = jax.value_and_grad(mb_loss)(out, y_f)
+        is_last = idx == n - 1
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, valid_f), lval, 0.0)
+
+        # ---- backward half: stage idx backprops m_b = t - 2(n-1) + idx
+        m_b = t - 2 * (n - 1) + idx
+        valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        inp_b = jax.lax.dynamic_index_in_dim(stash, m_b_c % S, 0,
+                                             keepdims=False)
+        # cotangent: from the loss (last stage, same-tick mb) or from
+        # the next stage via the previous tick's ppermute
+        cot = jnp.where(is_last, dout_loss.astype(cot_in.dtype), cot_in)
+        _, vjp = jax.vjp(stage_fn, params, inp_b)
+        dparams, dinp = vjp(cot)
+        grads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(valid_b, d, 0.0), grads, dparams)
+
+        # shift: activations up, cotangents down
+        state = jax.lax.ppermute(out, axis_name, perm_up)
+        cot_out = jax.lax.ppermute(dinp, axis_name, perm_down)
+        return (state, cot_out, stash, grads, loss_acc), ()
+
+    total_ticks = M + 2 * (n - 1)
+    init = (state0, cot0, stash0, grad0,
+            _vary(jnp.zeros((), jnp.float32), axis_name))
+    (_, _, _, grads, loss_acc), _ = jax.lax.scan(
+        tick, init, jnp.arange(total_ticks))
+    return loss_acc, grads
+
+
+def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
+                num_microbatches, mesh=None, pp_axis="pp"):
+    """1F1B pipeline schedule: fused forward+backward with interleaved
+    microbatch backprop and an O(num_stages) activation stash.
+
+    Unlike `gpipe` (forward-only, differentiable via jax AD — which
+    stashes every microbatch's activations), this computes the loss AND
+    the parameter gradients in one pass:
+
+        loss, grads = one_f_one_b(stage_fn, params, x, y, loss_fn, M)
+
+    stage_fn: (stage_params, h) -> h, shape/dtype-preserving.
+    loss_fn: (out_mb, y_mb) -> scalar mean loss for one microbatch.
+    Returns (mean microbatch loss, grads pytree stacked like
+    `stacked_params` with the leading pp dim).
+
+    Reference analogue: upstream MXNet has no pipeline engine — this is
+    the TPU-first design the SURVEY §2 checklist promises (bubble ratio
+    (n-1)/(M+n-1), steady state 1 fwd + 1 bwd per tick per stage).
+
+    Without a mesh (or without a `pp` axis) it computes the same
+    quantities sequentially (exact reference semantics for tests).
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    mb = B // num_microbatches
+    mbatches = x.reshape(num_microbatches, mb, *x.shape[1:])
+    ybatches = y.reshape(num_microbatches, mb, *y.shape[1:])
+
+    if mesh is None or pp_axis not in mesh.axis_names:
+        def total(params):
+            def body(acc, mby):
+                mbx, mby_ = mby
+                out = sequential_apply(stage_fn, params, mbx)
+                return acc + loss_fn(out, mby_), ()
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  (mbatches, ybatches))
+            return acc / num_microbatches
+        loss, grads = jax.value_and_grad(total)(stacked_params)
+        return loss, grads
+
+    n = mesh.shape[pp_axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    assert leaves[0].shape[0] == n, \
+        f"{leaves[0].shape[0]} stages vs pp={n} shards"
+
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P(pp_axis, *([None] * (a.ndim - 1))), stacked_params)
+
+    def body(params, mbs, ybs):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        loss_sum, grads = _1f1b_local(params, mbs, ybs, stage_fn,
+                                      loss_fn, pp_axis)
+        # loss lives on the last stage only; share it with every shard
+        loss_sum = jax.lax.psum(loss_sum, pp_axis)
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss_sum, grads
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(param_specs, P(), P()),
+                   out_specs=(P(), param_specs))
+    loss_sum, grads = fn(stacked_params, mbatches, ybatches)
+    # per-microbatch cotangents were seeded unscaled; match the
+    # sequential reference's mean-over-microbatches loss
+    grads = jax.tree_util.tree_map(lambda g: g / num_microbatches, grads)
+    return loss_sum / num_microbatches, grads
 
 
 def gpipe(stage_fn, stacked_params, x, num_microbatches, mesh=None,

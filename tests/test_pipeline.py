@@ -82,3 +82,70 @@ def test_gpipe_no_mesh_fallback():
     out = gpipe(_stage_fn, params, x, 2, mesh=None)
     ref = sequential_apply(_stage_fn, params, x)
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def _mse_loss(out, y):
+    return ((out - y) ** 2).mean()
+
+
+@pytest.mark.parametrize("num_microbatches", [4, 8])
+def test_1f1b_matches_sequential_grads(pp_mesh, num_microbatches):
+    from mxnet_tpu.parallel.pipeline import one_f_one_b
+    params = _make_params(4, 6, 12, seed=8)
+    rs = np.random.RandomState(9)
+    B = 2 * num_microbatches
+    x = jnp.asarray(rs.rand(B, 6).astype(np.float32))
+    y = jnp.asarray(rs.rand(B, 6).astype(np.float32))
+
+    loss, grads = one_f_one_b(_stage_fn, params, x, y, _mse_loss,
+                              num_microbatches, mesh=pp_mesh)
+    loss_ref, grads_ref = one_f_one_b(_stage_fn, params, x, y, _mse_loss,
+                                      num_microbatches, mesh=None)
+    assert np.allclose(float(loss), float(loss_ref), atol=1e-5)
+    for k in grads_ref:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(grads_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_matches_autodiff(pp_mesh):
+    # cross-check the schedule against plain jax.grad of the sequential
+    # mean-microbatch loss
+    from mxnet_tpu.parallel.pipeline import one_f_one_b, sequential_apply
+    params = _make_params(4, 4, 8, seed=10)
+    rs = np.random.RandomState(11)
+    M, mb = 6, 3
+    x = jnp.asarray(rs.rand(M * mb, 4).astype(np.float32))
+    y = jnp.asarray(rs.rand(M * mb, 4).astype(np.float32))
+
+    def total(p):
+        outs = sequential_apply(_stage_fn, p,
+                                x.reshape(M * mb, 4))
+        return _mse_loss(outs.reshape(M, mb, 4),
+                         y.reshape(M, mb, 4))
+
+    g_ref = jax.grad(total)(params)
+    loss, grads = one_f_one_b(_stage_fn, params, x, y, _mse_loss, M,
+                              mesh=pp_mesh)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_under_jit(pp_mesh):
+    from mxnet_tpu.parallel.pipeline import one_f_one_b
+    params = _make_params(4, 4, 8, seed=12)
+    rs = np.random.RandomState(13)
+    x = jnp.asarray(rs.rand(8, 4).astype(np.float32))
+    y = jnp.asarray(rs.rand(8, 4).astype(np.float32))
+    f = jax.jit(lambda p, x_, y_: one_f_one_b(
+        _stage_fn, p, x_, y_, _mse_loss, 4, mesh=pp_mesh))
+    loss, grads = f(params, x, y)
+    loss_ref, grads_ref = one_f_one_b(_stage_fn, params, x, y,
+                                      _mse_loss, 4, mesh=None)
+    assert np.allclose(float(loss), float(loss_ref), atol=1e-5)
+    for k in grads_ref:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(grads_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
