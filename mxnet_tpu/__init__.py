@@ -40,6 +40,7 @@ from . import profiler
 from . import tracing
 from . import parallel
 from . import io
+from . import quantization
 from . import image
 from . import recordio
 from . import runtime

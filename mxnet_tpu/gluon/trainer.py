@@ -39,6 +39,8 @@ class Trainer:
         self._states: Dict[int, object] = {}
         self._kvstore: Optional[KVStore] = None
         self._kv_type = kvstore
+        self._compression_params = dict(compression_params) \
+            if compression_params else None
         self._update_on_kvstore = update_on_kvstore
         self._init_done = False
         self._scale = 1.0
@@ -56,6 +58,9 @@ class Trainer:
             # reference default: dist stores update on the store
             self._update_on_kvstore = self._kvstore.type.startswith("dist")
         if self._kvstore is not None:
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             for i, p in enumerate(self._params):
                 self._kvstore.init(i, p.data())
             if self._update_on_kvstore:

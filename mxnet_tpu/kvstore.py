@@ -57,7 +57,28 @@ class KVStore:
                 self._optimizer.create_state_multi_precision(
                     key, self._store[key])
 
-    def _aggregate(self, value):
+    def _compress(self, key, vals):
+        """Per-replica quantize-with-residual before aggregation
+        (reference: gradient_compression.cc quantizes worker pushes)."""
+        from .parallel.compression import (dequantize_2bit, quantize_2bit,
+                                           quantize_int8)
+        ctype = self._compression.get("type", "2bit")
+        thr = float(self._compression.get("threshold", 0.5))
+        res = self._residuals.setdefault(
+            key, [jnp.zeros(v.shape, jnp.float32) for v in vals])
+        out = []
+        for i, v in enumerate(vals):
+            g = v._data.astype(jnp.float32) + res[i]
+            if ctype == "2bit":
+                sent = dequantize_2bit(quantize_2bit(g, thr), thr)
+            else:  # int8
+                scale = jnp.maximum(jnp.max(jnp.abs(g)) / 127.0, 1e-30)
+                sent = quantize_int8(g, scale).astype(jnp.float32) * scale
+            res[i] = g - sent
+            out.append(NDArray(sent.astype(v._data.dtype), ctx=v.ctx))
+        return out
+
+    def _aggregate(self, value, key=None):
         """Sum grads from all local devices (reference: comm.cc Reduce)."""
         if isinstance(value, list):
             if isinstance(value[0], RowSparseNDArray):
@@ -65,10 +86,16 @@ class KVStore:
                 for v in value[1:]:
                     out = out + v
                 return out
+            if self._compression is not None and key is not None:
+                value = self._compress(key, value)
             total = value[0]._data
             for v in value[1:]:
                 total = total + v._data
             return NDArray(total, ctx=value[0].ctx)
+        if self._compression is not None and key is not None and \
+                isinstance(value, NDArray):
+            # single-replica push (Trainer._update path) compresses too
+            return self._compress(key, [value])[0]
         return value
 
     def push(self, key, value, priority=0):
@@ -76,7 +103,7 @@ class KVStore:
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
-        agg = self._aggregate(value)
+        agg = self._aggregate(value, key)
         if self._optimizer is not None:
             weight = self._store[key]
             self._opt_states[key] = self._optimizer.update(
@@ -107,7 +134,7 @@ class KVStore:
                 self.pushpull(k, value[i],
                               out[i] if out is not None else None, priority)
             return
-        agg = self._aggregate(value)
+        agg = self._aggregate(value, key)
         if self._optimizer is not None:
             self.push(key, agg, priority)
             if out is not None:
@@ -151,10 +178,19 @@ class KVStore:
         return capability in ("optimizer", "row_sparse_pull")
 
     def set_gradient_compression(self, compression_params):
-        """2-bit/fp16 gradient compression (reference: the PS-path option).
-        On TPU, EQuARX-style quantized allreduce (PAPERS.md) would live in
-        the collective itself; recorded here for API parity."""
-        self._compression = dict(compression_params)
+        """2-bit / int8 gradient compression with error feedback
+        (reference: src/kvstore/gradient_compression.cc). Eager pushes
+        quantize each replica's gradient before aggregation; the fused
+        mesh path quantizes the allreduce itself
+        (parallel/compression.py, FusedTrainStep(compression=...))."""
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype not in ("2bit", "int8"):
+            raise ValueError(
+                f"unsupported compression type {ctype!r} "
+                "(supported: '2bit', 'int8')")
+        self._compression = params
+        self._residuals = {}
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         import pickle

@@ -1,0 +1,89 @@
+"""Gradient compression for the data-parallel collective path.
+
+Reference parity: src/kvstore/gradient_compression.cc (2-bit quantization
+on the parameter-server push path). TPU-first redesign: compression wraps
+the *allreduce itself* — each device quantizes its local gradient, the
+psum rides ICI on small codes, and dequantization happens after the
+reduce (EQuARX-style quantized allreduce; see PAPERS.md). Error feedback
+keeps the quantization residual on-device and folds it into the next
+step's gradient, which is what makes low-bit schemes converge.
+
+Schemes:
+  * "2bit"  — the reference's algorithm: values beyond +-threshold send
+    +-threshold, everything else sends 0; the un-sent remainder becomes
+    the residual. Codes are {-1, 0, +1} so the wire format is 2 bits.
+  * "int8"  — linear quantization with a psum-shared fp32 scale
+    (pmax of |g|/127), codes are int8, summed in int32.
+
+Both return the *mean* over the `dp` axis (matching what XLA's implicit
+backward allreduce produces for a mean loss).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["compressed_psum", "compressed_psum_tree", "quantize_2bit",
+           "dequantize_2bit", "quantize_int8"]
+
+
+def quantize_2bit(x, threshold):
+    """{-1, 0, +1} codes: +-1 where |x| crosses the threshold."""
+    pos = (x > threshold).astype(jnp.int8)
+    neg = (x < -threshold).astype(jnp.int8)
+    return pos - neg
+
+
+def dequantize_2bit(codes, threshold):
+    return codes.astype(jnp.float32) * threshold
+
+
+def quantize_int8(x, scale):
+    """Linear int8 codes for a given (shared) fp32 scale."""
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+def compressed_psum(grad, residual, axis_name, scheme="2bit",
+                    threshold=0.5):
+    """Quantize -> psum -> dequantize one gradient with error feedback.
+
+    grad: this device's local fp32 gradient (inside shard_map).
+    residual: carried quantization error from the previous step.
+    Returns (mean-reduced gradient, new residual).
+    """
+    g = grad.astype(jnp.float32) + residual
+    n = lax.psum(1, axis_name)
+    if scheme == "2bit":
+        codes = quantize_2bit(g, threshold)
+        sent = dequantize_2bit(codes, threshold)
+        # int8 codes in [-1,1]; summing over <=127 devices fits int8,
+        # but accumulate in int32 to be safe at any scale
+        total = lax.psum(codes.astype(jnp.int32), axis_name)
+        reduced = total.astype(jnp.float32) * threshold / n
+    elif scheme == "int8":
+        # share one scale so codes from different devices are summable
+        amax = lax.pmax(jnp.max(jnp.abs(g)), axis_name)
+        scale = jnp.maximum(amax / 127.0, 1e-30)
+        codes = quantize_int8(g, scale)
+        sent = codes.astype(jnp.float32) * scale
+        total = lax.psum(codes.astype(jnp.int32), axis_name)
+        reduced = total.astype(jnp.float32) * scale / n
+    else:
+        raise ValueError(f"unknown compression scheme {scheme!r}")
+    new_residual = g - sent
+    return reduced, new_residual
+
+
+def compressed_psum_tree(grads, residuals, axis_name, scheme="2bit",
+                         threshold=0.5):
+    """Apply compressed_psum leaf-wise over a gradient pytree."""
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_r = treedef.flatten_up_to(residuals)
+    out_g, out_r = [], []
+    for g, r in zip(flat_g, flat_r):
+        rg, nr = compressed_psum(g, r, axis_name, scheme, threshold)
+        out_g.append(rg)
+        out_r.append(nr)
+    return (jax.tree_util.tree_unflatten(treedef, out_g),
+            jax.tree_util.tree_unflatten(treedef, out_r))

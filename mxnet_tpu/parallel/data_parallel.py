@@ -147,13 +147,16 @@ class FusedTrainStep:
 
     def __init__(self, net, loss_fn, trainer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", donate: bool = True,
-                 n_model_inputs: int = 1, grad_accum: int = 1):
+                 n_model_inputs: int = 1, grad_accum: int = 1,
+                 compression=None):
         from ..gluon.trainer import Trainer
         self.net = net
         self.loss_fn = loss_fn
         if isinstance(trainer, Trainer):
             self.optimizer = trainer._optimizer
             self._trainer = trainer
+            if compression is None:
+                compression = trainer._compression_params
         else:
             self.optimizer = trainer
             self._trainer = None
@@ -162,11 +165,16 @@ class FusedTrainStep:
         self.donate = donate
         self.n_model_inputs = n_model_inputs
         self.grad_accum = grad_accum
+        # {"type": "2bit"|"int8", "threshold": float} — quantized
+        # allreduce with error feedback (reference:
+        # src/kvstore/gradient_compression.cc; see parallel/compression)
+        self.compression = dict(compression) if compression else None
         self._compiled = None
         self._params = None
         self._tr = None
         self._aux = None
         self._states = None
+        self._resid = None
         self._step_count = 0
 
     # -- state pull/push ----------------------------------------------------
@@ -233,56 +241,69 @@ class FusedTrainStep:
 
         accum = self.grad_accum
 
-        def step(tr, aux, states, hyper, key, *batch):
-            def loss_of(tr_, aux_, key_, batch_):
-                flat, new_aux = entry.raw_fn(tr_, aux_, key_,
-                                             *batch_[:n_in])
-                outs = jax.tree_util.tree_unflatten(
-                    treedef_box.out_treedef,
-                    [NDArray(f) for f in flat])
-                with autograd._mode(False, True), _random.trace_key(
-                        jax.random.fold_in(key_, 7)):
-                    labels = [NDArray(b) for b in batch_[n_in:]]
-                    l = loss_fn(outs, *labels) if not isinstance(
-                        outs, tuple) else loss_fn(*outs, *labels)
-                    l = l.mean()
-                return l._data.astype(jnp.float32), new_aux
+        def loss_of(tr_, aux_, key_, batch_):
+            flat, new_aux = entry.raw_fn(tr_, aux_, key_,
+                                         *batch_[:n_in])
+            outs = jax.tree_util.tree_unflatten(
+                treedef_box.out_treedef,
+                [NDArray(f) for f in flat])
+            with autograd._mode(False, True), _random.trace_key(
+                    jax.random.fold_in(key_, 7)):
+                labels = [NDArray(b) for b in batch_[n_in:]]
+                l = loss_fn(outs, *labels) if not isinstance(
+                    outs, tuple) else loss_fn(*outs, *labels)
+                l = l.mean()
+            return l._data.astype(jnp.float32), new_aux
 
+        def local_grads(tr, aux, key, batch):
             if accum <= 1:
                 (loss, new_aux), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(tr, aux, key, batch)
-            else:
-                # microbatch scan: split the batch dim by `accum`,
-                # accumulate grads in fp32, one optimizer update at the
-                # end — the remat-friendly way to grow effective batch
-                # without growing activation memory
-                micro = tuple(
-                    b.reshape(accum, b.shape[0] // accum, *b.shape[1:])
-                    for b in batch)
-                keys = jax.random.split(key, accum)
+                return loss, new_aux, grads
+            # microbatch scan: split the batch dim by `accum`,
+            # accumulate grads in fp32, one optimizer update at the
+            # end — the remat-friendly way to grow effective batch
+            # without growing activation memory
+            micro = tuple(
+                b.reshape(accum, b.shape[0] // accum, *b.shape[1:])
+                for b in batch)
+            keys = jax.random.split(key, accum)
 
-                def body(carry, xs):
-                    aux_c, gacc, lacc = carry
-                    key_i, mb = xs
-                    (l, new_aux_c), g = jax.value_and_grad(
-                        loss_of, has_aux=True)(tr, aux_c, key_i, mb)
-                    gacc = jax.tree_util.tree_map(
-                        lambda a, b_: a + b_.astype(a.dtype), gacc, g)
-                    return (new_aux_c, gacc, lacc + l), None
+            def body(carry, xs):
+                aux_c, gacc, lacc = carry
+                key_i, mb = xs
+                (l, new_aux_c), g = jax.value_and_grad(
+                    loss_of, has_aux=True)(tr, aux_c, key_i, mb)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, b_: a + b_.astype(a.dtype), gacc, g)
+                return (new_aux_c, gacc, lacc + l), None
 
-                g0 = jax.tree_util.tree_map(
-                    lambda w: jnp.zeros(w.shape, jnp.float32), tr)
-                (new_aux, gsum, lsum), _ = lax.scan(
-                    body, (aux, g0, jnp.float32(0.0)), (keys, micro))
-                grads = jax.tree_util.tree_map(lambda g_: g_ / accum,
-                                               gsum)
-                loss = lsum / accum
+            g0 = jax.tree_util.tree_map(
+                lambda w: jnp.zeros(w.shape, jnp.float32), tr)
+            (new_aux, gsum, lsum), _ = lax.scan(
+                body, (aux, g0, jnp.float32(0.0)), (keys, micro))
+            grads = jax.tree_util.tree_map(lambda g_: g_ / accum, gsum)
+            return lsum / accum, new_aux, grads
+
+        def step(tr, aux, states, hyper, key, *batch):
+            loss, new_aux, grads = local_grads(tr, aux, key, batch)
             new_tr, new_states = {}, {}
             for n in tr_names:
                 new_tr[n], new_states[n] = opt._step(
                     tr[n], grads[n], states[n], hyper)
             return loss, new_tr, new_aux, new_states
 
+        if self.compression is not None:
+            if self.mesh is not None and \
+                    self.dp_axis in self.mesh.axis_names:
+                self._build_compressed(args, local_grads, tr_names,
+                                       aux_names)
+                return
+            import warnings
+            warnings.warn(
+                "gradient compression requested but there is no mesh "
+                f"with a {self.dp_axis!r} axis — training uncompressed",
+                RuntimeWarning, stacklevel=3)
         if self.mesh is not None:
             mesh = self.mesh
             repl = NamedSharding(mesh, P())
@@ -314,6 +335,78 @@ class FusedTrainStep:
         self._tr_names = tr_names
         self._aux_names = aux_names
 
+    def _build_compressed(self, args, local_grads, tr_names, aux_names):
+        """Quantized-allreduce variant: the step runs inside shard_map
+        over the dp axis so the gradient sync is an *explicit* collective
+        we can quantize (psum of int codes + error feedback) instead of
+        the implicit fp32 AllReduce XLA inserts in the backward. Pure
+        data parallelism only — parameters must be unsharded."""
+        from jax import shard_map
+        from .compression import compressed_psum_tree
+
+        for n in tr_names:
+            if self._params[n].sharding is not None:
+                raise ValueError(
+                    "gradient compression supports pure data parallelism; "
+                    f"parameter {n!r} carries a TP sharding")
+        mesh = self.mesh
+        dp = self.dp_axis
+        ndp = mesh.shape[dp]
+        scheme = self.compression.get("type", "2bit")
+        threshold = float(self.compression.get("threshold", 0.5))
+        opt = self.optimizer
+
+        def step(tr, aux, states, hyper, key, resid, *batch):
+            # distinct dropout keys per dp shard
+            key = jax.random.fold_in(key, lax.axis_index(dp))
+            resid = jax.tree_util.tree_map(lambda r: r[0], resid)
+            loss, new_aux, grads = local_grads(tr, aux, key, batch)
+            grads, new_resid = compressed_psum_tree(
+                grads, resid, dp, scheme, threshold)
+            loss = lax.pmean(loss, dp)
+            # aux (e.g. BatchNorm running stats) computed on the local
+            # shard: average across replicas like the fp32 path would
+            new_aux = {n: lax.pmean(v, dp)
+                       if jnp.issubdtype(v.dtype, jnp.inexact)
+                       else lax.pmax(v, dp) for n, v in new_aux.items()}
+            new_tr, new_states = {}, {}
+            for n in tr_names:
+                new_tr[n], new_states[n] = opt._step(
+                    tr[n], grads[n], states[n], hyper)
+            return (loss, new_tr, new_aux, new_states,
+                    jax.tree_util.tree_map(lambda r: r[None], new_resid))
+
+        batch_specs = tuple(split_batch_spec(
+            _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp)
+            for a in args)
+        fn = shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(dp), *batch_specs),
+            out_specs=(P(), P(), P(), P(), P(dp)))
+        self._compiled = jax.jit(
+            fn, donate_argnums=(0, 2, 5) if self.donate else ())
+        repl = NamedSharding(mesh, P())
+        self._tr = {n: jax.device_put(v, repl)
+                    for n, v in self._tr.items()}
+        self._aux = {n: jax.device_put(v, repl)
+                     for n, v in self._aux.items()}
+        self._states = jax.device_put(self._states, repl)
+        self._resid = {
+            n: jax.device_put(
+                jnp.zeros((ndp,) + tuple(self._tr[n].shape), jnp.float32),
+                NamedSharding(mesh, P(dp)))
+            for n in tr_names}
+        self._batch_sh = tuple(
+            NamedSharding(mesh, spec) for spec in batch_specs)
+        # checkpoint restore reads these to re-place restored state
+        self._tr_sh = {n: repl for n in tr_names}
+        self._aux_sh = {n: repl for n in aux_names}
+        self._st_sh = {n: jax.tree_util.tree_map(lambda _: repl,
+                                                 self._states[n])
+                       for n in tr_names}
+        self._tr_names = tr_names
+        self._aux_names = aux_names
+
     # -- execution ------------------------------------------------------------
     def __call__(self, *args) -> NDArray:
         if self._params is None:
@@ -336,6 +429,12 @@ class FusedTrainStep:
                    for r, sh in zip(raw, self._batch_sh)]
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):
-            loss, self._tr, self._aux, self._states = self._compiled(
-                self._tr, self._aux, self._states, hyper, key, *raw)
+            if self._resid is not None:
+                (loss, self._tr, self._aux, self._states,
+                 self._resid) = self._compiled(
+                    self._tr, self._aux, self._states, hyper, key,
+                    self._resid, *raw)
+            else:
+                loss, self._tr, self._aux, self._states = self._compiled(
+                    self._tr, self._aux, self._states, hyper, key, *raw)
         return NDArray(loss)

@@ -1,0 +1,213 @@
+"""Post-training int8 quantization (PTQ) for inference.
+
+Reference parity: the fork's quantization stack
+(src/operator/quantization/, example/quantization/,
+contrib.quantization.quantize_net): calibrate activation ranges on a few
+batches, then replace Dense/Conv with int8 versions. TPU-first redesign:
+the int8 compute is `lax.dot_general` / `lax.conv_general_dilated` with
+`preferred_element_type=int32` — the MXU multiplies int8 operands at
+full throughput and accumulates exactly in int32; scales are applied as
+a cheap fp32 epilogue that XLA fuses. Weights use per-output-channel
+scales, activations per-tensor scales from calibration (max mode).
+
+    qnet = quantize_net(net, calib_data=[x1, x2, ...])
+    y = qnet(x)                      # int8 matmuls inside
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import nd
+from .gluon.block import HybridBlock
+from .gluon.nn.basic_layers import Dense
+from .gluon.nn.conv_layers import _Conv
+from .ndarray import NDArray
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "calibrate"]
+
+
+def _quantize_weight(w, out_axis):
+    """Per-output-channel symmetric int8 codes + fp32 scales."""
+    red = tuple(i for i in range(w.ndim) if i != out_axis)
+    amax = jnp.max(jnp.abs(w), axis=red, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-30)
+    codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return codes, scale.astype(jnp.float32)
+
+
+def _quantize_act(x, scale):
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense: activation and weight quantized, int32 accumulation.
+    Built from a calibrated fp32 Dense by quantize_net."""
+
+    def __init__(self, dense: Dense, act_amax: float, **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data()._data.astype(jnp.float32)  # (units, in)
+        self._wq, wscale = _quantize_weight(w, out_axis=0)
+        self._wscale = wscale.reshape(-1)                  # (units,)
+        self._in_scale = jnp.float32(max(act_amax / 127.0, 1e-30))
+        self._bias = dense.bias.data()._data.astype(jnp.float32) \
+            if dense.bias is not None else None
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._activation = dense._activation
+
+    def forward(self, x):
+        data = x._data
+        if self._flatten and data.ndim > 2:
+            data = data.reshape(data.shape[0], -1)
+        xq = _quantize_act(data.astype(jnp.float32), self._in_scale)
+        acc = lax.dot_general(
+            xq, self._wq,
+            dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (self._in_scale * self._wscale)
+        if self._bias is not None:
+            y = y + self._bias
+        out = NDArray(y.astype(x.dtype))
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 Conv2D (NHWC or NCHW, groups=1), int32 accumulation."""
+
+    def __init__(self, conv: _Conv, act_amax: float, **kwargs):
+        super().__init__(**kwargs)
+        layout = conv._layout
+        rhs = {"NCHW": "OIHW", "NHWC": "HWIO"}[layout]
+        w = conv.weight.data()._data.astype(jnp.float32)
+        self._wq, wscale = _quantize_weight(w, out_axis=rhs.index("O"))
+        self._wscale = wscale.reshape(-1)                  # (channels,)
+        self._in_scale = jnp.float32(max(act_amax / 127.0, 1e-30))
+        self._bias = conv.bias.data()._data.astype(jnp.float32) \
+            if conv.bias is not None else None
+        self._layout = layout
+        self._dn = (layout, rhs, layout)
+        self._strides = conv._strides
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._activation = conv._activation
+
+    def forward(self, x):
+        data = x._data
+        xq = _quantize_act(data.astype(jnp.float32), self._in_scale)
+        acc = lax.conv_general_dilated(
+            xq, self._wq, window_strides=self._strides,
+            padding=[(p, p) for p in self._padding],
+            rhs_dilation=self._dilation,
+            dimension_numbers=self._dn,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (self._in_scale * self._wscale
+                                       if self._layout == "NHWC"
+                                       else (self._in_scale *
+                                             self._wscale)[:, None, None])
+        if self._bias is not None:
+            y = y + (self._bias if self._layout == "NHWC"
+                     else self._bias[:, None, None])
+        out = NDArray(y.astype(x.dtype))
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+def _quantizable(block):
+    if isinstance(block, Dense):
+        return True
+    if isinstance(block, _Conv):
+        return (not block._transpose and block._groups == 1
+                and len(block._layout) == 4)
+    return False
+
+
+def calibrate(net, calib_data: List) -> Dict[int, float]:
+    """Run calibration batches through the fp32 net recording each
+    quantizable layer's input |max| (reference: calib_mode='naive').
+    Returns {id(block): amax}."""
+    stats: Dict[int, float] = {}
+    handles = []
+
+    # hybridized blocks route through the jit cache and skip forward
+    # hooks (and would feed tracers to them) — calibrate eagerly
+    def dehybridize(block):
+        if getattr(block, "_active", False):
+            block.hybridize(False)
+        for c in block._children.values():
+            dehybridize(c)
+
+    dehybridize(net)
+
+    def make_hook(blk):
+        def hook(b, args):
+            x = args[0]
+            amax = float(jnp.max(jnp.abs(
+                x._data if isinstance(x, NDArray) else x)))
+            stats[id(blk)] = max(stats.get(id(blk), 0.0), amax)
+        return hook
+
+    def attach(block):
+        if _quantizable(block):
+            block._forward_pre_hooks.append(make_hook(block))
+            handles.append(block)
+        for c in block._children.values():
+            attach(c)
+
+    attach(net)
+    from . import autograd
+    with autograd.pause():
+        for batch in calib_data:
+            net(batch if isinstance(batch, NDArray) else nd.array(batch))
+    for blk in handles:
+        blk._forward_pre_hooks.pop()
+    return stats
+
+
+def quantize_net(net, calib_data: Optional[List] = None,
+                 quantized_dtype: str = "int8", calib_mode: str = "naive",
+                 exclude: Optional[List] = None):
+    """Quantize a trained net in place for int8 inference.
+
+    calib_data: list of representative input batches (NDArray/array).
+    quantized_dtype: only 'int8'/'auto' (the MXU-native narrow type).
+    calib_mode: only 'naive' (abs-max); 'entropy' is not implemented.
+    exclude: blocks (instances) to leave in fp32.
+    """
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError(f"unsupported quantized_dtype {quantized_dtype!r}")
+    if calib_mode != "naive":
+        raise ValueError(
+            f"calib_mode {calib_mode!r} not supported (use 'naive')")
+    if not calib_data:
+        raise ValueError("calib_data batches are required for PTQ")
+    excluded = set(id(b) for b in (exclude or []))
+    stats = calibrate(net, calib_data)
+
+    def replace(block):
+        for name, child in list(block._children.items()):
+            if _quantizable(child) and id(child) not in excluded \
+                    and id(child) in stats:
+                if isinstance(child, Dense):
+                    q = QuantizedDense(child, stats[id(child)])
+                else:
+                    q = QuantizedConv2D(child, stats[id(child)])
+                block._children[name] = q
+                # attribute-registered children need the attr updated too
+                for attr, val in list(block.__dict__.items()):
+                    if val is child:
+                        object.__setattr__(block, attr, q)
+            else:
+                replace(child)
+
+    replace(net)
+    return net

@@ -1,0 +1,118 @@
+"""int8 PTQ: calibrated quantized inference within 1% of fp32 accuracy
+(reference: src/operator/quantization/, contrib.quantization.quantize_net)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.quantization import (QuantizedConv2D, QuantizedDense,
+                                    quantize_net)
+
+
+def _toy_images(n=256, classes=3, seed=0):
+    """Linearly separable 16x16 single-channel images (LeNet's unpadded
+    5x5 conv needs >= 16px input)."""
+    rs = np.random.RandomState(seed)
+    proto = rs.rand(classes, 16, 16, 1).astype(np.float32)
+    y = rs.randint(0, classes, n)
+    X = proto[y] + 0.15 * rs.rand(n, 16, 16, 1).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.int32)
+
+
+def _accuracy(net, X, y):
+    out = net(mx.nd.array(X)).asnumpy()
+    return float((out.argmax(axis=1) == y).mean())
+
+
+def test_quantized_dense_matches_fp32():
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(16, in_units=32)
+    net.initialize()
+    X = np.random.RandomState(1).randn(8, 32).astype(np.float32)
+    ref = net(mx.nd.array(X)).asnumpy()
+    q = QuantizedDense(net, act_amax=float(np.abs(X).max()))
+    out = q(mx.nd.array(X)).asnumpy()
+    # int8 matmul should agree to ~1% relative scale
+    assert np.max(np.abs(out - ref)) < 0.05 * np.abs(ref).max()
+
+
+def test_quantize_net_lenet_accuracy_within_1pct():
+    X, y = _toy_images()
+    mx.random.seed(0)
+    net = mx.models.get_model("lenet", classes=3, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 5e-3})
+    xs, ys = mx.nd.array(X), mx.nd.array(y)
+    for _ in range(60):
+        with mx.autograd.record():
+            l = loss_fn(net(xs), ys).mean()
+        l.backward()
+        tr.step(1)
+    acc_fp32 = _accuracy(net, X, y)
+    assert acc_fp32 > 0.9, acc_fp32
+
+    calib = [mx.nd.array(X[i * 32:(i + 1) * 32]) for i in range(3)]
+    qnet = quantize_net(net, calib_data=calib)
+    # every Dense/Conv2D replaced
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "Dense" not in kinds and "Conv2D" not in kinds, kinds
+    assert any(k == "QuantizedDense" for k in kinds)
+    assert any(k == "QuantizedConv2D" for k in kinds)
+
+    acc_q = _accuracy(qnet, X, y)
+    assert acc_q >= acc_fp32 - 0.01, (acc_fp32, acc_q)
+
+
+def test_quantized_net_hybridizes():
+    X, _ = _toy_images(n=16)
+    mx.random.seed(1)
+    net = mx.models.get_model("lenet", classes=3, layout="NHWC")
+    net.initialize()
+    net(mx.nd.array(X[:4]))  # materialize
+    qnet = quantize_net(net, calib_data=[mx.nd.array(X)])
+    eager = qnet(mx.nd.array(X[:4])).asnumpy()
+    qnet.hybridize()
+    hyb = qnet(mx.nd.array(X[:4])).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_net_validates_args():
+    net = mx.gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    with pytest.raises(ValueError):
+        quantize_net(net, calib_data=[mx.nd.ones((2, 4))],
+                     quantized_dtype="int4")
+    with pytest.raises(ValueError):
+        quantize_net(net, calib_data=None)
+    with pytest.raises(ValueError):
+        quantize_net(net, calib_data=[mx.nd.ones((2, 4))],
+                     calib_mode="entropy")
+
+
+def test_exclude_keeps_layer_fp32():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+            mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    last = net._children["1"]
+    qnet = quantize_net(net, calib_data=[mx.nd.ones((2, 4))],
+                        exclude=[last])
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ["QuantizedDense", "Dense"], kinds
+
+
+def test_quantize_net_on_hybridized_net():
+    # hybridized nets bypass forward hooks; quantize_net must calibrate
+    # eagerly instead of silently returning the fp32 net
+    X, _ = _toy_images(n=16)
+    mx.random.seed(2)
+    net = mx.models.get_model("lenet", classes=3, layout="NHWC")
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.array(X[:4]))  # warm the jit cache
+    qnet = quantize_net(net, calib_data=[mx.nd.array(X)])
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "QuantizedDense" in kinds, kinds
