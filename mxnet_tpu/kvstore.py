@@ -62,18 +62,21 @@ class KVStore:
         (reference: gradient_compression.cc quantizes worker pushes)."""
         from .parallel.compression import (dequantize_2bit, quantize_2bit,
                                            quantize_int8)
+        from .parallel.compression import int8_dequantized
         ctype = self._compression.get("type", "2bit")
         thr = float(self._compression.get("threshold", 0.5))
-        res = self._residuals.setdefault(
-            key, [jnp.zeros(v.shape, jnp.float32) for v in vals])
+        res = self._residuals.setdefault(key, [])
+        # replica count may change between pushes (device hot-plug /
+        # list-vs-single push styles): grow the residual list on demand
+        while len(res) < len(vals):
+            res.append(jnp.zeros(vals[len(res)].shape, jnp.float32))
         out = []
         for i, v in enumerate(vals):
             g = v._data.astype(jnp.float32) + res[i]
             if ctype == "2bit":
                 sent = dequantize_2bit(quantize_2bit(g, thr), thr)
             else:  # int8
-                scale = jnp.maximum(jnp.max(jnp.abs(g)) / 127.0, 1e-30)
-                sent = quantize_int8(g, scale).astype(jnp.float32) * scale
+                sent = int8_dequantized(g)
             res[i] = g - sent
             out.append(NDArray(sent.astype(v._data.dtype), ctx=v.ctx))
         return out
@@ -104,6 +107,10 @@ class KVStore:
                 self.push(k, v, priority)
             return
         agg = self._aggregate(value, key)
+        self._apply_aggregate(key, agg)
+
+    def _apply_aggregate(self, key, agg):
+        """Apply an already-aggregated (and already-compressed) value."""
         if self._optimizer is not None:
             weight = self._store[key]
             self._opt_states[key] = self._optimizer.update(
@@ -136,7 +143,9 @@ class KVStore:
             return
         agg = self._aggregate(value, key)
         if self._optimizer is not None:
-            self.push(key, agg, priority)
+            # agg is already aggregated+compressed: applying it via
+            # push() would quantize it a second time
+            self._apply_aggregate(key, agg)
             if out is not None:
                 self.pull(key, out, priority)
             return
@@ -210,6 +219,32 @@ class KVStore:
         waitall()
 
 
+class AsyncKVStore(KVStore):
+    """'dist_async' — stale, per-replica updates (reference: the async
+    parameter server). Where the sync store aggregates every replica's
+    gradient and applies ONE optimizer update, the async store applies
+    the optimizer once per replica push, in arrival order, with no
+    aggregation barrier — each update sees whatever weights the previous
+    ones left (single-process model of PS staleness; multi-process
+    arrival order comes from the host threads driving the pushes)."""
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        if self._optimizer is None or not isinstance(value, list):
+            super().push(key, value, priority)
+            return
+        for i, v in enumerate(value):
+            # one stale update per replica, no aggregation
+            if self._compression is not None:
+                v = self._compress((key, i), [v])[0]
+            weight = self._store[key]
+            self._opt_states[key] = self._optimizer.update(
+                key, weight, v, self._opt_states.get(key))
+
+
 class TPUSyncKVStore(KVStore):
     """'tpu_sync' — synchronous data parallelism over the device mesh.
 
@@ -236,5 +271,5 @@ def create(name: str = "local") -> KVStore:
                 "dist_device_sync", "horovod"):
         return TPUSyncKVStore(name)
     if name == "dist_async":
-        return KVStore(name)  # single-process: degenerates to local PS
+        return AsyncKVStore(name)
     raise ValueError(f"unknown kvstore type {name!r}")

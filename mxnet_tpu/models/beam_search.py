@@ -54,18 +54,32 @@ def beam_search_translate(net, src, bos_id: int, eos_id: int,
     K = beam_size
     scorer = BeamSearchScorer(alpha=alpha)
 
+    if src_valid_len is not None:
+        raw_vl = (src_valid_len._data
+                  if isinstance(src_valid_len, NDArray)
+                  else jnp.asarray(src_valid_len)).astype(jnp.int32)
+    else:
+        raw_vl = None
+
     # trace the full decoder forward once as a pure fn of (params, ...)
     import mxnet_tpu as mx
     proto_tgt = NDArray(jnp.zeros((B, max_len), jnp.int32))
     proto_src = NDArray(raw_src)
-    entry = net.trace_entry([proto_src, proto_tgt], training=False)
+    proto_args = [proto_src, proto_tgt]
+    if raw_vl is not None:
+        proto_args.append(NDArray(raw_vl))
+    entry = net.trace_entry(proto_args, training=False)
     params = net.collect_params()
     tr = {n: params[n].data()._data for n in entry.tr_names}
     aux = {n: params[n].data()._data for n in entry.aux_names}
     key = jax.random.PRNGKey(0)
 
+    # valid-len repeated per beam so padded source positions stay masked
+    vl_rep = jnp.repeat(raw_vl, K, axis=0) if raw_vl is not None else None
+
     def logits_fn(src_rep, tgt_buf):
-        flat, _ = entry.raw_fn(tr, aux, key, src_rep, tgt_buf)
+        extra = (vl_rep,) if vl_rep is not None else ()
+        flat, _ = entry.raw_fn(tr, aux, key, src_rep, tgt_buf, *extra)
         return flat[0]  # (B*K, max_len, V)
 
     src_rep = jnp.repeat(raw_src, K, axis=0)  # (B*K, S)

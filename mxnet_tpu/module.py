@@ -71,6 +71,17 @@ class Module(BaseModule):
         label_shapes = _as_desc_list(label_shapes)
         shape_env = {d.name: tuple(d.shape) for d in data_shapes}
         shape_env.update({d.name: tuple(d.shape) for d in label_shapes})
+        batch = data_shapes[0].shape[0]
+        # predict-only binding (reference: bind without label_shapes):
+        # label variables are not parameters — give them a (batch,)
+        # placeholder; ops like SoftmaxOutput ignore the label in
+        # forward, which is all a for_training=False executor runs.
+        # Training still requires real label shapes (a zero placeholder
+        # would silently train against class-0 labels).
+        if not for_training:
+            for name in self._label_names:
+                if name not in shape_env:
+                    shape_env[name] = (batch,)
         args = self._symbol.list_arguments()
         self._param_names = [a for a in args
                              if a not in shape_env]

@@ -44,6 +44,14 @@ def quantize_int8(x, scale):
     return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
 
 
+def int8_dequantized(x):
+    """Symmetric per-tensor int8 quantize->dequantize round trip
+    (abs-max/127 scale) — the single definition of the int8 rule that
+    kvstore and quantization share."""
+    scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-30)
+    return quantize_int8(x, scale).astype(jnp.float32) * scale
+
+
 def compressed_psum(grad, residual, axis_name, scheme="2bit",
                     threshold=0.5):
     """Quantize -> psum -> dequantize one gradient with error feedback.

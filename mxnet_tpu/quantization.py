@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import nd
+from .parallel.compression import quantize_int8
 from .gluon.block import HybridBlock
 from .gluon.nn.basic_layers import Dense
 from .gluon.nn.conv_layers import _Conv
@@ -33,17 +34,16 @@ __all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
            "calibrate"]
 
 
+# activations quantize with the shared symmetric int8 rule
+_quantize_act = quantize_int8
+
+
 def _quantize_weight(w, out_axis):
     """Per-output-channel symmetric int8 codes + fp32 scales."""
     red = tuple(i for i in range(w.ndim) if i != out_axis)
     amax = jnp.max(jnp.abs(w), axis=red, keepdims=True)
     scale = jnp.maximum(amax / 127.0, 1e-30)
-    codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
-    return codes, scale.astype(jnp.float32)
-
-
-def _quantize_act(x, scale):
-    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return quantize_int8(w, scale), scale.astype(jnp.float32)
 
 
 class QuantizedDense(HybridBlock):
@@ -193,14 +193,21 @@ def quantize_net(net, calib_data: Optional[List] = None,
     excluded = set(id(b) for b in (exclude or []))
     stats = calibrate(net, calib_data)
 
+    def quantized_of(child):
+        if isinstance(child, Dense):
+            return QuantizedDense(child, stats[id(child)])
+        return QuantizedConv2D(child, stats[id(child)])
+
+    # the net itself may be a bare Dense/Conv — return its replacement
+    # (callers must use the returned net, as the docstring says)
+    if _quantizable(net) and id(net) not in excluded and id(net) in stats:
+        return quantized_of(net)
+
     def replace(block):
         for name, child in list(block._children.items()):
             if _quantizable(child) and id(child) not in excluded \
                     and id(child) in stats:
-                if isinstance(child, Dense):
-                    q = QuantizedDense(child, stats[id(child)])
-                else:
-                    q = QuantizedConv2D(child, stats[id(child)])
+                q = quantized_of(child)
                 block._children[name] = q
                 # attribute-registered children need the attr updated too
                 for attr, val in list(block.__dict__.items()):

@@ -50,3 +50,22 @@ def test_scorer_length_penalty():
     # alpha=0 disables the penalty
     sc0 = BeamSearchScorer(alpha=0.0)
     assert sc0(-10.0, 10.0) == sc0(-10.0, 2.0)
+
+
+def test_beam_search_src_valid_len_masks_padding(net_src):
+    net, src = net_src
+    # row padded beyond valid_len must decode the same as the unpadded
+    # row: padding tokens must not be attended
+    srcn = src.asnumpy()
+    padded = srcn.copy()
+    padded[:, 5:] = 99  # junk in the padding region
+    vl = mx.nd.array(np.array([5, 5]), dtype="int32")
+    out_a = beam_search_translate(net, mx.nd.array(srcn.copy()
+                                                   .astype(np.int32)),
+                                  bos_id=1, eos_id=2, beam_size=2,
+                                  max_len=8, src_valid_len=vl)
+    out_b = beam_search_translate(net, mx.nd.array(padded
+                                                   .astype(np.int32)),
+                                  bos_id=1, eos_id=2, beam_size=2,
+                                  max_len=8, src_valid_len=vl)
+    np.testing.assert_array_equal(out_a, out_b)

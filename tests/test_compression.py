@@ -177,3 +177,53 @@ def test_compressed_step_checkpoint_shardings_exist():
     assert step._tr_sh and step._st_sh is not None
     for n in step._tr_names:
         assert n in step._tr_sh
+
+
+def test_dist_async_stale_updates_differ_from_sync():
+    # async applies one momentum update per replica push (stale reads);
+    # sync aggregates then updates once — different trajectories
+    def run(kv_type):
+        kv = mx.kv.create(kv_type)
+        kv.init(0, mx.nd.array(np.ones(4, np.float32)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                          momentum=0.9))
+        g1 = mx.nd.array(np.full(4, 1.0, np.float32))
+        g2 = mx.nd.array(np.full(4, 2.0, np.float32))
+        kv.push(0, [g1, g2])
+        kv.push(0, [g1, g2])
+        out = mx.nd.zeros((4,))
+        kv.pull(0, out=out)
+        return out.asnumpy()
+
+    w_async = run("dist_async")
+    w_sync = run("dist_sync")
+    assert not np.allclose(w_async, w_sync), (w_async, w_sync)
+    # both still descend
+    assert (w_async < 1.0).all() and (w_sync < 1.0).all()
+
+
+def test_pushpull_with_optimizer_compresses_once():
+    # regression: pushpull used to quantize the replica list, then push
+    # re-quantized the aggregate (halving every update)
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.array(np.zeros(3, np.float32)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    g = mx.nd.array(np.array([0.6, 0.0, -0.6], np.float32))
+    kv.pushpull(0, [g, g])
+    out = mx.nd.zeros((3,))
+    kv.pull(0, out=out)
+    # each replica sends 0.5 -> aggregate 1.0 applied once with lr 1
+    np.testing.assert_allclose(out.asnumpy(), [-1.0, 0.0, 1.0])
+
+
+def test_compression_residuals_survive_replica_count_change():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.zeros((2,)))
+    g = mx.nd.array(np.array([1.0, 0.0], np.float32))
+    kv.push(0, g)          # single push: one residual slot
+    kv.push(0, [g, g])     # list push: must grow, not IndexError
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 0.0])

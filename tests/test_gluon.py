@@ -266,7 +266,7 @@ def test_contrib_concurrent_and_pixelshuffle():
                     .astype(np.float32))
     y = ps(x)
     assert y.shape == (1, 2, 6, 6)
-    import torch
+    torch = pytest.importorskip("torch")
     ref = torch.nn.functional.pixel_shuffle(
         torch.from_numpy(x.asnumpy().copy()), 2).numpy()
     np.testing.assert_allclose(y.asnumpy(), ref)

@@ -116,3 +116,11 @@ def test_quantize_net_on_hybridized_net():
     qnet = quantize_net(net, calib_data=[mx.nd.array(X)])
     kinds = [type(c).__name__ for c in qnet._children.values()]
     assert "QuantizedDense" in kinds, kinds
+
+
+def test_quantize_net_bare_dense():
+    # the net itself being a quantizable layer must not silently no-op
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    qnet = quantize_net(net, calib_data=[mx.nd.ones((2, 8))])
+    assert type(qnet).__name__ == "QuantizedDense"

@@ -1,6 +1,7 @@
 """mx.sym symbolic API + mx.mod.Module (reference: symbol.py /
 module/module.py — classic pre-Gluon workflow on the TPU-native DAG)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import sym
@@ -206,3 +207,39 @@ def test_monitor_records_activations():
     recs = mon.toc()
     assert len(recs) >= 2
     assert all(np.isfinite(v) for _, v in recs)
+
+
+def test_module_bind_predict_only_without_label_shapes():
+    # reference workflow: bind(for_training=False) with no label_shapes
+    # must work for inference (label vars are not parameters)
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    w = mx.sym.Variable("fc_weight", shape=(3, 4))
+    b = mx.sym.Variable("fc_bias", shape=(3,))
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, w, b, num_hidden=3), label,
+        name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (5, 4))], for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.ones((5, 4))], label=None)
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (5, 3)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                               np.ones(5), rtol=1e-5)
+
+
+def test_module_bind_training_still_requires_label_shapes():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    w = mx.sym.Variable("fc_weight", shape=(3, 4))
+    b = mx.sym.Variable("fc_bias", shape=(3,))
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, w, b, num_hidden=3), label,
+        name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    with pytest.raises(ValueError, match="softmax_label"):
+        mod.bind(data_shapes=[("data", (5, 4))], for_training=True)
