@@ -75,7 +75,9 @@ def main():
     y = mx.nd.array(np.random.randint(0, 1000, batch), dtype="int32")
 
     # warmup (compile + first exec)
+    t_c = time.perf_counter()
     float(step(x, y).asscalar())
+    compile_s = time.perf_counter() - t_c
     float(step(x, y).asscalar())
 
     # async-chained timing: each step consumes the previous step's
@@ -104,12 +106,20 @@ def main():
     if ips > ceiling and ips_sync < ips:
         ips = ips_sync
 
+    # ResNet-50 training ~= 3x fwd FLOPs; fwd ~4.1 GFLOP at 224px
+    flops_per_img = 3 * 4.1e9 * (image / 224.0) ** 2
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / REFERENCE_IMG_PER_SEC, 3),
         "backend": backend,
+        "batch": batch, "image": image,
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(1000.0 * batch / ips, 2),
+        "mfu": round(ips * flops_per_img / peak, 4),
+        "images_per_sec_synced": round(ips_sync, 2),
     }))
 
 

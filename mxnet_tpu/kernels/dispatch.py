@@ -1,0 +1,34 @@
+"""Shared kernel-dispatch policy: warn-once, counted fallback with a
+strict-mode escape hatch. Every Pallas kernel family routes its
+jnp-fallback bookkeeping through one KernelFallback so a kernel
+regression is always visible (warning + counter) and can be made fatal
+(MXNET_TPU_STRICT_KERNELS=1, or the family-specific env)."""
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["KernelFallback"]
+
+
+class KernelFallback:
+    def __init__(self, kernel_name: str, strict_envs=()):
+        self.kernel_name = kernel_name
+        self.strict_envs = tuple(strict_envs) + ("MXNET_TPU_STRICT_KERNELS",)
+        self.count = 0
+        self._warned = False
+
+    def strict(self) -> bool:
+        return any(os.environ.get(e, "0") == "1" for e in self.strict_envs)
+
+    def note(self, e: BaseException):
+        """Record a fallback; re-raises first in strict mode."""
+        if self.strict():
+            raise e
+        self.count += 1
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"Pallas {self.kernel_name} kernel failed; falling back "
+                f"to the jnp path: {type(e).__name__}: {e}",
+                RuntimeWarning, stacklevel=4)

@@ -1,0 +1,282 @@
+"""Fused normalization Pallas kernels (RMSNorm / LayerNorm).
+
+Reference analogue: the fork's fused layer-norm CUDA kernels
+(src/operator/nn/layer_norm.cu vectorized/fused paths). TPU-first: one
+VMEM pass per row block computes the moments and applies scale/shift —
+no separate mean/var/normalize kernels, no fp32 round trips to HBM.
+Forward saves only the per-row statistics; the backward recomputes
+x_hat from the saved stats in a second fused kernel (dgamma/dbeta are
+cross-row sums XLA handles well in jnp).
+
+Layout: (..., d) — normalization over the trailing axis. Kernels grid
+over row blocks with the full feature dim resident in VMEM.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import KernelFallback
+
+__all__ = ["fused_rmsnorm", "fused_layernorm"]
+
+#: fallback bookkeeping (FALLBACK_COUNT exposed via __getattr__ below)
+_fallback = KernelFallback("fused-norm",
+                           strict_envs=("MXNET_TPU_STRICT_NORM",))
+
+
+def __getattr__(name):
+    if name == "FALLBACK_COUNT":
+        return _fallback.count
+    raise AttributeError(name)
+
+
+def _pallas_mode():
+    if os.environ.get("MXNET_TPU_NORM_INTERPRET", "0") == "1":
+        return "interpret"
+    if jax.default_backend() not in ("cpu",):
+        return "compiled"
+    return None
+
+
+# VMEM is ~16 MiB/core; keep x-block + out-block + temps well under it
+_VMEM_BUDGET_BYTES = 4 << 20
+
+
+def _pick_rows(n, d, want=512):
+    """Rows per block: bounded by a VMEM byte budget for the (rows, d)
+    fp32 block, then rounded down to a power of two. Callers pad the
+    row count up to a multiple (see _pad_rows) so odd n never degrades
+    to single-row blocks."""
+    budget = max(1, _VMEM_BUDGET_BYTES // (max(d, 1) * 4))
+    b = max(1, min(want, budget, n))
+    p = 1
+    while p * 2 <= b:
+        p *= 2
+    return p
+
+
+def _pad_rows(x2, rows):
+    n = x2.shape[0]
+    pad = (-n) % rows
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad,) + x2.shape[1:], x2.dtype)], axis=0)
+    return x2, n
+
+
+# ---------------------------------------------------------------- RMSNorm
+
+def _rms_fwd_kernel(eps, x_ref, g_ref, o_ref, rrms_ref):
+    x = x_ref[...].astype(jnp.float32)            # (rows, d)
+    ms = jnp.mean(x * x, axis=-1)
+    rrms = jax.lax.rsqrt(ms + eps)                # (rows,)
+    o_ref[...] = (x * rrms[:, None] *
+                  g_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+    rrms_ref[...] = rrms
+
+
+def _rms_bwd_kernel(eps, x_ref, g_ref, rrms_ref, dy_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    rrms = rrms_ref[...].astype(jnp.float32)[:, None]
+    dy = dy_ref[...].astype(jnp.float32)
+    d = x.shape[-1]
+    wdy = dy * g
+    # dx = rrms * (wdy - x * mean(wdy * x) * rrms^2)
+    corr = jnp.mean(wdy * x, axis=-1, keepdims=True) * rrms * rrms
+    dx_ref[...] = (rrms * (wdy - x * corr)).astype(dx_ref.dtype)
+
+
+def _rms_pallas_fwd(x2, g, eps, interpret):
+    from jax.experimental import pallas as pl
+    n, d = x2.shape
+    rows = _pick_rows(n)
+    grid = (n // rows,)
+    return pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                   pl.BlockSpec((rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x2.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        interpret=interpret,
+    )(x2, g)
+
+
+def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
+    from jax.experimental import pallas as pl
+    n, d = x2.shape
+    rows = _pick_rows(n)
+    grid = (n // rows,)
+    return pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((rows,), lambda i: (i,)),
+                  pl.BlockSpec((rows, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=interpret,
+    )(x2, g, rrms, dy2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x2, g, eps, interpret):
+    out, _ = _rms_fwd(x2, g, eps, interpret)
+    return out
+
+
+def _rms_fwd(x2, g, eps, interpret):
+    out, rrms = _rms_pallas_fwd(x2, g, eps, interpret)
+    return out, (x2, g, rrms)
+
+
+def _rms_bwd(eps, interpret, res, dy2):
+    x2, g, rrms = res
+    dx = _rms_pallas_dx(x2, g, rrms, dy2.astype(x2.dtype), eps,
+                        interpret)
+    xhat = x2.astype(jnp.float32) * rrms[:, None]
+    dg = jnp.sum(dy2.astype(jnp.float32) * xhat, axis=0).astype(g.dtype)
+    return dx, dg
+
+
+_rms.defvjp(_rms_fwd, _rms_bwd)
+
+
+def fused_rmsnorm(x, gamma, eps=1e-6):
+    """RMSNorm over the trailing axis; Pallas on TPU, jnp elsewhere."""
+    mode = _pallas_mode()
+    if mode is not None:
+        try:
+            x2 = x.reshape(-1, x.shape[-1])
+            out = _rms(x2, gamma, eps, mode == "interpret")
+            return out.reshape(x.shape)
+        except Exception as e:
+            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
+                raise
+            _note_fallback(e)
+    xs = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xs), axis=-1, keepdims=True)
+    return (xs * jax.lax.rsqrt(ms + eps) *
+            gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+# -------------------------------------------------------------- LayerNorm
+
+def _ln_fwd_kernel(eps, x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref):
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1)
+    xc = x - mu[:, None]
+    var = jnp.mean(xc * xc, axis=-1)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[...] = (xc * rstd[:, None] * g_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
+
+
+def _ln_bwd_kernel(eps, x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    mu = mu_ref[...].astype(jnp.float32)[:, None]
+    rstd = rstd_ref[...].astype(jnp.float32)[:, None]
+    dy = dy_ref[...].astype(jnp.float32)
+    xhat = (x - mu) * rstd
+    wdy = dy * g
+    # dx = rstd * (wdy - mean(wdy) - xhat * mean(wdy * xhat))
+    m1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    m2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (wdy - m1 - xhat * m2)).astype(dx_ref.dtype)
+
+
+def _ln_pallas_fwd(x2, g, b, eps, interpret):
+    from jax.experimental import pallas as pl
+    n, d = x2.shape
+    rows = _pick_rows(n)
+    grid = (n // rows,)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                   pl.BlockSpec((rows,), lambda i: (i,)),
+                   pl.BlockSpec((rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x2.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.float32),
+                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        interpret=interpret,
+    )(x2, g, b)
+
+
+def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
+    from jax.experimental import pallas as pl
+    n, d = x2.shape
+    rows = _pick_rows(n)
+    grid = (n // rows,)
+    return pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((rows,), lambda i: (i,)),
+                  pl.BlockSpec((rows,), lambda i: (i,)),
+                  pl.BlockSpec((rows, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=interpret,
+    )(x2, g, mu, rstd, dy2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x2, g, b, eps, interpret):
+    out, _ = _ln_fwd(x2, g, b, eps, interpret)
+    return out
+
+
+def _ln_fwd(x2, g, b, eps, interpret):
+    out, mu, rstd = _ln_pallas_fwd(x2, g, b, eps, interpret)
+    return out, (x2, g, mu, rstd)
+
+
+def _ln_bwd(eps, interpret, res, dy2):
+    x2, g, mu, rstd = res
+    dx = _ln_pallas_dx(x2, g, mu, rstd, dy2.astype(x2.dtype), eps,
+                       interpret)
+    xhat = (x2.astype(jnp.float32) - mu[:, None]) * rstd[:, None]
+    dyf = dy2.astype(jnp.float32)
+    dg = jnp.sum(dyf * xhat, axis=0).astype(g.dtype)
+    db = jnp.sum(dyf, axis=0).astype(g.dtype)
+    return dx, dg, db
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def fused_layernorm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the trailing axis; Pallas on TPU, jnp elsewhere."""
+    mode = _pallas_mode()
+    if mode is not None:
+        try:
+            x2 = x.reshape(-1, x.shape[-1])
+            out = _ln(x2, gamma, beta, eps, mode == "interpret")
+            return out.reshape(x.shape)
+        except Exception as e:
+            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
+                raise
+            _note_fallback(e)
+    xs = x.astype(jnp.float32)
+    mean = jnp.mean(xs, axis=-1, keepdims=True)
+    var = jnp.var(xs, axis=-1, keepdims=True)
+    return ((xs - mean) * jax.lax.rsqrt(var + eps)
+            * gamma.astype(jnp.float32)
+            + beta.astype(jnp.float32)).astype(x.dtype)

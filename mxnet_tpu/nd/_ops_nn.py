@@ -213,19 +213,38 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
 
 def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **kw):
-    """Reference: layer_norm.cc; fp32 accumulation for bf16 inputs."""
+    """Reference: layer_norm.cc; fp32 accumulation for bf16 inputs.
+    Trailing-axis norms go through the fused Pallas kernel on TPU
+    (kernels/fused_norm.py)."""
+    if axis in (-1, data.ndim - 1):
+        from ..kernels.fused_norm import fused_layernorm
+
+        def f(x, g, b):
+            return fused_layernorm(x, g, b, eps)
+        return invoke(f, [data, gamma, beta])
+
     def f(x, g, b):
         xs = x.astype(jnp.float32)
         mean = jnp.mean(xs, axis=axis, keepdims=True)
         var = jnp.var(xs, axis=axis, keepdims=True)
         out = (xs - mean) * lax.rsqrt(var + eps)
-        return (out * g.astype(jnp.float32) +
-                b.astype(jnp.float32)).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return (out * g.astype(jnp.float32).reshape(shape) +
+                b.astype(jnp.float32).reshape(shape)).astype(x.dtype)
     return invoke(f, [data, gamma, beta])
 
 
 def RMSNorm(data, gamma, axis=-1, eps=1e-6):
-    """TPU-era norm (Llama family); no reference op — contrib extension."""
+    """TPU-era norm (Llama family); no reference op — contrib extension.
+    Trailing-axis norms go through the fused Pallas kernel on TPU."""
+    if axis in (-1, data.ndim - 1):
+        from ..kernels.fused_norm import fused_rmsnorm
+
+        def f(x, g):
+            return fused_rmsnorm(x, g, eps)
+        return invoke(f, [data, gamma])
+
     def f(x, g):
         xs = x.astype(jnp.float32)
         ms = jnp.mean(jnp.square(xs), axis=axis, keepdims=True)
