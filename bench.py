@@ -1,13 +1,22 @@
 """Headline benchmark: ResNet-50 training throughput (images/sec/chip).
 
-Matches BASELINE.json's headline metric. Runs the fused train step
-(fwd+bwd+SGD in one XLA executable) in bf16 NHWC on whatever the default
-jax platform provides (the real TPU chip under the driver; CPU elsewhere).
-vs_baseline compares against the reference fork's published V100+AMP
-ResNet-50 number (~1360 img/s, ptrendx MXNet AMP benchmarks).
+Matches BASELINE.json's headline metric (reference analogue: the fork's
+example/image-classification/benchmark_score.py — it ALWAYS prints a
+score). This version defends its own deadline so a driver-side timeout
+can never produce zero data again:
+
+- BENCH_BUDGET_S (default 300) is a self-imposed wall-clock budget; a
+  SIGALRM/SIGTERM handler prints the best-so-far JSON line and exits 0.
+- The JAX persistent compilation cache is enabled, so a re-run skips
+  the expensive ResNet-50 compile entirely.
+- Phase 1 is a cheap bf16 matmul MFU probe (compiles in seconds) whose
+  JSON line is emitted immediately; phase 2 upgrades it to the real
+  ResNet-50 headline only if budget remains. The LAST line printed is
+  always the best measurement available.
 """
 import json
 import os
+import signal
 import sys
 import time
 
@@ -15,15 +24,83 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-REFERENCE_IMG_PER_SEC = 1360.0  # ptrendx/mxnet ResNet-50 V100 AMP
+REFERENCE_IMG_PER_SEC = 1360.0   # ptrendx/mxnet ResNet-50 V100 AMP
+REFERENCE_MATMUL_TFLOPS = 112.0  # V100 measured dense fp16 (tensor cores)
+V5E_PEAK_TFLOPS = 197.0          # bf16 peak per v5e chip
+
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "300"))
+_T0 = time.monotonic()
 
 
-def _acquire_backend(max_wait=240.0):
+def _remaining():
+    return BUDGET_S - (time.monotonic() - _T0)
+
+
+#: best measurement so far; the alarm handler prints exactly this
+_best = {
+    "metric": "resnet50_train_images_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "images/sec",
+    "vs_baseline": 0.0,
+    "phase": "startup",
+}
+
+
+def _emit():
+    sys.stdout.write(json.dumps(_best) + "\n")
+    sys.stdout.flush()
+
+
+def _deadline(signum=None, frame=None):
+    # never let this thread die before os._exit: snapshot the dict (the
+    # main thread may be mutating it) and exit even if emission fails
+    try:
+        snap = dict(_best)
+        snap["note"] = "budget expired; best-so-far emitted"
+        sys.stdout.write(json.dumps(snap) + "\n")
+        sys.stdout.flush()
+    finally:
+        os._exit(0)
+
+
+def _install_watchdog():
+    # a daemon THREAD, not signal.alarm: Python signal handlers only run
+    # between bytecodes on the main thread, so a main thread blocked in
+    # a C call (grpc backend init, XLA compile, block_until_ready) never
+    # sees SIGALRM/SIGTERM. The timer thread's os._exit always fires.
+    import threading
+
+    t = threading.Timer(max(5.0, BUDGET_S), _deadline)
+    t.daemon = True
+    t.start()
+    # best-effort: if the main thread IS interruptible, exit cleanly on
+    # the driver's TERM too
+    signal.signal(signal.SIGTERM, _deadline)
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache: a re-run (or a retry after a
+    timeout) skips straight past the multi-minute ResNet compile."""
+    import jax
+
+    cache = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:
+        print(f"# compile cache unavailable: {e}", file=sys.stderr)
+
+
+def _acquire_backend(max_wait):
     """Probe the default jax backend, retrying while the single TPU grant
-    is transiently held by another process (the axon tunnel raises
-    UNAVAILABLE until the previous holder's lease lapses — can take
-    minutes). Falls back to CPU rather than crashing: a recorded CPU
-    number beats no number."""
+    is transiently held (axon raises UNAVAILABLE until the previous
+    holder's lease lapses). Falls back to CPU rather than crashing: a
+    recorded CPU number beats no number."""
     import jax
 
     deadline = time.monotonic() + max_wait
@@ -39,22 +116,58 @@ def _acquire_backend(max_wait=240.0):
             print(f"# backend unavailable ({type(e).__name__}); retrying",
                   file=sys.stderr)
             time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
-            delay = min(delay * 1.6, 40.0)
+            delay = min(delay * 1.6, 30.0)
     print(f"# TPU init failed after {max_wait:.0f}s: {last}; "
           "falling back to CPU", file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")
     return jax.default_backend()
 
 
-def main():
+def _matmul_probe(on_tpu, backend):
+    """bf16 matmul TFLOP/s — compiles in seconds, so SOME hardware
+    number lands even if ResNet-50 never finishes compiling."""
     import jax
-    backend = _acquire_backend()
+    import jax.numpy as jnp
+
+    n = 4096 if on_tpu else 512
+    iters = 30 if on_tpu else 3
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.rand(n, n).astype(np.float32)).astype(jnp.bfloat16)
+    b = jnp.asarray(rs.rand(n, n).astype(np.float32)).astype(jnp.bfloat16)
+
+    @jax.jit
+    def mm(x, y):
+        return ((x @ y) * jnp.bfloat16(1.0 / n)).astype(jnp.bfloat16)
+
+    mm(a, b).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    c = a
+    for _ in range(iters):
+        c = mm(c, b)  # chained: no dispatch can complete early
+    c.block_until_ready()
+    dt = time.perf_counter() - t0
+    tflops = 2.0 * n ** 3 * iters / dt / 1e12
+    peak = V5E_PEAK_TFLOPS if on_tpu else 2.0
+    _best.update({
+        "metric": "matmul_bf16_tflops_per_chip",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / REFERENCE_MATMUL_TFLOPS, 3),
+        "backend": backend,
+        "mfu": round(tflops / peak, 4),
+        "phase": "matmul_probe",
+        "probe_matmul_tflops": round(tflops, 2),
+    })
+    _emit()
+    return tflops
+
+
+def _resnet_phase(on_tpu, backend, probe_tflops):
     import mxnet_tpu as mx
     from mxnet_tpu import amp
     from mxnet_tpu.models.resnet import resnet50_v1
     from mxnet_tpu.parallel.data_parallel import FusedTrainStep
 
-    on_tpu = backend not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
@@ -78,10 +191,18 @@ def main():
     t_c = time.perf_counter()
     float(step(x, y).asscalar())
     compile_s = time.perf_counter() - t_c
+    t_w = time.perf_counter()
     float(step(x, y).asscalar())
+    step_s = time.perf_counter() - t_w
 
-    # async-chained timing: each step consumes the previous step's
-    # donated params, so forcing the final loss to host bounds the
+    # fit the timing loop into what's left of the budget: the chained
+    # loop runs `steps` and the sync cross-check ~steps/4 more, so fit
+    # 1.25x steps plus 10s headroom
+    if step_s > 0:
+        fit = int(max(0.0, _remaining() - 10.0) / (1.25 * step_s))
+        steps = max(3, min(steps, fit))
+
+    # async-chained timing: forcing the final loss to host bounds the
     # whole chain (the reference benchmarks the same way: enqueue,
     # sync once)
     t0 = time.perf_counter()
@@ -91,49 +212,97 @@ def main():
     dt = time.perf_counter() - t0
     ips = batch * steps / dt
 
-    # cross-check: block every step (pays sync latency; slower but
-    # immune to async-timing artifacts). Report the conservative
-    # number if the chained figure is implausible for one chip.
+    # record the chained result immediately: if the watchdog fires
+    # during the cross-check below, this measurement still lands
+    flops_per_img = 3 * 4.1e9 * (image / 224.0) ** 2
+    peak = V5E_PEAK_TFLOPS * 1e12 if on_tpu else 1e12
+    _best.update({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REFERENCE_IMG_PER_SEC, 3),
+        "batch": batch, "image": image, "steps": steps,
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(1000.0 * batch / ips, 2),
+        "mfu": round(ips * flops_per_img / peak, 4),
+        "phase": "resnet50_chained",
+    })
+    _emit()
+
+    # cross-check: block every step (pays sync latency; immune to
+    # async-timing artifacts). Use it if the chained figure is
+    # implausible for one chip.
+    sync_steps = max(3, steps // 4)
     t0 = time.perf_counter()
-    for _ in range(max(3, steps // 4)):
+    for _ in range(sync_steps):
         float(step(x, y).asscalar())
     dt_sync = time.perf_counter() - t0
-    ips_sync = batch * max(3, steps // 4) / dt_sync
+    ips_sync = batch * sync_steps / dt_sync
 
     # ResNet-50 training is ~12.3 GFLOP/image; one v5e chip peaks at
     # ~197 bf16 TFLOP/s => hard ceiling ~16k img/s
-    ceiling = 197e12 / 12.3e9
+    ceiling = V5E_PEAK_TFLOPS * 1e12 / 12.3e9
     if ips > ceiling and ips_sync < ips:
         ips = ips_sync
 
-    # ResNet-50 training ~= 3x fwd FLOPs; fwd ~4.1 GFLOP at 224px
-    flops_per_img = 3 * 4.1e9 * (image / 224.0) ** 2
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
-    print(json.dumps({
+    # ResNet-50 training ~= 3x fwd FLOPs; fwd ~4.1 GFLOP at 224px.
+    # Single .update (one C-level call, atomic under the GIL) — no
+    # clear() first, so the watchdog can never snapshot an empty dict
+    _best.update({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / REFERENCE_IMG_PER_SEC, 3),
         "backend": backend,
-        "batch": batch, "image": image,
+        "batch": batch, "image": image, "steps": steps,
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000.0 * batch / ips, 2),
         "mfu": round(ips * flops_per_img / peak, 4),
         "images_per_sec_synced": round(ips_sync, 2),
-    }))
+        "probe_matmul_tflops": round(probe_tflops, 2),
+        "phase": "resnet50",
+    })
+    _emit()
+
+
+def main():
+    _install_watchdog()
+    _enable_compile_cache()
+    # lease contention can take minutes to clear, but never let the
+    # retry loop eat the whole budget
+    backend = _acquire_backend(max_wait=min(240.0, BUDGET_S / 3))
+    on_tpu = backend not in ("cpu",)
+    _best.update({"backend": backend, "phase": "backend_acquired"})
+
+    probe_tflops = 0.0
+    try:
+        probe_tflops = _matmul_probe(on_tpu, backend)
+    except Exception as e:
+        print(f"# matmul probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # only attempt the big compile with enough budget left for it to
+    # plausibly finish (cached recompile needs far less)
+    if _remaining() > 60.0:
+        try:
+            _resnet_phase(on_tpu, backend, probe_tflops)
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            _best["resnet_error"] = f"{type(e).__name__}: {e}"[:300]
+            _emit()
+    else:
+        _best["note"] = "skipped resnet50: insufficient budget remaining"
+        _emit()
 
 
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # always emit the JSON line; rc stays 0
+    except Exception as e:  # always emit a JSON line; rc stays 0
         import traceback
 
         traceback.print_exc()
-        print(json.dumps({
-            "metric": "resnet50_train_images_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "images/sec",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:300],
-        }))
+        _best["error"] = f"{type(e).__name__}: {e}"[:300]
+        _emit()

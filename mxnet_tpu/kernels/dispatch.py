@@ -8,7 +8,16 @@ from __future__ import annotations
 import os
 import warnings
 
-__all__ = ["KernelFallback"]
+__all__ = ["KernelFallback", "fallback_counts"]
+
+#: every KernelFallback registers itself here so the profiler can report
+#: per-family fallback counts (kernel regressions are never invisible)
+_REGISTRY = {}
+
+
+def fallback_counts():
+    """{kernel_name: fallback count} across all kernel families."""
+    return {name: fb.count for name, fb in _REGISTRY.items()}
 
 
 class KernelFallback:
@@ -17,6 +26,7 @@ class KernelFallback:
         self.strict_envs = tuple(strict_envs) + ("MXNET_TPU_STRICT_KERNELS",)
         self.count = 0
         self._warned = False
+        _REGISTRY[kernel_name] = self
 
     def strict(self) -> bool:
         return any(os.environ.get(e, "0") == "1" for e in self.strict_envs)

@@ -13,12 +13,23 @@ from __future__ import annotations
 import functools
 import math
 import os
-import warnings
 
 import jax
 import jax.numpy as jnp
 
+from .dispatch import KernelFallback
+
 __all__ = ["flash_attention_raw", "reference_attention"]
+
+#: fallback bookkeeping (FALLBACK_COUNT exposed via __getattr__ below)
+_fallback = KernelFallback("flash-attention",
+                           strict_envs=("MXNET_TPU_STRICT_FLASH",))
+
+
+def __getattr__(name):
+    if name == "FALLBACK_COUNT":
+        return _fallback.count
+    raise AttributeError(name)
 
 
 def reference_attention(q, k, v, causal=True, scale=None):
@@ -287,9 +298,7 @@ def _flash_pallas_bwd(causal, scale, interpret, res, g):
     except Exception as e:
         # same contract as the forward: never let a kernel regression
         # crash training unless the user opted into strict mode
-        if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
-            raise
-        _note_fallback(e)
+        _fallback.note(e)
         _, vjp = jax.vjp(lambda q_, k_, v_:
                          reference_attention(q_, k_, v_, causal, scale),
                          q, k, v)
@@ -321,23 +330,6 @@ def _flash_ref_bwd(causal, scale, res, g):
 _flash_ref.defvjp(_flash_ref_fwd, _flash_ref_bwd)
 
 
-#: number of times the Pallas path failed and fell back to the exact
-#: reference implementation (visible to the profiler / tests).
-FALLBACK_COUNT = 0
-_warned_fallback = False
-
-
-def _note_fallback(e):
-    global FALLBACK_COUNT, _warned_fallback
-    FALLBACK_COUNT += 1
-    if not _warned_fallback:
-        _warned_fallback = True
-        warnings.warn(
-            "Pallas flash-attention kernel failed; falling back to "
-            f"exact O(T^2) attention: {type(e).__name__}: {e}",
-            RuntimeWarning, stacklevel=3)
-
-
 def _pallas_mode(T):
     """None (use reference), 'compiled', or 'interpret' (CPU testing of
     the real kernels, enabled via MXNET_TPU_FLASH_INTERPRET=1)."""
@@ -360,9 +352,8 @@ def flash_attention_raw(q, k, v, causal=True, scale=None, use_flash=True):
                                  mode == "interpret")
         except Exception as e:
             # fail loudly: a silently-degraded flash path hides O(T^2)
-            # perf regressions. MXNET_TPU_STRICT_FLASH=1 turns the
-            # fallback into an error; otherwise warn once and count.
-            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
-                raise
-            _note_fallback(e)
+            # perf regressions. MXNET_TPU_STRICT_FLASH=1 (or
+            # MXNET_TPU_STRICT_KERNELS=1) turns the fallback into an
+            # error; otherwise warn once and count.
+            _fallback.note(e)
     return _flash_ref(q, k, v, causal, scale)

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import functools
 import os
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -61,12 +60,13 @@ def _pick_rows(n, d, want=512):
 
 
 def _pad_rows(x2, rows):
-    n = x2.shape[0]
-    pad = (-n) % rows
+    """Zero-pad axis 0 up to a multiple of `rows` (callers slice the
+    kernel outputs back to the original row count)."""
+    pad = (-x2.shape[0]) % rows
     if pad:
         x2 = jnp.concatenate(
             [x2, jnp.zeros((pad,) + x2.shape[1:], x2.dtype)], axis=0)
-    return x2, n
+    return x2
 
 
 # ---------------------------------------------------------------- RMSNorm
@@ -95,27 +95,34 @@ def _rms_bwd_kernel(eps, x_ref, g_ref, rrms_ref, dy_ref, dx_ref):
 def _rms_pallas_fwd(x2, g, eps, interpret):
     from jax.experimental import pallas as pl
     n, d = x2.shape
-    rows = _pick_rows(n)
-    grid = (n // rows,)
-    return pl.pallas_call(
+    rows = _pick_rows(n, d)
+    x2p = _pad_rows(x2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
+    out, rrms = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps),
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                    pl.BlockSpec((rows,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((n, d), x2.dtype),
-                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((np_, d), x2.dtype),
+                   jax.ShapeDtypeStruct((np_,), jnp.float32)],
         interpret=interpret,
-    )(x2, g)
+    )(x2p, g)
+    return out[:n], rrms[:n]
 
 
 def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
     from jax.experimental import pallas as pl
     n, d = x2.shape
-    rows = _pick_rows(n)
-    grid = (n // rows,)
-    return pl.pallas_call(
+    rows = _pick_rows(n, d)
+    x2p = _pad_rows(x2, rows)
+    rrmsp = _pad_rows(rrms, rows)
+    dy2p = _pad_rows(dy2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
+    dx = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps),
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
@@ -123,9 +130,10 @@ def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
                   pl.BlockSpec((rows,), lambda i: (i,)),
                   pl.BlockSpec((rows, d), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
         interpret=interpret,
-    )(x2, g, rrms, dy2)
+    )(x2p, g, rrmsp, dy2p)
+    return dx[:n]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -160,9 +168,7 @@ def fused_rmsnorm(x, gamma, eps=1e-6):
             out = _rms(x2, gamma, eps, mode == "interpret")
             return out.reshape(x.shape)
         except Exception as e:
-            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
-                raise
-            _note_fallback(e)
+            _fallback.note(e)
     xs = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xs), axis=-1, keepdims=True)
     return (xs * jax.lax.rsqrt(ms + eps) *
@@ -200,9 +206,11 @@ def _ln_bwd_kernel(eps, x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref):
 def _ln_pallas_fwd(x2, g, b, eps, interpret):
     from jax.experimental import pallas as pl
     n, d = x2.shape
-    rows = _pick_rows(n)
-    grid = (n // rows,)
-    return pl.pallas_call(
+    rows = _pick_rows(n, d)
+    x2p = _pad_rows(x2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
+    out, mu, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps),
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
@@ -211,19 +219,25 @@ def _ln_pallas_fwd(x2, g, b, eps, interpret):
         out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                    pl.BlockSpec((rows,), lambda i: (i,)),
                    pl.BlockSpec((rows,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((n, d), x2.dtype),
-                   jax.ShapeDtypeStruct((n,), jnp.float32),
-                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((np_, d), x2.dtype),
+                   jax.ShapeDtypeStruct((np_,), jnp.float32),
+                   jax.ShapeDtypeStruct((np_,), jnp.float32)],
         interpret=interpret,
-    )(x2, g, b)
+    )(x2p, g, b)
+    return out[:n], mu[:n], rstd[:n]
 
 
 def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
     from jax.experimental import pallas as pl
     n, d = x2.shape
-    rows = _pick_rows(n)
-    grid = (n // rows,)
-    return pl.pallas_call(
+    rows = _pick_rows(n, d)
+    x2p = _pad_rows(x2, rows)
+    mup = _pad_rows(mu, rows)
+    rstdp = _pad_rows(rstd, rows)
+    dy2p = _pad_rows(dy2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
+    dx = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, eps),
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
@@ -232,9 +246,10 @@ def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
                   pl.BlockSpec((rows,), lambda i: (i,)),
                   pl.BlockSpec((rows, d), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
         interpret=interpret,
-    )(x2, g, mu, rstd, dy2)
+    )(x2p, g, mup, rstdp, dy2p)
+    return dx[:n]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -271,9 +286,7 @@ def fused_layernorm(x, gamma, beta, eps=1e-5):
             out = _ln(x2, gamma, beta, eps, mode == "interpret")
             return out.reshape(x.shape)
         except Exception as e:
-            if os.environ.get("MXNET_TPU_STRICT_FLASH", "0") == "1":
-                raise
-            _note_fallback(e)
+            _fallback.note(e)
     xs = x.astype(jnp.float32)
     mean = jnp.mean(xs, axis=-1, keepdims=True)
     var = jnp.var(xs, axis=-1, keepdims=True)

@@ -79,6 +79,11 @@ def summary() -> str:
     for name, durs in sorted(_AGG.items()):
         lines.append(f"{name:<40}{len(durs):>8}"
                      f"{sum(durs) / len(durs):>12.1f}{sum(durs):>14.1f}")
+    from .kernels.dispatch import fallback_counts
+    fb = fallback_counts()
+    if fb:
+        lines.append("kernel fallbacks: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(fb.items())))
     return "\n".join(lines)
 
 

@@ -81,7 +81,8 @@ class QuantizedDense(HybridBlock):
 
 
 class QuantizedConv2D(HybridBlock):
-    """int8 Conv2D (NHWC or NCHW, groups=1), int32 accumulation."""
+    """int8 Conv2D (NHWC or NCHW, incl. grouped/depthwise), int32
+    accumulation via feature_group_count."""
 
     def __init__(self, conv: _Conv, act_amax: float, **kwargs):
         super().__init__(**kwargs)
@@ -98,6 +99,7 @@ class QuantizedConv2D(HybridBlock):
         self._strides = conv._strides
         self._padding = conv._padding
         self._dilation = conv._dilation
+        self._groups = conv._groups
         self._activation = conv._activation
 
     def forward(self, x):
@@ -108,6 +110,7 @@ class QuantizedConv2D(HybridBlock):
             padding=[(p, p) for p in self._padding],
             rhs_dilation=self._dilation,
             dimension_numbers=self._dn,
+            feature_group_count=self._groups,
             preferred_element_type=jnp.int32)
         y = acc.astype(jnp.float32) * (self._in_scale * self._wscale
                                        if self._layout == "NHWC"
@@ -126,29 +129,86 @@ def _quantizable(block):
     if isinstance(block, Dense):
         return True
     if isinstance(block, _Conv):
-        return (not block._transpose and block._groups == 1
-                and len(block._layout) == 4)
+        # grouped/depthwise included (feature_group_count on the MXU);
+        # transposed convs stay fp32
+        return not block._transpose and len(block._layout) == 4
     return False
 
 
-def calibrate(net, calib_data: List) -> Dict[int, float]:
+# entropy-calibration resolution (reference: calib_mode='entropy', the
+# KL-divergence threshold search of src/operator/quantization/ — which
+# uses 8001 histogram bins / 255 quantized levels)
+_HIST_BINS = 8192
+_QUANT_BINS = 255
+_SEARCH_STRIDE = 32
+
+
+def _kl_threshold(hist: "_np.ndarray", amax: float) -> float:
+    """Pick the |x| clip threshold minimizing KL(P || Q) where P is the
+    calibration histogram clipped at the threshold (outliers folded into
+    the last bin) and Q is P re-quantized to 255 int8 levels."""
+    hist = hist.astype(_np.float64)
+    n = len(hist)
+    if hist.sum() == 0 or amax == 0.0:
+        return amax
+    bin_width = amax / n
+    best_i, best_kl = n, _np.inf
+    candidates = list(range(_QUANT_BINS, n, _SEARCH_STRIDE)) + [n]
+    for i in candidates:
+        p = hist[:i].copy()
+        p[-1] += hist[i:].sum()
+        nz = hist[:i] != 0
+        # re-quantize the first i bins into 255 levels, then expand:
+        # each quantized level spreads its mass uniformly over the
+        # nonzero source bins it covers (vectorized via reduceat)
+        edges = (_np.arange(_QUANT_BINS + 1) * i) // _QUANT_BINS
+        sums = _np.add.reduceat(hist[:i], edges[:-1])
+        cnts = _np.add.reduceat(nz.astype(_np.float64), edges[:-1])
+        level = _np.divide(sums, cnts, out=_np.zeros_like(sums),
+                           where=cnts > 0)
+        q = _np.repeat(level, _np.diff(edges))
+        q[~nz] = 0.0
+        ps, qs = p.sum(), q.sum()
+        if qs == 0:
+            continue
+        p /= ps
+        q /= qs
+        mask = p > 0
+        # smooth: where p>0 but q==0, KL is inf — penalize via epsilon
+        kl = float(_np.sum(p[mask] * _np.log(
+            p[mask] / _np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+def calibrate(net, calib_data: List, mode: str = "naive") -> Dict[int, float]:
     """Run calibration batches through the fp32 net recording each
-    quantizable layer's input |max| (reference: calib_mode='naive').
-    Returns {id(block): amax}."""
+    quantizable layer's input activation range. mode='naive' records
+    |max|; mode='entropy' additionally builds per-layer |x| histograms
+    and picks the KL-optimal clip threshold (reference:
+    contrib.quantization calib_mode='naive'|'entropy').
+    Returns {id(block): amax}. The net's hybridization state is
+    restored afterwards."""
     stats: Dict[int, float] = {}
+    hists: Dict[int, "_np.ndarray"] = {}
     handles = []
 
     # hybridized blocks route through the jit cache and skip forward
-    # hooks (and would feed tracers to them) — calibrate eagerly
+    # hooks (and would feed tracers to them) — calibrate eagerly and
+    # restore the hybridized state when done
+    rehybridize = []
+
     def dehybridize(block):
         if getattr(block, "_active", False):
             block.hybridize(False)
+            rehybridize.append(block)
         for c in block._children.values():
             dehybridize(c)
 
     dehybridize(net)
 
-    def make_hook(blk):
+    def make_amax_hook(blk):
         def hook(b, args):
             x = args[0]
             amax = float(jnp.max(jnp.abs(
@@ -156,20 +216,48 @@ def calibrate(net, calib_data: List) -> Dict[int, float]:
             stats[id(blk)] = max(stats.get(id(blk), 0.0), amax)
         return hook
 
-    def attach(block):
+    def make_hist_hook(blk):
+        def hook(b, args):
+            x = args[0]
+            a = _np.abs(_np.asarray(
+                x._data if isinstance(x, NDArray) else x,
+                dtype=_np.float32)).ravel()
+            h, _ = _np.histogram(a, bins=_HIST_BINS,
+                                 range=(0.0, stats[id(blk)] or 1.0))
+            hists[id(blk)] = hists.get(id(blk), 0) + h
+        return hook
+
+    def attach(block, factory):
         if _quantizable(block):
-            block._forward_pre_hooks.append(make_hook(block))
+            block._forward_pre_hooks.append(factory(block))
             handles.append(block)
         for c in block._children.values():
-            attach(c)
+            attach(c, factory)
 
-    attach(net)
-    from . import autograd
-    with autograd.pause():
-        for batch in calib_data:
-            net(batch if isinstance(batch, NDArray) else nd.array(batch))
-    for blk in handles:
-        blk._forward_pre_hooks.pop()
+    def sweep(factory):
+        handles.clear()
+        attach(net, factory)
+        from . import autograd
+        try:
+            with autograd.pause():
+                for batch in calib_data:
+                    net(batch if isinstance(batch, NDArray)
+                        else nd.array(batch))
+        finally:
+            # always detach, or a raising batch leaves hooks that feed
+            # tracers to float() on the next hybridized forward
+            for blk in handles:
+                blk._forward_pre_hooks.pop()
+
+    try:
+        sweep(make_amax_hook)            # pass 1: ranges
+        if mode == "entropy":
+            sweep(make_hist_hook)        # pass 2: histograms at range
+            for bid, h in hists.items():
+                stats[bid] = _kl_threshold(h, stats[bid])
+    finally:
+        for blk in rehybridize:
+            blk.hybridize(True)
     return stats
 
 
@@ -180,18 +268,19 @@ def quantize_net(net, calib_data: Optional[List] = None,
 
     calib_data: list of representative input batches (NDArray/array).
     quantized_dtype: only 'int8'/'auto' (the MXU-native narrow type).
-    calib_mode: only 'naive' (abs-max); 'entropy' is not implemented.
+    calib_mode: 'naive' (abs-max) or 'entropy' (KL threshold search).
     exclude: blocks (instances) to leave in fp32.
     """
     if quantized_dtype not in ("int8", "auto"):
         raise ValueError(f"unsupported quantized_dtype {quantized_dtype!r}")
-    if calib_mode != "naive":
+    if calib_mode not in ("naive", "entropy"):
         raise ValueError(
-            f"calib_mode {calib_mode!r} not supported (use 'naive')")
+            f"calib_mode {calib_mode!r} not supported "
+            "(use 'naive' or 'entropy')")
     if not calib_data:
         raise ValueError("calib_data batches are required for PTQ")
     excluded = set(id(b) for b in (exclude or []))
-    stats = calibrate(net, calib_data)
+    stats = calibrate(net, calib_data, mode=calib_mode)
 
     def quantized_of(child):
         if isinstance(child, Dense):

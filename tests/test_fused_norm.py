@@ -103,6 +103,26 @@ def test_fused_entrypoints_interpret_mode(monkeypatch):
         rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.parametrize("n", [96, 7, 130])
+def test_tail_rows_written(n):
+    # n % rows != 0: _pad_rows pads the grid up and the wrapper slices
+    # back — every tail row must be written (not left zero)
+    x, g, b = _data(n=n, d=64, seed=7)
+    rows = __import__(
+        "mxnet_tpu.kernels.fused_norm", fromlist=["_pick_rows"]
+    )._pick_rows(n, 64)
+    if n > rows:
+        assert n % rows != 0 or n == 96  # cases genuinely exercise padding
+    out = _rms(x, g, 1e-6, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref_rms(x, g)),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(out)[-1], 0.0)
+    out2 = _ln(x, g, b, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(_ref_ln(x, g, b)),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_nd_op_integration(monkeypatch):
     # nd.LayerNorm / nd.RMSNorm route trailing-axis norms through the
     # fused kernel; outputs must not change

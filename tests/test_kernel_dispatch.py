@@ -1,0 +1,74 @@
+"""Unified kernel-dispatch policy: every Pallas family routes fallback
+bookkeeping through dispatch.KernelFallback (one counter + warn-once +
+strict escape hatch), and the profiler surfaces the counts.
+Reference analogue: the fork's fused-kernel env toggles
+(MXNET_USE_FUSION-style) with visible fallback logging."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu.kernels import dispatch, flash_attention, fused_norm
+
+
+def _boom(*a, **k):
+    raise RuntimeError("forced kernel failure")
+
+
+def test_fallback_counter_increments_on_forced_failure(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_NORM_INTERPRET", "1")
+    monkeypatch.setattr(fused_norm, "_rms_pallas_fwd", _boom)
+    before = fused_norm.FALLBACK_COUNT
+    x = jnp.ones((4, 8), jnp.float32)
+    g = jnp.ones((8,), jnp.float32)
+    with pytest.warns(RuntimeWarning, match="fused-norm"):
+        fused_norm._fallback._warned = False
+        out = fused_norm.fused_rmsnorm(x, g)
+    assert fused_norm.FALLBACK_COUNT == before + 1
+    # fallback still computes the right answer
+    np.testing.assert_allclose(np.asarray(out),
+                               np.ones((4, 8), np.float32), rtol=1e-5)
+
+
+def test_strict_mode_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_NORM_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_TPU_STRICT_KERNELS", "1")
+    monkeypatch.setattr(fused_norm, "_rms_pallas_fwd", _boom)
+    x = jnp.ones((4, 8), jnp.float32)
+    g = jnp.ones((8,), jnp.float32)
+    with pytest.raises(RuntimeError, match="forced kernel failure"):
+        fused_norm.fused_rmsnorm(x, g)
+
+
+def test_family_strict_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_NORM_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_TPU_STRICT_NORM", "1")
+    monkeypatch.setattr(fused_norm, "_ln_pallas_fwd", _boom)
+    x = jnp.ones((4, 8), jnp.float32)
+    g = jnp.ones((8,), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    with pytest.raises(RuntimeError):
+        fused_norm.fused_layernorm(x, g, b)
+
+
+def test_flash_attention_uses_shared_dispatch(monkeypatch):
+    # the flash family registers in the same registry with its own env
+    assert isinstance(flash_attention._fallback, dispatch.KernelFallback)
+    assert "MXNET_TPU_STRICT_FLASH" in flash_attention._fallback.strict_envs
+    assert "MXNET_TPU_STRICT_KERNELS" in flash_attention._fallback.strict_envs
+    monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
+    monkeypatch.setattr(flash_attention, "_flash_pallas", _boom)
+    before = flash_attention.FALLBACK_COUNT
+    q = jnp.ones((1, 128, 2, 8), jnp.float32)
+    flash_attention._fallback._warned = True  # silence; counting is the test
+    out = flash_attention.flash_attention_raw(q, q, q)
+    assert flash_attention.FALLBACK_COUNT == before + 1
+    assert out.shape == q.shape
+
+
+def test_registry_and_profiler_surface_counts():
+    counts = dispatch.fallback_counts()
+    assert "fused-norm" in counts and "flash-attention" in counts
+    from mxnet_tpu import profiler
+    s = profiler.summary()
+    assert "kernel fallbacks:" in s and "fused-norm=" in s

@@ -89,7 +89,7 @@ def test_quantize_net_validates_args():
         quantize_net(net, calib_data=None)
     with pytest.raises(ValueError):
         quantize_net(net, calib_data=[mx.nd.ones((2, 4))],
-                     calib_mode="entropy")
+                     calib_mode="percentile")
 
 
 def test_exclude_keeps_layer_fp32():
@@ -124,3 +124,96 @@ def test_quantize_net_bare_dense():
     net.initialize()
     qnet = quantize_net(net, calib_data=[mx.nd.ones((2, 8))])
     assert type(qnet).__name__ == "QuantizedDense"
+
+
+def test_quantized_depthwise_conv_matches_fp32():
+    # groups == channels (depthwise, the MobileNet hot path) routes
+    # through feature_group_count on the int8 path
+    mx.random.seed(3)
+    conv = mx.gluon.nn.Conv2D(8, 3, padding=1, groups=8, in_channels=8,
+                              layout="NHWC")
+    conv.initialize()
+    X = np.random.RandomState(4).randn(2, 8, 8, 8).astype(np.float32)
+    ref = conv(mx.nd.array(X)).asnumpy()
+    q = QuantizedConv2D(conv, act_amax=float(np.abs(X).max()))
+    out = q(mx.nd.array(X)).asnumpy()
+    assert np.max(np.abs(out - ref)) < 0.05 * np.abs(ref).max()
+
+
+def test_entropy_calibration_clips_outliers():
+    # a distribution with one huge outlier: naive amax wastes the int8
+    # range on it; the KL threshold should land well below the outlier
+    from mxnet_tpu.quantization import calibrate
+    net = mx.gluon.nn.Dense(4, in_units=16)
+    net.initialize()
+    rs = np.random.RandomState(5)
+    X = rs.randn(512, 16).astype(np.float32)
+    X[0, 0] = 1000.0
+    naive = calibrate(net, [mx.nd.array(X)], mode="naive")
+    ent = calibrate(net, [mx.nd.array(X)], mode="entropy")
+    (amax,) = naive.values()
+    (thr,) = ent.values()
+    assert amax >= 1000.0
+    assert thr < 100.0, thr  # outlier clipped away
+
+
+def test_calibrate_restores_hybridization():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+            mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.ones((2, 4)))  # warm the jit cache
+    from mxnet_tpu.quantization import calibrate
+    calibrate(net, [mx.nd.ones((2, 4))])
+    assert net._active, "calibrate() must restore hybridize state"
+
+
+def test_quantize_mobilenet_v2_accuracy_within_1pct():
+    # the reference's own quantization demo net: depthwise/grouped convs
+    # + pooling/flatten pass-through end-to-end (reference:
+    # example/quantization/imagenet_gen_qsym.py)
+    rs = np.random.RandomState(6)
+    classes = 3
+    proto = rs.rand(classes, 24, 24, 3).astype(np.float32)
+    y = rs.randint(0, classes, 96)
+    X = (proto[y] + 0.05 * rs.rand(96, 24, 24, 3)).astype(np.float32)
+    # large held-out eval set so the 1% accuracy bar is meaningful at
+    # sample granularity (1/384 = 0.26%)
+    ye = rs.randint(0, classes, 384)
+    Xe = (proto[ye] + 0.05 * rs.rand(384, 24, 24, 3)).astype(np.float32)
+
+    mx.random.seed(4)
+    from mxnet_tpu.models.mobilenet import MobileNetV2
+    net = MobileNetV2(multiplier=0.25, classes=classes, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    xs, ys = mx.nd.array(X), mx.nd.array(y)
+    net.hybridize()
+    for _ in range(60):
+        with mx.autograd.record():
+            l = loss_fn(net(xs), ys).mean()
+        l.backward()
+        tr.step(1)
+    # BN running-stat warmup: train-mode forwards with frozen weights so
+    # predict-mode eval sees converged statistics
+    for _ in range(30):
+        with mx.autograd.train_mode():
+            net(xs)
+    acc_fp32 = _accuracy(net, Xe, ye)
+    assert acc_fp32 > 0.95, acc_fp32
+
+    calib = [mx.nd.array(X[i * 32:(i + 1) * 32]) for i in range(3)]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+
+    # every conv (incl. depthwise groups>1) must be on the int8 path
+    def count(block, kind):
+        n = int(type(block).__name__ == kind)
+        return n + sum(count(c, kind) for c in block._children.values())
+
+    assert count(qnet, "Conv2D") == 0
+    assert count(qnet, "QuantizedConv2D") > 10
+    acc_q = _accuracy(qnet, Xe, ye)
+    assert acc_q >= acc_fp32 - 0.01, (acc_fp32, acc_q)
