@@ -14,15 +14,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 import numpy as np
 
+from bench import BudgetGuard, _acquire_backend, _enable_compile_cache
+
 REFERENCE_GBPS = 130.0  # NCCL allreduce on 8xV100 NVLink (bus BW)
 
 
 def main():
+    guard = BudgetGuard("kvstore_allreduce_gbps", "GB/s").install()
+    _enable_compile_cache()
+    backend = _acquire_backend(max_wait=min(240.0, guard.budget_s / 3))
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from mxnet_tpu.parallel import make_mesh
 
+    guard.best.update({"backend": backend, "phase": "backend_acquired"})
     n = len(jax.devices())
     mesh = make_mesh([n], ["dp"])
     mb = int(os.environ.get("BENCH_MB", 64))
@@ -51,13 +58,24 @@ def main():
     bytes_moved = 2 * (n - 1) / max(n, 1) * size * 4 * reps \
         if n > 1 else size * 4 * reps
     gbps = bytes_moved / dt / 1e9
-    print(json.dumps({
-        "metric": "kvstore_allreduce_gbps",
+    guard.best.update({
         "value": round(gbps, 2),
-        "unit": "GB/s",
         "vs_baseline": round(gbps / REFERENCE_GBPS, 3),
-    }))
+        "devices": n, "mb": mb, "reps": reps,
+        "phase": "allreduce",
+    })
+    guard.emit()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # always emit a JSON line; rc stays 0
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "kvstore_allreduce_gbps",
+            "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }))

@@ -30,13 +30,17 @@ class Context:
     # -- jax resolution -----------------------------------------------------
     @property
     def jax_device(self) -> jax.Device:
+        # local (addressable) devices only: in a multi-process job,
+        # jax.devices() lists every host's chips and eager placement on
+        # a non-addressable device is invalid
+        local = jax.local_devices()
         if self.device_type == "tpu":
-            devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+            devs = [d for d in local if d.platform in ("tpu", "axon")]
             if not devs:  # CPU test platform: emulate tpu ids on host devices
-                devs = jax.devices()
+                devs = local
             return devs[self.device_id % len(devs)]
-        return jax.devices("cpu")[self.device_id % len(jax.devices("cpu"))] \
-            if any(d.platform == "cpu" for d in jax.devices()) else jax.devices()[0]
+        cpus = [d for d in local if d.platform == "cpu"]
+        return cpus[self.device_id % len(cpus)] if cpus else local[0]
 
     # -- context-manager stack ---------------------------------------------
     def __enter__(self):
@@ -83,13 +87,15 @@ def current_context() -> Context:
 
 
 def _default_context() -> Context:
-    if any(d.platform in ("tpu", "axon") for d in jax.devices()):
+    if any(d.platform in ("tpu", "axon") for d in jax.local_devices()):
         return Context("tpu", 0)
     return Context("cpu", 0)
 
 
 def num_tpus() -> int:
-    return len([d for d in jax.devices() if d.platform in ("tpu", "axon")])
+    """Local (this host's) TPU count, like the reference's num_gpus."""
+    return len([d for d in jax.local_devices()
+                if d.platform in ("tpu", "axon")])
 
 
 def num_gpus() -> int:  # reference API parity (mx.context.num_gpus)

@@ -11,9 +11,18 @@ from ._ops_shape import one_hot  # noqa: F401 (re-export parity)
 
 __all__ = ["isnan", "isinf", "isfinite", "index_copy", "index_array",
            "getnnz", "arange_like", "check_numerics", "has_inf_or_nan",
-           "div_sqrt_dim", "fft_stub", "boolean_mask", "allclose",
+           "div_sqrt_dim", "fft", "ifft", "fft_stub", "boolean_mask",
+           "allclose",
            "interleaved_matmul_selfatt_qk", "rotary_embedding",
-           "foreach", "while_loop", "cond"]
+           "foreach", "while_loop", "cond",
+           "ROIAlign", "box_nms", "box_iou", "DeformableConvolution"]
+
+# vision contrib ops live in vision_ops.py; re-export under the
+# upstream contrib names (src/operator/contrib/roi_align.cc,
+# bounding_box.cc, deformable_convolution.cc)
+from .vision_ops import (roi_align as ROIAlign,  # noqa: E402,F401
+                         box_nms, box_iou,
+                         deformable_convolution as DeformableConvolution)
 
 
 def isnan(data):
@@ -119,9 +128,38 @@ def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
     return invoke(f, [queries_keys_values])
 
 
-def fft_stub(*a, **k):
-    raise NotImplementedError("FFT ops: use jnp.fft via raw jax; not in the "
-                              "reference's TPU-critical path")
+def fft(data, compute_size=None):
+    """1-D FFT over the trailing axis with the reference's interleaved
+    real/imag output layout: (..., d) real -> (..., 2d) where
+    out[..., 2k] = Re(X_k), out[..., 2k+1] = Im(X_k)
+    (reference: src/operator/contrib/fft.cc — a cuFFT-only GPU op there;
+    here jnp.fft lowers to XLA's FFT HLO which runs on TPU natively).
+    compute_size is accepted for API parity and ignored (no batching
+    constraint on TPU)."""
+    def f(x):
+        X = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+        out = jnp.stack([X.real, X.imag], axis=-1)
+        return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)) \
+            .astype(jnp.float32)
+    return invoke(f, [data])
+
+
+def ifft(data, compute_size=None):
+    """Inverse of contrib.fft: (..., 2d) interleaved -> (..., d) real
+    (reference: src/operator/contrib/fft.cc ifft). Like the reference's
+    cuFFT path the inverse is UNNORMALIZED — callers divide by d
+    themselves, exactly as upstream documents — so ported scripts get
+    bit-compatible semantics."""
+    def f(x):
+        d = x.shape[-1] // 2
+        z = x.reshape(x.shape[:-1] + (d, 2))
+        X = jax.lax.complex(z[..., 0], z[..., 1])
+        return (jnp.fft.ifft(X, axis=-1).real * d).astype(jnp.float32)
+    return invoke(f, [data])
+
+
+def fft_stub(*a, **k):  # backwards-compat alias for the old stub name
+    return fft(*a, **k)
 
 
 # -- control-flow operators (reference: src/operator/control_flow.cc ------

@@ -7,7 +7,8 @@ from ..ndarray import invoke
 
 __all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
            "gelqf", "syevd", "inverse", "det", "slogdet", "cholesky", "svd",
-           "norm"]
+           "norm", "solve", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian"]
 
 
 def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
@@ -108,3 +109,81 @@ def svd(A):
 def norm(A, ord=2, axis=None, keepdims=False):
     from ._ops_reduce import norm as _n
     return _n(A, ord=ord, axis=axis, keepdims=keepdims)
+
+
+def solve(A, B):
+    """Solve A x = B for general square A (batched on the trailing two
+    axes). Reference: la_op linalg_solve. Differentiable via jax's
+    lu-solve vjp."""
+    return invoke(jnp.linalg.solve, [A, B])
+
+
+def sumlogdiag(A):
+    """sum(log(diag(A))) over the trailing 2 axes (reference: la_op
+    sumlogdiag — the log-likelihood term for cholesky factors)."""
+    return invoke(
+        lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                          axis=-1), [A])
+
+
+def extractdiag(A, offset=0):
+    return invoke(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1), [A])
+
+
+def makediag(A, offset=0):
+    """Embed the trailing axis as the (offset) diagonal of a zero square
+    matrix (reference: la_op makediag)."""
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return out.at[..., r, c].set(a)
+    return invoke(f, [A])
+
+
+def extracttrian(A, offset=0, lower=True):
+    """Pack the (lower/upper) triangle rows into a flat trailing axis
+    (reference: la_op extracttrian)."""
+    def f(a):
+        n = a.shape[-1]
+        if lower:
+            r, c = jnp.tril_indices(n, k=offset)
+        else:
+            r, c = jnp.triu_indices(n, k=offset)
+        return a[..., r, c]
+    return invoke(f, [A])
+
+
+def maketrian(A, offset=0, lower=True):
+    """Inverse of extracttrian: unpack a flat triangle back into a
+    (zero-filled) square matrix. The matrix size is recovered by
+    searching the (monotone in n) packed length — closed-form
+    inversion of n(n+1)/2 is wrong once the offset widens or narrows
+    the triangle."""
+    import numpy as _host_np
+
+    def count(n):
+        idx = (_host_np.tril_indices(n, offset) if lower
+               else _host_np.triu_indices(n, offset))
+        return idx[0].size
+
+    def f(a):
+        m = a.shape[-1]
+        n = 1
+        while count(n) < m:
+            n += 1
+        if count(n) != m:
+            raise ValueError(
+                f"packed length {m} is not a valid "
+                f"{'lower' if lower else 'upper'} triangle with "
+                f"offset {offset}")
+        if lower:
+            r, c = jnp.tril_indices(n, k=offset)
+        else:
+            r, c = jnp.triu_indices(n, k=offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return out.at[..., r, c].set(a)
+    return invoke(f, [A])

@@ -1,0 +1,332 @@
+"""Vision ops: ROI pooling/align, bilinear sampling, spatial transform,
+NMS, deformable convolution.
+
+Reference parity: upstream src/operator/roi_pooling.cc,
+src/operator/contrib/roi_align.cc, src/operator/bilinear_sampler.cc,
+src/operator/spatial_transformer.cc, src/operator/contrib/nms.cc,
+src/operator/contrib/deformable_convolution.cc. TPU-first redesign:
+every op is a fixed-shape vectorized gather / masked reduction — no
+data-dependent shapes, no scalar loops — so XLA can fuse and tile them
+(the reference's CUDA kernels loop per-ROI/per-pixel; here vmap +
+take/one_hot formulations keep everything on the MXU/VPU).
+
+Layouts follow upstream: data is NCHW, rois are (R, 5)
+[batch_idx, x1, y1, x2, y2] in image coordinates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import invoke
+
+__all__ = ["ROIPooling", "roi_align", "BilinearSampler", "GridGenerator",
+           "SpatialTransformer", "box_nms", "box_iou",
+           "deformable_convolution"]
+
+
+def _bilinear_gather(img, ys, xs):
+    """Sample img (C, H, W) at float coords (ys, xs) of any shape ->
+    (C, *shape). Out-of-bounds samples are zero (border handled by
+    clamping the corner reads, zeroing fully-outside points)."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    inside = ((ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)) \
+        .astype(img.dtype)
+
+    def read(yi, xi):
+        oob = ((yi < 0) | (yi > H - 1) | (xi < 0) | (xi > W - 1))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                       # (C, *shape)
+        return jnp.where(oob[None], jnp.zeros_like(v), v)
+
+    v00 = read(y0, x0)
+    v01 = read(y0, x0 + 1)
+    v10 = read(y0 + 1, x0)
+    v11 = read(y0 + 1, x0 + 1)
+    out = (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+           + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+    return out * inside[None]
+
+
+def ROIPooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Max-pool each quantized ROI bin to a fixed (ph, pw) grid
+    (reference: src/operator/roi_pooling.cc). Masked-max formulation:
+    each output bin takes max over the full feature map under its bin
+    mask — fixed shapes, fully parallel."""
+    ph, pw = pooled_size
+
+    def f(x, r):
+        N, C, H, W = x.shape
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * spatial_scale)
+            y1 = jnp.round(roi[2] * spatial_scale)
+            x2 = jnp.round(roi[3] * spatial_scale)
+            y2 = jnp.round(roi[4] * spatial_scale)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            img = jnp.take(x, b, axis=0)          # (C, H, W)
+            iy = jnp.arange(H, dtype=x.dtype)
+            ix = jnp.arange(W, dtype=x.dtype)
+            # bin index of each pixel row/col relative to this roi
+            hstart = jnp.floor((iy - y1) / (rh / ph))
+            wstart = jnp.floor((ix - x1) / (rw / pw))
+            rowm = (hstart[None, :] ==
+                    jnp.arange(ph, dtype=x.dtype)[:, None]) \
+                & (iy[None, :] >= y1) & (iy[None, :] <= y2)  # (ph, H)
+            colm = (wstart[None, :] ==
+                    jnp.arange(pw, dtype=x.dtype)[:, None]) \
+                & (ix[None, :] >= x1) & (ix[None, :] <= x2)  # (pw, W)
+            mask = rowm[:, None, :, None] & colm[None, :, None, :]
+            neg = jnp.asarray(-jnp.inf, x.dtype)
+            masked = jnp.where(mask[None], img[:, None, None],
+                               neg)                 # (C, ph, pw, H, W)
+            out = jnp.max(masked, axis=(-2, -1))
+            # empty bins (possible for tiny rois) pool to 0 like the ref
+            return jnp.where(jnp.isfinite(out), out,
+                             jnp.zeros_like(out))
+
+        return jax.vmap(one_roi)(r)                # (R, C, ph, pw)
+
+    return invoke(f, [data, rois])
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0,
+              sample_ratio=2, aligned=False):
+    """Average of bilinear samples per bin, no quantization
+    (reference: src/operator/contrib/roi_align.cc)."""
+    ph, pw = pooled_size
+    s = max(int(sample_ratio), 1)
+
+    def f(x, r):
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            off = 0.5 if aligned else 0.0
+            x1 = roi[1] * spatial_scale - off
+            y1 = roi[2] * spatial_scale - off
+            x2 = roi[3] * spatial_scale - off
+            y2 = roi[4] * spatial_scale - off
+            rh = y2 - y1
+            rw = x2 - x1
+            if not aligned:
+                rh = jnp.maximum(rh, 1.0)
+                rw = jnp.maximum(rw, 1.0)
+            bh, bw = rh / ph, rw / pw
+            # s*s sample points per bin at bin-relative offsets
+            gy = (jnp.arange(ph)[:, None] +
+                  (jnp.arange(s)[None, :] + 0.5) / s)   # (ph, s)
+            gx = (jnp.arange(pw)[:, None] +
+                  (jnp.arange(s)[None, :] + 0.5) / s)   # (pw, s)
+            ys = y1 + gy * bh                            # (ph, s)
+            xs = x1 + gx * bw                            # (pw, s)
+            Y = jnp.broadcast_to(ys[:, :, None, None], (ph, s, pw, s))
+            X = jnp.broadcast_to(xs[None, None, :, :], (ph, s, pw, s))
+            img = jnp.take(x, b, axis=0)
+            v = _bilinear_gather(img, Y, X)              # (C, ph, s, pw, s)
+            return jnp.mean(v, axis=(2, 4))              # (C, ph, pw)
+
+        return jax.vmap(one_roi)(r)
+
+    return invoke(f, [data, rois])
+
+
+def BilinearSampler(data, grid):
+    """Sample data (N, C, H, W) at grid (N, 2, Ho, Wo) of [-1, 1]
+    normalized (x, y) coords (reference:
+    src/operator/bilinear_sampler.cc)."""
+    def f(x, g):
+        H, W = x.shape[-2:]
+        xs = (g[:, 0] + 1.0) * (W - 1) / 2.0   # (N, Ho, Wo)
+        ys = (g[:, 1] + 1.0) * (H - 1) / 2.0
+        return jax.vmap(_bilinear_gather)(x, ys, xs)
+
+    return invoke(f, [data, grid])
+
+
+def GridGenerator(data, transform_type="affine", target_shape=None):
+    """affine: data (N, 6) -> sampling grid (N, 2, H, W) over the
+    target shape; warp: data (N, 2, H, W) flow field -> grid
+    (reference: src/operator/grid_generator.cc)."""
+    if transform_type == "affine":
+        H, W = target_shape
+
+        def f(theta):
+            t = theta.reshape(-1, 2, 3)
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+            gx, gy = jnp.meshgrid(xs, ys)              # (H, W)
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+            out = jnp.einsum("nij,jk->nik", t, base)   # (N, 2, H*W)
+            return out.reshape(-1, 2, H, W)
+
+        return invoke(f, [data])
+    if transform_type == "warp":
+        def f(flow):
+            N, _, H, W = flow.shape
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+            gx, gy = jnp.meshgrid(xs, ys)
+            norm = jnp.stack([flow[:, 0] * 2.0 / jnp.maximum(W - 1, 1),
+                              flow[:, 1] * 2.0 / jnp.maximum(H - 1, 1)],
+                             axis=1)
+            return norm + jnp.stack([gx, gy], axis=0)[None]
+
+        return invoke(f, [data])
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def SpatialTransformer(data, loc, target_shape,
+                       transform_type="affine",
+                       sampler_type="bilinear"):
+    """Affine grid + bilinear sampling (reference:
+    src/operator/spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("only affine/bilinear supported")
+    grid = GridGenerator(loc, "affine", target_shape)
+    return BilinearSampler(data, grid)
+
+
+def box_iou(lhs, rhs, fmt="corner"):
+    """Pairwise IoU of (..., N, 4) x (..., M, 4) boxes (reference:
+    src/operator/contrib/bounding_box.cc box_iou)."""
+    def f(a, b):
+        if fmt == "center":
+            def to_corner(z):
+                cx, cy, w, h = jnp.split(z, 4, axis=-1)
+                return jnp.concatenate(
+                    [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    axis=-1)
+            a, b = to_corner(a), to_corner(b)
+        ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)   # (..., N, 1)
+        bx1, by1, bx2, by2 = jnp.split(b, 4, axis=-1)   # (..., M, 1)
+        ix1 = jnp.maximum(ax1, jnp.swapaxes(bx1, -1, -2))
+        iy1 = jnp.maximum(ay1, jnp.swapaxes(by1, -1, -2))
+        ix2 = jnp.minimum(ax2, jnp.swapaxes(bx2, -1, -2))
+        iy2 = jnp.minimum(ay2, jnp.swapaxes(by2, -1, -2))
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        area_a = (ax2 - ax1) * (ay2 - ay1)
+        area_b = (bx2 - bx1) * (by2 - by1)
+        union = area_a + jnp.swapaxes(area_b, -1, -2) - inter
+        return inter / jnp.maximum(union, 1e-12)
+
+    return invoke(f, [lhs, rhs])
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=True, in_format="corner",
+            out_format="corner"):
+    """Greedy NMS over (N, K) boxes-with-scores rows; suppressed rows
+    have score set to -1 like the reference
+    (src/operator/contrib/bounding_box.cc box_nms). lax.fori over the
+    score-sorted boxes with a running suppression mask — fixed shapes,
+    no data-dependent box count."""
+    def f(x):
+        batched = x.ndim == 3
+        xb = x if batched else x[None]
+        B, N, K = xb.shape
+        scores = xb[..., score_index]
+        boxes = jax.lax.dynamic_slice_in_dim(xb, coord_start, 4, axis=2)
+        ids = xb[..., id_index] if id_index >= 0 else None
+
+        order = jnp.argsort(-scores, axis=-1)           # (B, N)
+        inv = jnp.argsort(order, axis=-1)
+        s_scores = jnp.take_along_axis(scores, order, axis=-1)
+        s_boxes = jnp.take_along_axis(boxes, order[..., None], axis=1)
+        # pairwise IoU on sorted boxes (B, N, N)
+        if in_format == "center":
+            cx, cy, w, h = jnp.split(s_boxes, 4, axis=-1)
+            s_boxes = jnp.concatenate(
+                [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                axis=-1)
+        x1, y1, x2, y2 = jnp.split(s_boxes, 4, axis=-1)
+        ix1 = jnp.maximum(x1, jnp.swapaxes(x1, -1, -2))
+        iy1 = jnp.maximum(y1, jnp.swapaxes(y1, -1, -2))
+        ix2 = jnp.minimum(x2, jnp.swapaxes(x2, -1, -2))
+        iy2 = jnp.minimum(y2, jnp.swapaxes(y2, -1, -2))
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        area = (x2 - x1) * (y2 - y1)
+        union = area + jnp.swapaxes(area, -1, -2) - inter
+        iou = inter / jnp.maximum(union, 1e-12)          # (B, N, N)
+
+        valid = s_scores > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(N)[None] < topk)
+        same_class = jnp.ones((B, N, N), bool)
+        if ids is not None and not force_suppress:
+            s_ids = jnp.take_along_axis(ids, order, axis=-1)
+            same_class = s_ids[:, :, None] == s_ids[:, None, :]
+
+        def body(i, keep):
+            # suppress j>i overlapping box i if box i is still kept
+            row = (iou[:, i] > overlap_thresh) & same_class[:, i] \
+                & keep[:, i][:, None] & valid[:, i][:, None]
+            later = jnp.arange(N)[None] > i
+            return keep & ~(row & later)
+
+        keep = jax.lax.fori_loop(0, N, body,
+                                 jnp.ones((B, N), bool)) & valid
+        keep_orig = jnp.take_along_axis(keep, inv, axis=-1)
+        new_scores = jnp.where(keep_orig, scores,
+                               -jnp.ones_like(scores))
+        out = xb.at[..., score_index].set(new_scores)
+        return out if batched else out[0]
+
+    return invoke(f, [data])
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(1, 1), dilate=(1, 1),
+                           num_filter=None, num_deformable_group=1):
+    """Deformable conv v1 (reference:
+    src/operator/contrib/deformable_convolution.cc). Formulated as
+    offset-shifted bilinear im2col (one big gather) followed by an
+    einsum — the whole op is a single fused XLA computation instead of
+    the reference's per-position CUDA kernel.
+
+    data (N, C, H, W); offset (N, 2*G*kh*kw, Ho, Wo) with [dy, dx]
+    interleaved per tap; weight (Co, C, kh, kw)."""
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    G = num_deformable_group
+
+    def f(x, off, w, *maybe_bias):
+        N, C, H, W = x.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        base_y = jnp.arange(Ho) * sh - ph               # (Ho,)
+        base_x = jnp.arange(Wo) * sw - pw               # (Wo,)
+        ky = jnp.arange(kh) * dh                        # (kh,)
+        kx = jnp.arange(kw) * dw                        # (kw,)
+        # grid positions before offsets: (kh, kw, Ho, Wo)
+        gy = base_y[None, None, :, None] + ky[:, None, None, None]
+        gx = base_x[None, None, None, :] + kx[None, :, None, None]
+
+        offr = off.reshape(N, G, kh, kw, 2, Ho, Wo)
+
+        def one_image(img, o):
+            # o: (G, kh, kw, 2, Ho, Wo)
+            ys = gy[None] + o[..., 0, :, :]             # (G, kh, kw, Ho, Wo)
+            xs = gx[None] + o[..., 1, :, :]
+            imgs = img.reshape(G, C // G, H, W)
+            cols = jax.vmap(_bilinear_gather)(
+                imgs, ys, xs)                            # (G, C/G, kh, kw, Ho, Wo)
+            return cols.reshape(C, kh, kw, Ho, Wo)
+
+        cols = jax.vmap(one_image)(x, offr)             # (N, C, kh, kw, Ho, Wo)
+        out = jnp.einsum("ncklhw,ockl->nohw", cols, w)
+        if maybe_bias:
+            out = out + maybe_bias[0][None, :, None, None]
+        return out
+
+    args = [data, offset, weight] + ([bias] if bias is not None else [])
+    return invoke(f, args)

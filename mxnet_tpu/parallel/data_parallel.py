@@ -33,6 +33,18 @@ def split_batch_spec(ndim: int, axis: int = 0, dp_axis: str = "dp"):
     return P(*spec)
 
 
+def _global_put(v, sh):
+    """device_put that works on multi-process meshes: a committed
+    process-local array cannot be resharded onto a global mesh (jax
+    raises on the cross-host transfer), but its VALUE is identical on
+    every process (replicated init / host numpy), so round-trip through
+    the host and let device_put write only the addressable shards."""
+    try:
+        return jax.device_put(v, sh)
+    except ValueError:
+        return jax.device_put(_np.asarray(v), sh)
+
+
 def _param_shardings(params, names, mesh):
     """NamedSharding per parameter: its Parameter.sharding spec, else
     replicated."""
@@ -322,11 +334,12 @@ class FusedTrainStep:
                 out_shardings=(repl, tr_sh, aux_sh, st_sh),
                 donate_argnums=(0, 2) if self.donate else ())
             # place initial state on the mesh (args arrive single-device)
-            self._tr = {n: jax.device_put(v, tr_sh[n])
+            self._tr = {n: _global_put(v, tr_sh[n])
                         for n, v in self._tr.items()}
-            self._aux = {n: jax.device_put(v, aux_sh[n])
+            self._aux = {n: _global_put(v, aux_sh[n])
                          for n, v in self._aux.items()}
-            self._states = jax.device_put(self._states, st_sh)
+            self._states = jax.tree_util.tree_map(_global_put,
+                                                  self._states, st_sh)
             self._batch_sh = batch_sh
             self._tr_sh, self._aux_sh, self._st_sh = tr_sh, aux_sh, st_sh
         else:
@@ -386,11 +399,12 @@ class FusedTrainStep:
         self._compiled = jax.jit(
             fn, donate_argnums=(0, 2, 5) if self.donate else ())
         repl = NamedSharding(mesh, P())
-        self._tr = {n: jax.device_put(v, repl)
+        self._tr = {n: _global_put(v, repl)
                     for n, v in self._tr.items()}
-        self._aux = {n: jax.device_put(v, repl)
+        self._aux = {n: _global_put(v, repl)
                      for n, v in self._aux.items()}
-        self._states = jax.device_put(self._states, repl)
+        self._states = jax.tree_util.tree_map(
+            lambda v: _global_put(v, repl), self._states)
         self._resid = {
             n: jax.device_put(
                 jnp.zeros((ndp,) + tuple(self._tr[n].shape), jnp.float32),
@@ -425,7 +439,7 @@ class FusedTrainStep:
         raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
                for a in args]
         if self.mesh is not None:
-            raw = [jax.device_put(r, sh)
+            raw = [_global_put(r, sh)
                    for r, sh in zip(raw, self._batch_sh)]
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):

@@ -1,0 +1,154 @@
+"""nd.linalg + contrib FFT parity vs numpy (reference:
+src/operator/tensor/la_op.cc, src/operator/contrib/fft.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.nd import linalg, contrib
+
+
+def _spd(n=4, batch=(), seed=0):
+    rs = np.random.RandomState(seed)
+    a = rs.randn(*batch, n, n).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32)
+
+
+def test_cholesky_and_potri():
+    A = _spd(4, seed=1)
+    L = linalg.potrf(mx.nd.array(A)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, A, rtol=1e-4, atol=1e-4)
+    Ainv = linalg.potri(mx.nd.array(A)).asnumpy()
+    np.testing.assert_allclose(Ainv, np.linalg.inv(A), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_solve_batched_matches_numpy():
+    A = _spd(5, batch=(3,), seed=2)
+    B = np.random.RandomState(3).randn(3, 5, 2).astype(np.float32)
+    X = linalg.solve(mx.nd.array(A), mx.nd.array(B)).asnumpy()
+    np.testing.assert_allclose(X, np.linalg.solve(A, B), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_solve_gradient():
+    A = _spd(3, seed=4)
+    B = np.random.RandomState(5).randn(3, 1).astype(np.float32)
+    a, b = mx.nd.array(A), mx.nd.array(B)
+    a.attach_grad()
+    with mx.autograd.record():
+        loss = (linalg.solve(a, b) ** 2).sum()
+    loss.backward()
+    g = a.grad.asnumpy()
+    # finite-difference check on one entry
+    eps = 1e-3
+
+    def f(Ap):
+        return float((np.linalg.solve(Ap, B) ** 2).sum())
+
+    Ap = A.copy()
+    Ap[1, 2] += eps
+    Am = A.copy()
+    Am[1, 2] -= eps
+    fd = (f(Ap) - f(Am)) / (2 * eps)
+    np.testing.assert_allclose(g[1, 2], fd, rtol=2e-2, atol=2e-2)
+
+
+def test_inverse_det_slogdet():
+    A = _spd(4, seed=6)
+    np.testing.assert_allclose(linalg.inverse(mx.nd.array(A)).asnumpy(),
+                               np.linalg.inv(A), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(linalg.det(mx.nd.array(A)).asnumpy(),
+                               np.linalg.det(A), rtol=1e-3)
+    s, ld = linalg.slogdet(mx.nd.array(A))
+    rs, rld = np.linalg.slogdet(A)
+    assert float(s.asnumpy()) == pytest.approx(rs)
+    assert float(ld.asnumpy()) == pytest.approx(rld, rel=1e-4)
+
+
+def test_syevd_svd():
+    A = _spd(4, seed=7)
+    V, w = linalg.syevd(mx.nd.array(A))
+    wr = np.linalg.eigvalsh(A)
+    np.testing.assert_allclose(np.sort(w.asnumpy()), np.sort(wr),
+                               rtol=1e-4)
+    # rows of V are eigenvectors: V_row diag(w) V_row^T == A
+    Vn = V.asnumpy()
+    np.testing.assert_allclose(Vn.T @ np.diag(w.asnumpy()) @ Vn, A,
+                               rtol=1e-3, atol=1e-3)
+    M = np.random.RandomState(8).randn(5, 3).astype(np.float32)
+    U, S, VT = linalg.svd(mx.nd.array(M))
+    np.testing.assert_allclose(
+        U.asnumpy() @ np.diag(S.asnumpy()) @ VT.asnumpy(), M,
+        rtol=1e-3, atol=1e-3)
+
+
+def test_sumlogdiag():
+    A = _spd(4, seed=9)
+    out = float(linalg.sumlogdiag(mx.nd.array(A)).asnumpy())
+    assert out == pytest.approx(float(np.log(np.diag(A)).sum()), rel=1e-5)
+
+
+@pytest.mark.parametrize("offset", [0, 1, -2])
+def test_diag_roundtrip(offset):
+    rs = np.random.RandomState(10)
+    d = rs.randn(5).astype(np.float32)
+    M = linalg.makediag(mx.nd.array(d), offset=offset).asnumpy()
+    np.testing.assert_allclose(np.diagonal(M, offset=offset), d)
+    back = linalg.extractdiag(mx.nd.array(M), offset=offset).asnumpy()
+    np.testing.assert_allclose(back, d)
+
+
+@pytest.mark.parametrize("lower", [True, False])
+@pytest.mark.parametrize("offset", [0, 1, -1])
+def test_trian_roundtrip(lower, offset):
+    A = _spd(4, seed=11)
+    tri = np.tril(A, offset) if lower else np.triu(A, offset)
+    packed = linalg.extracttrian(mx.nd.array(A), offset=offset,
+                                 lower=lower)
+    M = linalg.maketrian(packed, offset=offset, lower=lower).asnumpy()
+    np.testing.assert_allclose(M, tri, rtol=1e-6)
+
+
+def test_trsm_trmm_syrk_gelqf():
+    A = _spd(4, seed=12)
+    L = np.linalg.cholesky(A)
+    B = np.random.RandomState(13).randn(4, 2).astype(np.float32)
+    X = linalg.trsm(mx.nd.array(L), mx.nd.array(B)).asnumpy()
+    np.testing.assert_allclose(L @ X, B, rtol=1e-3, atol=1e-3)
+    Y = linalg.trmm(mx.nd.array(L), mx.nd.array(B)).asnumpy()
+    np.testing.assert_allclose(Y, L @ B, rtol=1e-4, atol=1e-4)
+    S = linalg.syrk(mx.nd.array(L)).asnumpy()
+    np.testing.assert_allclose(S, L @ L.T, rtol=1e-4, atol=1e-4)
+    M = np.random.RandomState(14).randn(3, 5).astype(np.float32)
+    Lq, Q = linalg.gelqf(mx.nd.array(M))
+    np.testing.assert_allclose(Lq.asnumpy() @ Q.asnumpy(), M, rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_fft_ifft_roundtrip_and_parity():
+    rs = np.random.RandomState(15)
+    x = rs.randn(3, 8).astype(np.float32)
+    out = contrib.fft(mx.nd.array(x)).asnumpy()
+    assert out.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(out[:, 0::2], ref.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(out[:, 1::2], ref.imag, rtol=1e-4,
+                               atol=1e-4)
+    # ifft is cuFFT-unnormalized like the reference: callers divide by d
+    back = contrib.ifft(mx.nd.array(out)).asnumpy() / 8
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_gradient_flows():
+    x = mx.nd.array(np.random.RandomState(16).randn(2, 8)
+                    .astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        l = (contrib.fft(x) ** 2).sum()
+    l.backward()
+    # Parseval: sum|X|^2 = n * sum|x|^2, so dl/dx = 2n x
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * 8 * x.asnumpy(),
+                               rtol=1e-3, atol=1e-3)

@@ -1,0 +1,172 @@
+"""Vision ops (ROI pooling/align, sampling, NMS, deformable conv) vs
+hand-computed references (reference: src/operator/roi_pooling.cc,
+contrib/roi_align.cc, bilinear_sampler.cc, contrib/bounding_box.cc,
+contrib/deformable_convolution.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.nd import contrib
+
+
+def test_roi_pooling_matches_manual():
+    # 1x1x4x4 ramp image, one roi covering the left 2x4 block, 2x2 bins
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 1, 3]], dtype=np.float32)  # x1,y1,x2,y2
+    out = mx.nd.ROIPooling(mx.nd.array(x), mx.nd.array(rois),
+                           pooled_size=(2, 2)).asnumpy()
+    # roi spans cols 0..1, rows 0..3 -> bins: rows{0,1}x cols{0},{1}...
+    # bin(0,0)=max(x[0:2,0:1])=4; bin(0,1)=max(x[0:2,1:2])=5
+    # bin(1,0)=max(x[2:4,0:1])=12; bin(1,1)=max(x[2:4,1:2])=13
+    np.testing.assert_allclose(out[0, 0], [[4, 5], [12, 13]])
+
+
+def test_roi_pooling_spatial_scale():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 7, 7]], dtype=np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(x), mx.nd.array(rois), (2, 2),
+                           spatial_scale=0.5).asnumpy()
+    # scaled roi rounds to 0..4 -> bin width 2.5: rows/cols {0,1,2}
+    # land in bin 0, {3} in bin 1 (col 4 is outside the 4px map)
+    np.testing.assert_allclose(out[0, 0], [[10, 11], [14, 15]])
+
+
+def test_roi_align_constant_image():
+    # constant image: any roi/bin averages to the constant
+    x = np.full((1, 3, 8, 8), 2.5, np.float32)
+    rois = np.array([[0, 1.3, 2.1, 6.7, 7.2]], np.float32)
+    out = contrib.ROIAlign(mx.nd.array(x), mx.nd.array(rois),
+                           (3, 3)).asnumpy()
+    assert out.shape == (1, 3, 3, 3)
+    np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+
+def test_roi_align_linear_ramp():
+    # bilinear sampling of a linear ramp is exact -> bin averages equal
+    # the ramp at bin centers
+    H = W = 8
+    ramp = np.arange(W, dtype=np.float32)[None, None, None, :].repeat(
+        H, axis=2)  # value = x coordinate
+    rois = np.array([[0, 1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = contrib.ROIAlign(mx.nd.array(ramp), mx.nd.array(rois), (2, 2),
+                           sample_ratio=2).asnumpy()
+    # roi width 4 (x in [1,5]) -> bins of width 2 centered at x=2, 4
+    np.testing.assert_allclose(out[0, 0, 0], [2.0, 4.0], rtol=1e-5)
+
+
+def test_bilinear_sampler_identity():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 5, 7).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                         indexing="ij")
+    grid = np.stack([xs, ys], axis=0)[None].repeat(2, axis=0) \
+        .astype(np.float32)
+    out = mx.nd.BilinearSampler(mx.nd.array(x),
+                                mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_generator_identity_affine():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    g = mx.nd.GridGenerator(mx.nd.array(theta), "affine",
+                            target_shape=(3, 5)).asnumpy()
+    assert g.shape == (1, 2, 3, 5)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 5),
+                               rtol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               rtol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rs = np.random.RandomState(1)
+    x = rs.rand(2, 2, 6, 6).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                   target_shape=(6, 6)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_known_values():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], np.float32)
+    iou = contrib.box_iou(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [id, score, x1, y1, x2, y2]
+    data = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # heavy overlap with row 0
+        [0, 0.7, 5, 5, 7, 7],            # disjoint
+        [0, 0.05, 8, 8, 9, 9],           # below valid_thresh
+    ], np.float32)
+    out = contrib.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                          valid_thresh=0.1).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)    # kept
+    assert out[1, 1] == -1.0                  # suppressed by row 0
+    assert out[2, 1] == pytest.approx(0.7)    # kept (disjoint)
+    assert out[3, 1] == -1.0                  # invalid score
+    # coordinates unchanged
+    np.testing.assert_allclose(out[:, 2:], data[:, 2:])
+
+
+def test_box_nms_per_class():
+    data = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [1, 0.8, 0.1, 0.1, 2.1, 2.1],   # overlaps but other class
+    ], np.float32)
+    out = contrib.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                          force_suppress=False, id_index=0).asnumpy()
+    assert out[1, 1] == pytest.approx(0.8)    # survives: class differs
+    out2 = contrib.box_nms(mx.nd.array(data), overlap_thresh=0.5,
+                           force_suppress=True, id_index=0).asnumpy()
+    assert out2[1, 1] == -1.0                 # forced suppression
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(2)
+    x = rs.rand(2, 3, 8, 8).astype(np.float32)
+    w = (rs.rand(4, 3, 3, 3).astype(np.float32) - 0.5)
+    b = rs.rand(4).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        mx.nd.array(b), kernel=(3, 3), pad=(1, 1)).asnumpy()
+    ref = mx.nd.Convolution(
+        mx.nd.array(x), mx.nd.array(w), mx.nd.array(b), kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1), num_filter=4,
+        layout="NCHW").asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_constant_shift():
+    # constant offset of one pixel right == conv on shifted image (in
+    # the interior, away from borders)
+    rs = np.random.RandomState(3)
+    x = rs.rand(1, 1, 10, 10).astype(np.float32)
+    w = rs.rand(1, 1, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 10, 10), np.float32)
+    off[:, 1::2] = 1.0  # dx = +1 everywhere
+    out = contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), pad=(1, 1)).asnumpy()
+    ref = contrib.DeformableConvolution(
+        mx.nd.array(np.roll(x, -1, axis=3)),
+        mx.nd.array(np.zeros_like(off)), mx.nd.array(w),
+        kernel=(3, 3), pad=(1, 1)).asnumpy()
+    np.testing.assert_allclose(out[..., 2:-2, 2:-2],
+                               ref[..., 2:-2, 2:-2], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_roi_align_gradient_flows():
+    x = mx.nd.array(np.random.RandomState(4).rand(1, 2, 6, 6)
+                    .astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        l = (contrib.ROIAlign(x, rois, (2, 2)) ** 2).sum()
+    l.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
