@@ -1,0 +1,160 @@
+"""Flash-decode: single-token attention against a static KV cache.
+
+Reference analogue: the fork's fused decoder-attention kernels
+(interleaved_matmul_encdec_* / fmha inference paths). TPU-first: during
+autoregressive decoding the bottleneck is streaming the (B, S, K, d)
+cache from HBM; this kernel tiles the cache through VMEM with an
+online-softmax accumulator and never materializes the GQA head
+repetition (q rows for one kv head attend to the SAME cache block, so
+the block is read once per kv head instead of once per query head —
+1/rep of the naive jnp.repeat traffic).
+
+Layout: q (B, H, d) for ONE decode position, caches (B, S, K, d) with
+H = K * rep, valid lengths (B,) masking the un-filled cache tail.
+Grid (B, K, S/blk); the S axis runs sequentially so VMEM scratch
+carries the running max / normalizer / accumulator across blocks.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import KernelFallback
+
+__all__ = ["flash_decode", "reference_decode_attention"]
+
+_fallback = KernelFallback("flash-decode",
+                           strict_envs=("MXNET_TPU_STRICT_FLASH",))
+
+
+def __getattr__(name):
+    if name == "FALLBACK_COUNT":
+        return _fallback.count
+    raise AttributeError(name)
+
+
+def reference_decode_attention(q, k_cache, v_cache, valid_len,
+                               scale=None):
+    """jnp reference. GQA WITHOUT jnp.repeat: fold the rep axis into
+    the einsum so XLA reads the cache once per kv head."""
+    B, H, d = q.shape
+    K = k_cache.shape[2]
+    rep = H // K
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qr = q.reshape(B, K, rep, d).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    s = jnp.einsum("bkrd,bskd->bkrs", qr, kf) * scale
+    S = k_cache.shape[1]
+    mask = jnp.arange(S)[None, :] < valid_len[:, None]        # (B, S)
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkrs,bskd->bkrd", p, vf)
+    return out.reshape(B, H, d).astype(q.dtype)
+
+
+def _flash_decode_pallas(q, k_cache, v_cache, valid_len, scale,
+                         interpret, block_s=256):
+    """Grid (B, K): one kernel instance owns a kv head's full cache
+    (S, d) in VMEM and sweeps it in blocks with a fori_loop — the same
+    walk as flash_attention's forward, but with one (rep, d) query
+    block and a valid-length mask instead of the causal mask."""
+    from jax.experimental import pallas as pl
+
+    B, H, d = q.shape
+    S, K = k_cache.shape[1], k_cache.shape[2]
+    rep = H // K
+    blk = max(1, min(block_s, S))
+    while S % blk:
+        blk //= 2
+    n_s = S // blk
+    qr = q.reshape(B, K, rep, d)
+
+    def kernel(vl_ref, q_ref, k_ref, v_ref, o_ref):
+        qblk = q_ref[...].astype(jnp.float32) * scale    # (rep, d)
+        vl = vl_ref[0]
+        m = jnp.full((rep,), -jnp.inf, jnp.float32)
+        l = jnp.zeros((rep,), jnp.float32)
+        acc = jnp.zeros((rep, d), jnp.float32)
+
+        def body(sj, carry):
+            m_, l_, acc_ = carry
+            kblk = k_ref[pl.dslice(sj * blk, blk), :] \
+                .astype(jnp.float32)                     # (blk, d)
+            vblk = v_ref[pl.dslice(sj * blk, blk), :] \
+                .astype(jnp.float32)
+            s = qblk @ kblk.T                            # (rep, blk)
+            pos = sj * blk + jax.lax.broadcasted_iota(
+                jnp.int32, (rep, blk), 1)
+            s = jnp.where(pos < vl, s, -jnp.inf)
+            m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
+            corr = jnp.where(jnp.isfinite(m_),
+                             jnp.exp(m_ - m_new), 0.0)
+            return (m_new, corr * l_ + jnp.sum(p, axis=-1),
+                    corr[:, None] * acc_ + p @ vblk)
+
+        # only sweep blocks that can contain valid positions
+        upper = jnp.minimum(n_s, (vl + blk - 1) // blk)
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, K),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, h: (b,)),
+            pl.BlockSpec((None, None, rep, d), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((None, S, None, d), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((None, S, None, d), lambda b, h: (b, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, rep, d),
+                               lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K, rep, d), q.dtype),
+        interpret=interpret,
+    )(valid_len.astype(jnp.int32), qr, k_cache, v_cache)
+    return out.reshape(B, H, d)
+
+
+def flash_decode(q, k_cache, v_cache, valid_len, scale=None,
+                 use_flash=True):
+    """Single-position attention against the cache; Pallas on TPU, the
+    no-repeat jnp formulation elsewhere."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mode = _pallas_mode(k_cache) if use_flash else None
+    if mode is not None:
+        try:
+            return _flash_decode_pallas(q, k_cache, v_cache, valid_len,
+                                        scale, mode == "interpret")
+        except Exception as e:
+            _fallback.note(e)
+    return reference_decode_attention(q, k_cache, v_cache, valid_len,
+                                      scale)
+
+
+# one kv head's K+V must fit VMEM (~16 MiB/core) next to the working
+# blocks; beyond this the (B, K)-grid kernel would fail at Mosaic
+# compile time INSIDE the caller's jit — where the try/except above
+# cannot catch it — so gate on static shapes instead
+_VMEM_CACHE_BUDGET_BYTES = 10 << 20
+
+
+def _pallas_mode(k_cache):
+    S, d = k_cache.shape[1], k_cache.shape[3]
+    if S % 128 != 0:
+        return None
+    if 2 * S * d * k_cache.dtype.itemsize > _VMEM_CACHE_BUDGET_BYTES:
+        return None
+    if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
+        return "interpret"
+    if jax.default_backend() not in ("cpu",):
+        return "compiled"
+    return None

@@ -245,6 +245,113 @@ class AsyncKVStore(KVStore):
                 key, weight, v, self._opt_states.get(key))
 
 
+class DistPSKVStore(KVStore):
+    """'dist_sync' / 'dist_async' with a REAL multi-process data path:
+    workers talk to a parameter server (ps.PSServer, conventionally a
+    daemon thread on worker 0's host) over TCP. Reference:
+    src/kvstore/kvstore_dist.h — sync aggregates all workers' pushes
+    into one update; async applies each push on arrival (stale).
+
+    Configuration: pass addr/rank/num_workers to create(), or set
+    MXNET_KVSTORE_PS_ADDR ("host:port"), MXNET_KVSTORE_RANK,
+    MXNET_KVSTORE_NUM_WORKERS (the DMLC_* role envs' analogue)."""
+
+    def __init__(self, kv_type, addr, rank, num_workers):
+        super().__init__(kv_type)
+        from .ps import PSClient
+        self._client = PSClient(addr, rank=rank)
+        self._rank = rank
+        self._num_workers = num_workers
+        self._sync = not kv_type.endswith("async")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        v = value[0] if isinstance(value, list) else value
+        self._store[key] = v
+        self._client.init(key, _np_of(v))
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        agg = self._aggregate(value, key)  # local replica sum (+comp.)
+        self._client.push(key, _np_of(agg))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        val = self._client.pull(key, sync=self._sync)
+        arr = jnp.asarray(val)
+        self._store[key] = NDArray(arr)
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            if o is not None:
+                o._data = jax.device_put(arr, o.ctx.jax_device)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i],
+                              out[i] if out is not None else None,
+                              priority)
+            return
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Only the requested rows travel the wire (reference:
+        kvstore_dist row_sparse pull — THE bandwidth saver for
+        embedding-dominated PS training)."""
+        outs = out if isinstance(out, list) else [out]
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for o, r in zip(outs, rids):
+            rows = jax.device_get(
+                r._data if isinstance(r, NDArray) else r)
+            vals = self._client.pull_rows(key, rows, sync=self._sync)
+            if isinstance(o, RowSparseNDArray):
+                o.indices = NDArray(jnp.asarray(rows).astype(jnp.int64))
+                o.data = NDArray(jnp.asarray(vals))
+            else:
+                # dense out keeps the FULL array, matching the base
+                # KVStore's dense branch (a caller indexing by row id
+                # must see the same shape under every kv type)
+                o._data = jnp.asarray(
+                    self._client.pull(key, sync=self._sync))
+
+    def set_optimizer(self, optimizer):
+        # "update on kvstore": the SERVER owns the optimizer + states
+        self._optimizer = None
+        self._client.set_optimizer(optimizer)
+
+    def barrier(self):
+        super().barrier()
+        self._client.barrier()
+
+    def close(self):
+        self._client.close()
+
+
+def _np_of(v):
+    import numpy as np
+    data = v._data if isinstance(v, NDArray) else v
+    return np.asarray(jax.device_get(data))
+
+
 class TPUSyncKVStore(KVStore):
     """'tpu_sync' — synchronous data parallelism over the device mesh.
 
@@ -261,15 +368,35 @@ class TPUSyncKVStore(KVStore):
         return len(jax.devices())
 
 
-def create(name: str = "local") -> KVStore:
+def create(name: str = "local", addr=None, rank=None,
+           num_workers=None) -> KVStore:
     """mx.kv.create — 'local' | 'device' | 'tpu_sync' | 'dist_tpu_sync' |
-    'dist_sync' | 'dist_async' | 'nccl' (alias of tpu_sync)."""
+    'dist_sync' | 'dist_async' | 'nccl' (alias of tpu_sync).
+
+    'dist_sync'/'dist_async' use the parameter-server data path when a
+    server address is configured (addr=(host, port) or
+    MXNET_KVSTORE_PS_ADDR="host:port"); otherwise they fall back to the
+    in-process model (tpu_sync collectives / staleness simulation)."""
+    import os
+
     name = name.lower()
     if name in ("local", "device"):
         return KVStore(name)
-    if name in ("tpu_sync", "nccl", "dist_tpu_sync", "dist_sync",
+    if name in ("dist_sync", "dist_async"):
+        if addr is None and os.environ.get("MXNET_KVSTORE_PS_ADDR"):
+            host, port = os.environ["MXNET_KVSTORE_PS_ADDR"].rsplit(":", 1)
+            addr = (host, int(port))
+        if addr is not None:
+            if rank is None:
+                rank = int(os.environ.get("MXNET_KVSTORE_RANK",
+                                          jax.process_index()))
+            if num_workers is None:
+                num_workers = int(os.environ.get(
+                    "MXNET_KVSTORE_NUM_WORKERS", jax.process_count()))
+            return DistPSKVStore(name, addr, rank, num_workers)
+        return (AsyncKVStore(name) if name == "dist_async"
+                else TPUSyncKVStore(name))
+    if name in ("tpu_sync", "nccl", "dist_tpu_sync",
                 "dist_device_sync", "horovod"):
         return TPUSyncKVStore(name)
-    if name == "dist_async":
-        return AsyncKVStore(name)
     raise ValueError(f"unknown kvstore type {name!r}")

@@ -77,11 +77,21 @@ def _rope_at(x, positions, base):
 
 
 def _attend(q, k_cache, v_cache, valid_len, cfg):
-    """q: (B, Tq, H, d); caches (B, S, K, d); attend to [0, valid_len)."""
+    """q: (B, Tq, H, d); caches (B, S, K, d); attend to [0, valid_len).
+
+    Tq == 1 (the decode step, HBM-bandwidth bound) dispatches to the
+    Pallas flash-decode kernel, which streams the cache once per KV
+    head with an online softmax (kernels/flash_decode.py); the general
+    path below is the prefill/fallback."""
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    if q.shape[1] == 1:
+        from ..kernels.flash_decode import flash_decode
+        out = flash_decode(q[:, 0], k_cache, v_cache, valid_len,
+                           scale=scale)
+        return out[:, None]
     rep = cfg.num_heads // cfg.num_kv_heads
     k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
     v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
-    scale = 1.0 / math.sqrt(cfg.head_dim)
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     S = k.shape[1]

@@ -1,0 +1,286 @@
+"""Parameter-server transport for the dist_sync / dist_async KVStores.
+
+Reference parity: the PS-lite parameter server behind upstream's
+'dist_sync' / 'dist_async' kvstores (src/kvstore/kvstore_dist.h,
+kvstore_dist_server.h): workers push gradients to a server that either
+aggregates all workers' pushes before one update (sync) or applies each
+push on arrival (async, stale). This rebuild keeps the wire protocol
+deliberately small — length-prefixed pickles over TCP — because on TPU
+pods the HOT gradient path is XLA collectives over ICI
+(parallel/data_parallel.py); the PS exists for the reference's
+API/semantics (sparse pulls, optimizer offload, async staleness), not
+for bandwidth.
+
+Roles (upstream: DMLC_ROLE=server/worker/scheduler): the server is a
+daemon thread, conventionally on worker 0's host. Workers connect with
+`PSClient(addr)`.
+
+    # worker 0                            # worker 1
+    srv = PSServer(mode="sync",
+                   num_workers=2).start()
+    kv = create('dist_sync',              kv = create('dist_sync',
+        addr=srv.address, rank=0,             addr=..., rank=1,
+        num_workers=2)                        num_workers=2)
+    kv.init("w", w0)                      kv.init("w", w0)   # first wins
+    kv.push("w", grad0)                   kv.push("w", grad1)
+    kv.pull("w", out)  # both see the sum of grad0+grad1 applied once
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PSServer", "PSClient"]
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class PSServer:
+    """The server role. One daemon thread per worker connection; state
+    guarded by one lock (gradient tensors are numpy on the host — the
+    server never touches a device)."""
+
+    def __init__(self, mode="sync", num_workers=1,
+                 addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        assert mode in ("sync", "async")
+        self.mode = mode
+        self.num_workers = num_workers
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(addr)
+        self._sock.listen(num_workers + 2)
+        self.address = self._sock.getsockname()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._store: Dict = {}
+        #: key -> {rank: [queued grads]} — sync rounds close when EVERY
+        #: rank has contributed (PS-lite tracks per-worker timestamps;
+        #: counting raw pushes would let one worker's double-push close
+        #: a round alone and strand the others)
+        self._pending: Dict = {}
+        self._version: Dict = {}      # key -> completed update rounds
+        self._optimizer = None
+        self._opt_states: Dict = {}
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = False
+        self._threads = []
+
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- internals ---------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _apply(self, key, grad):
+        w = self._store[key]
+        if self._optimizer is not None:
+            # the reference's "update on kvstore": the server owns the
+            # optimizer; import here so the server also runs opt-free
+            from .ndarray import NDArray
+            wn = NDArray(w)
+            self._opt_states[key] = self._optimizer.update(
+                key, wn, NDArray(grad), self._opt_states.get(key))
+            self._store[key] = np.asarray(wn.asnumpy())
+        else:
+            self._store[key] = grad  # default updater: assign aggregate
+
+    def _drain_rounds(self, key):
+        """Close every round for which all ranks have a queued push."""
+        pend = self._pending.setdefault(key, {})
+        while len(pend) == self.num_workers and \
+                all(pend.get(r) for r in pend):
+            agg = None
+            for r in list(pend):
+                g = pend[r].pop(0)
+                agg = g if agg is None else agg + g
+            self._apply(key, agg)
+            self._version[key] = self._version.get(key, 0) + 1
+
+    def _serve(self, conn):
+        try:
+            while not self._stop:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                try:
+                    resp = self._handle(op, msg)
+                except Exception as e:  # reply instead of killing the
+                    resp = ("err", f"{type(e).__name__}: {e}")  # thread
+                _send_msg(conn, resp)
+                if op == "shutdown":
+                    self.stop()
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def _handle(self, op, msg):
+        if op == "init":
+            _, key, value = msg
+            with self._cv:
+                if key not in self._store:  # first init wins
+                    self._store[key] = np.asarray(value)
+                    self._version[key] = 0
+            return ("ok",)
+        if op == "push":
+            _, key, rank, grad = msg
+            grad = np.asarray(grad)
+            with self._cv:
+                if key not in self._store:
+                    raise KeyError(f"push to uninitialized key {key!r}")
+                if self.mode == "async":
+                    self._apply(key, grad)
+                    self._version[key] = self._version.get(key, 0) + 1
+                else:
+                    pend = self._pending.setdefault(key, {})
+                    pend.setdefault(rank, []).append(grad)
+                    if len(pend) == self.num_workers:
+                        self._drain_rounds(key)
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "pull":
+            _, key, min_version = msg
+            with self._cv:
+                if key not in self._store:
+                    raise KeyError(f"pull of uninitialized key {key!r}")
+                # sync semantics: a pull after my push blocks until the
+                # round containing that push is applied on the server
+                self._cv.wait_for(
+                    lambda: self._version.get(key, 0) >= min_version)
+                val = self._store[key]
+            return ("ok", val)
+        if op == "pull_rows":
+            # the PS path's signature feature: only the requested
+            # embedding rows travel the wire (reference: kvstore_dist
+            # row_sparse pull)
+            _, key, rows, min_version = msg
+            with self._cv:
+                if key not in self._store:
+                    raise KeyError(f"pull of uninitialized key {key!r}")
+                self._cv.wait_for(
+                    lambda: self._version.get(key, 0) >= min_version)
+                val = self._store[key][np.asarray(rows, np.int64)]
+            return ("ok", val)
+        if op == "set_optimizer":
+            _, opt_bytes = msg
+            with self._cv:
+                self._optimizer = pickle.loads(opt_bytes)
+                self._opt_states = {}
+            return ("ok",)
+        if op == "barrier":
+            with self._cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count == self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._cv.notify_all()
+                else:
+                    self._cv.wait_for(lambda: self._barrier_gen > gen)
+            return ("ok",)
+        if op == "shutdown":
+            return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+
+class PSClient:
+    """Worker-side connection. Thread-safe (one lock per socket)."""
+
+    def __init__(self, addr, rank=0, timeout=None):
+        self._sock = socket.create_connection(tuple(addr), timeout=120)
+        # steady state: no socket timeout (default) — sync pulls and
+        # barriers legitimately block on stragglers (e.g. a worker in a
+        # >2 min XLA compile), and a mid-RPC timeout would desync the
+        # length-prefixed stream
+        self._sock.settimeout(timeout)
+        self._rank = rank
+        self._lock = threading.Lock()
+        #: how many of MY pushes each key has seen (sync round tracking)
+        self._pushes: Dict = {}
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp[0] != "ok":
+            raise RuntimeError(f"PS error: {resp[1:]}")
+        return resp[1] if len(resp) > 1 else None
+
+    def init(self, key, value: np.ndarray):
+        self._rpc("init", key, np.asarray(value))
+
+    def push(self, key, grad: np.ndarray):
+        self._pushes[key] = self._pushes.get(key, 0) + 1
+        self._rpc("push", key, self._rank, np.asarray(grad))
+
+    def pull(self, key, sync=True) -> np.ndarray:
+        min_version = self._pushes.get(key, 0) if sync else 0
+        return self._rpc("pull", key, min_version)
+
+    def pull_rows(self, key, rows, sync=True) -> np.ndarray:
+        min_version = self._pushes.get(key, 0) if sync else 0
+        return self._rpc("pull_rows", key,
+                         np.asarray(rows, np.int64), min_version)
+
+    def set_optimizer(self, optimizer):
+        self._rpc("set_optimizer",
+                  pickle.dumps(optimizer,
+                               protocol=pickle.HIGHEST_PROTOCOL))
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def shutdown_server(self):
+        try:
+            self._rpc("shutdown")
+        except (RuntimeError, ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

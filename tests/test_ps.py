@@ -1,0 +1,187 @@
+"""Parameter-server dist_sync / dist_async with a REAL multi-process
+data path (reference role: tests/nightly/dist_sync_kvstore.py /
+dist_async_kvstore.py over PS-lite)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ps import PSClient, PSServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ps_sync_in_process_threads():
+    """Sync semantics with two in-process clients: a pull after my push
+    blocks until the full round (both workers' pushes) is applied."""
+    srv = PSServer(mode="sync", num_workers=2).start()
+    c0 = PSClient(srv.address, rank=0)
+    c1 = PSClient(srv.address, rank=1)
+    c0.init("w", np.zeros(3, np.float32))
+    c1.init("w", np.ones(3, np.float32))  # first init wins -> zeros
+    import threading
+    results = {}
+
+    def worker(cid, client, grad):
+        client.push("w", grad)
+        results[cid] = client.pull("w")
+
+    t0 = threading.Thread(target=worker,
+                          args=(0, c0, np.full(3, 1.0, np.float32)))
+    t1 = threading.Thread(target=worker,
+                          args=(1, c1, np.full(3, 2.0, np.float32)))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    # default updater: store = aggregate of the round = 1 + 2 = 3
+    np.testing.assert_allclose(results[0], 3.0)
+    np.testing.assert_allclose(results[1], 3.0)
+    c0.shutdown_server()
+
+
+def test_ps_async_applies_each_push():
+    srv = PSServer(mode="async", num_workers=2).start()
+    c = PSClient(srv.address)
+    c.init("w", np.zeros(2, np.float32))
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    c.set_optimizer(opt)
+    c.push("w", np.ones(2, np.float32))
+    v1 = c.pull("w")  # one sgd step: w = 0 - 1*1 = -1
+    np.testing.assert_allclose(v1, -1.0, rtol=1e-6)
+    c.push("w", np.ones(2, np.float32))
+    v2 = c.pull("w")  # second stale update applied on arrival
+    np.testing.assert_allclose(v2, -2.0, rtol=1e-6)
+    c.shutdown_server()
+
+
+def test_ps_barrier_and_shutdown():
+    srv = PSServer(mode="sync", num_workers=1).start()
+    c = PSClient(srv.address)
+    c.init("x", np.arange(4, dtype=np.float32))
+    c.barrier()
+    np.testing.assert_allclose(c.pull("x"), np.arange(4))
+    c.shutdown_server()
+
+
+WORKER = textwrap.dedent("""
+    import sys, os
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rank = int(sys.argv[1])
+    host, port = {addr!r}
+    kv = mx.kv.create("dist_sync", addr=(host, port), rank=rank,
+                      num_workers=2)
+    assert kv.rank == rank and kv.num_workers == 2
+    kv.init("w", mx.nd.zeros((4,)))
+    # each worker pushes rank+1; sync round aggregates to 3
+    kv.push("w", mx.nd.full((4,), float(rank + 1)))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+    # optimizer offload round: server applies ONE sgd step on the sum
+    kv.barrier()
+    if rank == 0:
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.barrier()
+    kv.push("w", mx.nd.ones((4,)))
+    kv.pull("w", out=out)
+    # w was 3.0; grad sum = 2 -> w = 3 - 0.1*2 = 2.8
+    np.testing.assert_allclose(out.asnumpy(), 2.8, rtol=1e-5)
+    kv.barrier()
+    print("PS_WORKER_OK", rank)
+""")
+
+
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    srv = PSServer(mode="sync", num_workers=2).start()
+    script = tmp_path / "ps_worker.py"
+    script.write_text(WORKER.format(repo=REPO, addr=srv.address))
+    env = dict(os.environ)
+    procs = [subprocess.Popen(
+        [sys.executable, "-u", str(script), str(rank)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+        srv.stop()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {rank} failed:\n{out}"
+        assert f"PS_WORKER_OK {rank}" in out, out
+
+
+def test_ps_row_sparse_pull():
+    """Only requested embedding rows travel the wire."""
+    srv = PSServer(mode="sync", num_workers=1).start()
+    kv = mx.kv.create("dist_sync", addr=srv.address, rank=0,
+                      num_workers=1)
+    emb = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("emb", mx.nd.array(emb))
+    from mxnet_tpu.sparse import zeros as sparse_zeros
+    out = sparse_zeros("row_sparse", (5, 4))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=mx.nd.array(np.array([1, 3])))
+    np.testing.assert_allclose(out.indices.asnumpy(), [1, 3])
+    np.testing.assert_allclose(out.data.asnumpy(), emb[[1, 3]])
+    kv._client.shutdown_server()
+
+
+def test_ps_sync_double_push_same_rank():
+    """One worker pushing twice must NOT close a round alone: rounds
+    close only when every rank has contributed (per-rank queues, like
+    PS-lite's per-worker timestamps)."""
+    import threading
+    srv = PSServer(mode="sync", num_workers=2).start()
+    c0 = PSClient(srv.address, rank=0)
+    c1 = PSClient(srv.address, rank=1)
+    c0.init("w", np.zeros(2, np.float32))
+    c0.push("w", np.full(2, 1.0, np.float32))
+    c0.push("w", np.full(2, 2.0, np.float32))
+    got = {}
+
+    def puller():
+        got["v"] = c0.pull("w")  # needs version>=2: both of c1's rounds
+
+    t = threading.Thread(target=puller, daemon=True)
+    t.start()
+    t.join(0.5)
+    assert t.is_alive(), "pull must block until rank 1 contributes"
+    c1.push("w", np.full(2, 10.0, np.float32))
+    c1.push("w", np.full(2, 20.0, np.float32))
+    t.join(30)
+    assert not t.is_alive()
+    # round 1 = 1+10 applied, round 2 = 2+20 applied (assign updater)
+    np.testing.assert_allclose(got["v"], 22.0)
+    c0.shutdown_server()
+
+
+def test_ps_error_reply_not_hang():
+    """Pulling an uninitialized key errors back to the caller instead
+    of killing the server thread and hanging the socket."""
+    srv = PSServer(mode="sync", num_workers=1).start()
+    c = PSClient(srv.address, rank=0)
+    with pytest.raises(RuntimeError, match="uninitialized"):
+        c.pull("nope")
+    # connection still alive and usable after the error
+    c.init("x", np.ones(2, np.float32))
+    np.testing.assert_allclose(c.pull("x"), 1.0)
+    c.shutdown_server()
+
+
+def test_create_falls_back_without_addr():
+    kv = mx.kv.create("dist_sync")
+    assert type(kv).__name__ == "TPUSyncKVStore"
+    kv2 = mx.kv.create("dist_async")
+    assert type(kv2).__name__ == "AsyncKVStore"
