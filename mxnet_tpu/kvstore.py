@@ -320,18 +320,18 @@ class DistPSKVStore(KVStore):
         outs = out if isinstance(out, list) else [out]
         rids = row_ids if isinstance(row_ids, list) else [row_ids]
         for o, r in zip(outs, rids):
-            rows = jax.device_get(
-                r._data if isinstance(r, NDArray) else r)
-            vals = self._client.pull_rows(key, rows, sync=self._sync)
             if isinstance(o, RowSparseNDArray):
+                rows = jax.device_get(
+                    r._data if isinstance(r, NDArray) else r)
+                vals = self._client.pull_rows(key, rows,
+                                              sync=self._sync)
                 o.indices = NDArray(jnp.asarray(rows).astype(jnp.int64))
                 o.data = NDArray(jnp.asarray(vals))
             else:
                 # dense out keeps the FULL array, matching the base
                 # KVStore's dense branch (a caller indexing by row id
                 # must see the same shape under every kv type)
-                o._data = jnp.asarray(
-                    self._client.pull(key, sync=self._sync))
+                self.pull(key, out=o)
 
     def set_optimizer(self, optimizer):
         # "update on kvstore": the SERVER owns the optimizer + states

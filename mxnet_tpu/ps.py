@@ -90,6 +90,7 @@ class PSServer:
         self._barrier_gen = 0
         self._stop = False
         self._threads = []
+        self._conns = []
 
     def start(self):
         t = threading.Thread(target=self._accept_loop, daemon=True)
@@ -98,11 +99,20 @@ class PSServer:
         return self
 
     def stop(self):
-        self._stop = True
+        with self._cv:
+            self._stop = True
+            # wake every thread parked in a sync-pull/barrier wait so
+            # it can notice shutdown instead of blocking forever
+            self._cv.notify_all()
         try:
             self._sock.close()
         except OSError:
             pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- internals ---------------------------------------------------------
     def _accept_loop(self):
@@ -111,6 +121,7 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
@@ -120,11 +131,15 @@ class PSServer:
         w = self._store[key]
         if self._optimizer is not None:
             # the reference's "update on kvstore": the server owns the
-            # optimizer; import here so the server also runs opt-free
+            # optimizer AND its state (momentum/Adam slots); import
+            # here so the server also runs opt-free
             from .ndarray import NDArray
             wn = NDArray(w)
+            if key not in self._opt_states:
+                self._opt_states[key] = \
+                    self._optimizer.create_state_multi_precision(key, wn)
             self._opt_states[key] = self._optimizer.update(
-                key, wn, NDArray(grad), self._opt_states.get(key))
+                key, wn, NDArray(grad), self._opt_states[key])
             self._store[key] = np.asarray(wn.asnumpy())
         else:
             self._store[key] = grad  # default updater: assign aggregate
@@ -188,8 +203,12 @@ class PSServer:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 # sync semantics: a pull after my push blocks until the
                 # round containing that push is applied on the server
+                # (the predicate also wakes on shutdown)
                 self._cv.wait_for(
-                    lambda: self._version.get(key, 0) >= min_version)
+                    lambda: self._stop
+                    or self._version.get(key, 0) >= min_version)
+                if self._stop:
+                    raise ConnectionError("server shut down")
                 val = self._store[key]
             return ("ok", val)
         if op == "pull_rows":
@@ -201,7 +220,10 @@ class PSServer:
                 if key not in self._store:
                     raise KeyError(f"pull of uninitialized key {key!r}")
                 self._cv.wait_for(
-                    lambda: self._version.get(key, 0) >= min_version)
+                    lambda: self._stop
+                    or self._version.get(key, 0) >= min_version)
+                if self._stop:
+                    raise ConnectionError("server shut down")
                 val = self._store[key][np.asarray(rows, np.int64)]
             return ("ok", val)
         if op == "set_optimizer":
@@ -219,7 +241,10 @@ class PSServer:
                     self._barrier_gen += 1
                     self._cv.notify_all()
                 else:
-                    self._cv.wait_for(lambda: self._barrier_gen > gen)
+                    self._cv.wait_for(
+                        lambda: self._stop or self._barrier_gen > gen)
+                    if self._stop:
+                        raise ConnectionError("server shut down")
             return ("ok",)
         if op == "shutdown":
             return ("ok",)
@@ -253,8 +278,10 @@ class PSClient:
         self._rpc("init", key, np.asarray(value))
 
     def push(self, key, grad: np.ndarray):
-        self._pushes[key] = self._pushes.get(key, 0) + 1
+        # count the push only after the server acknowledged it — an
+        # inflated counter would deadlock every later sync pull
         self._rpc("push", key, self._rank, np.asarray(grad))
+        self._pushes[key] = self._pushes.get(key, 0) + 1
 
     def pull(self, key, sync=True) -> np.ndarray:
         min_version = self._pushes.get(key, 0) if sync else 0

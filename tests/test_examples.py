@@ -22,6 +22,7 @@ CASES = {
     "bert_pretrain.py": ["--cpu", "--steps", "2", "--batch-size", "2",
                          "--seq-len", "32", "--vocab", "128",
                          "--units", "32", "--layers", "1"],
+    "dist_train_ps.py": ["--cpu", "--steps", "4", "--workers", "2"],
 }
 
 

@@ -56,6 +56,58 @@ def test_ps_async_applies_each_push():
     c.shutdown_server()
 
 
+def test_ps_stateful_optimizer_keeps_slots():
+    """Server-side Adam: slot state (m, v) must persist across pushes —
+    stateless fallback would silently change the update rule."""
+    srv = PSServer(mode="sync", num_workers=1).start()
+    c = PSClient(srv.address, rank=0)
+    w0 = np.zeros(3, np.float32)
+    c.init("w", w0)
+    c.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+    g = np.ones(3, np.float32)
+    c.push("w", g)
+    v1 = np.asarray(c.pull("w"))
+    c.push("w", g)
+    v2 = np.asarray(c.pull("w"))
+
+    # reference: the same optimizer run locally with threaded state
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    import mxnet_tpu as mxl
+    w = mxl.nd.array(w0)
+    st = opt.create_state_multi_precision("w", w)
+    st = opt.update("w", w, mxl.nd.array(g), st)
+    np.testing.assert_allclose(v1, w.asnumpy(), rtol=1e-5, atol=1e-6)
+    st = opt.update("w", w, mxl.nd.array(g), st)
+    np.testing.assert_allclose(v2, w.asnumpy(), rtol=1e-5, atol=1e-6)
+    c.shutdown_server()
+
+
+def test_ps_shutdown_wakes_blocked_pull():
+    """A worker parked in a sync pull must get an error on shutdown,
+    not block forever."""
+    import threading
+    srv = PSServer(mode="sync", num_workers=2).start()
+    c = PSClient(srv.address, rank=0)
+    c.init("w", np.zeros(2, np.float32))
+    c.push("w", np.ones(2, np.float32))  # round can never close
+    err = {}
+
+    def puller():
+        try:
+            c.pull("w")
+        except Exception as e:
+            err["e"] = e
+
+    t = threading.Thread(target=puller, daemon=True)
+    t.start()
+    t.join(0.5)
+    assert t.is_alive()
+    srv.stop()
+    t.join(10)
+    assert not t.is_alive(), "pull must return after server stop"
+    assert "e" in err
+
+
 def test_ps_barrier_and_shutdown():
     srv = PSServer(mode="sync", num_workers=1).start()
     c = PSClient(srv.address)
@@ -178,6 +230,37 @@ def test_ps_error_reply_not_hang():
     c.init("x", np.ones(2, np.float32))
     np.testing.assert_allclose(c.pull("x"), 1.0)
     c.shutdown_server()
+
+
+def test_trainer_trains_through_ps_kvstore():
+    """gluon.Trainer with a dist_sync PS store: update_on_kvstore routes
+    every step through server-side optimizer push/pull, and the loss
+    still goes down (reference: dist training via 'dist_sync' with
+    update-on-kvstore)."""
+    srv = PSServer(mode="sync", num_workers=1).start()
+    kv = mx.kv.create("dist_sync", addr=srv.address, rank=0,
+                      num_workers=1)
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+            mx.gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.5}, kvstore=kv)
+    assert tr._update_on_kvstore in (None, True)
+    rs = np.random.RandomState(3)
+    X = mx.nd.array(rs.rand(16, 4).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 2, 16))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(15):
+        with mx.autograd.record():
+            l = loss_fn(net(X), y).mean()
+        l.backward()
+        tr.step(1)
+        losses.append(float(l.asscalar()))
+    assert losses[-1] < losses[0], losses
+    kv._client.shutdown_server()
 
 
 def test_create_falls_back_without_addr():
