@@ -48,6 +48,7 @@ def run_worker(args):
     X = mx.nd.array(X_all[shard])
     y = mx.nd.array(y_all[shard])
 
+    loss = None
     for step in range(args.steps):
         with mx.autograd.record():
             loss = loss_fn(net(X), y).mean()
@@ -57,8 +58,8 @@ def run_worker(args):
             print(f"[worker 0] step {step} loss "
                   f"{float(loss.asscalar()):.4f}", flush=True)
     kv.barrier()
-    print(f"WORKER_DONE {args.rank} final_loss "
-          f"{float(loss.asscalar()):.4f}", flush=True)
+    final = float(loss.asscalar()) if loss is not None else float("nan")
+    print(f"WORKER_DONE {args.rank} final_loss {final:.4f}", flush=True)
 
 
 def main():

@@ -50,6 +50,8 @@ from . import symbol
 from . import symbol as sym          # mx.sym.* (lazy DAG over mx.nd)
 from . import module
 from . import module as mod          # mx.mod.Module
+from . import visualization
+from . import visualization as viz   # mx.viz.print_summary/plot_network
 
 
 from . import test_utils

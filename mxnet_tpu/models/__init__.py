@@ -14,8 +14,8 @@ def register_model(name):
 
 def _ensure_registry():
     from . import (lenet, mlp, resnet, mobilenet, vgg, alexnet,  # noqa: F401
-                   squeezenet, densenet, bert, transformer, llama, fm,
-                   word_embedding)
+                   squeezenet, densenet, inception, bert, transformer,
+                   llama, fm, word_embedding)
     return _FACTORIES
 
 

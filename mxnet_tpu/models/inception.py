@@ -1,0 +1,163 @@
+"""Inception-V3 (reference: mxnet/gluon/model_zoo/vision/inception.py).
+
+The four mixed-block families (A/B/C/D/E in the Szegedy paper's
+nomenclature) concatenate parallel conv towers on the channel axis;
+NHWC keeps the concat on the lane dimension so XLA fuses each tower's
+Conv-BN-ReLU chain and the joins stay layout-friendly on the MXU.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from ..gluon.contrib import HybridConcurrent
+from . import register_model
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv(channels, kernel, stride=1, pad=0, layout="NHWC"):
+    out = HybridSequential()
+    out.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False,
+                      layout=layout),
+            nn.BatchNorm(axis=layout.index("C"), epsilon=0.001),
+            nn.Activation("relu"))
+    return out
+
+
+_Tower = HybridSequential
+_Concurrent = HybridConcurrent  # Inception-style branches (gluon.contrib)
+
+
+def _make_A(pool_features, layout):
+    ax = layout.index("C")
+    out = _Concurrent(ax)
+    t1 = _Tower(); t1.add(_conv(64, 1, layout=layout))
+    t2 = _Tower(); t2.add(_conv(48, 1, layout=layout),
+                          _conv(64, 5, pad=2, layout=layout))
+    t3 = _Tower(); t3.add(_conv(64, 1, layout=layout),
+                          _conv(96, 3, pad=1, layout=layout),
+                          _conv(96, 3, pad=1, layout=layout))
+    t4 = _Tower(); t4.add(nn.AvgPool2D(3, 1, 1, layout=layout),
+                          _conv(pool_features, 1, layout=layout))
+    out.add(t1, t2, t3, t4)
+    return out
+
+
+def _make_B(layout):
+    ax = layout.index("C")
+    out = _Concurrent(ax)
+    t1 = _Tower(); t1.add(_conv(384, 3, 2, layout=layout))
+    t2 = _Tower(); t2.add(_conv(64, 1, layout=layout),
+                          _conv(96, 3, pad=1, layout=layout),
+                          _conv(96, 3, 2, layout=layout))
+    t3 = _Tower(); t3.add(nn.MaxPool2D(3, 2, layout=layout))
+    out.add(t1, t2, t3)
+    return out
+
+
+def _make_C(channels_7x7, layout):
+    ax = layout.index("C")
+    c7 = channels_7x7
+    out = _Concurrent(ax)
+    t1 = _Tower(); t1.add(_conv(192, 1, layout=layout))
+    t2 = _Tower(); t2.add(_conv(c7, 1, layout=layout),
+                          _conv(c7, (1, 7), pad=(0, 3), layout=layout),
+                          _conv(192, (7, 1), pad=(3, 0), layout=layout))
+    t3 = _Tower(); t3.add(_conv(c7, 1, layout=layout),
+                          _conv(c7, (7, 1), pad=(3, 0), layout=layout),
+                          _conv(c7, (1, 7), pad=(0, 3), layout=layout),
+                          _conv(c7, (7, 1), pad=(3, 0), layout=layout),
+                          _conv(192, (1, 7), pad=(0, 3), layout=layout))
+    t4 = _Tower(); t4.add(nn.AvgPool2D(3, 1, 1, layout=layout),
+                          _conv(192, 1, layout=layout))
+    out.add(t1, t2, t3, t4)
+    return out
+
+
+def _make_D(layout):
+    ax = layout.index("C")
+    out = _Concurrent(ax)
+    t1 = _Tower(); t1.add(_conv(192, 1, layout=layout),
+                          _conv(320, 3, 2, layout=layout))
+    t2 = _Tower(); t2.add(_conv(192, 1, layout=layout),
+                          _conv(192, (1, 7), pad=(0, 3), layout=layout),
+                          _conv(192, (7, 1), pad=(3, 0), layout=layout),
+                          _conv(192, 3, 2, layout=layout))
+    t3 = _Tower(); t3.add(nn.MaxPool2D(3, 2, layout=layout))
+    out.add(t1, t2, t3)
+    return out
+
+
+class _SplitConcat(HybridBlock):
+    """conv -> two parallel convs whose outputs concat (the E-block's
+    3x3 split into 1x3 + 3x1)."""
+
+    def __init__(self, pre, a, b, axis, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self.pre = pre
+        self.a = a
+        self.b = b
+
+    def forward(self, x):
+        from .. import nd
+        h = self.pre(x) if self.pre is not None else x
+        return nd.concat(self.a(h), self.b(h), dim=self._axis)
+
+
+def _make_E(layout):
+    ax = layout.index("C")
+    out = _Concurrent(ax)
+    t1 = _Tower(); t1.add(_conv(320, 1, layout=layout))
+    t2 = _SplitConcat(_conv(384, 1, layout=layout),
+                      _conv(384, (1, 3), pad=(0, 1), layout=layout),
+                      _conv(384, (3, 1), pad=(1, 0), layout=layout), ax)
+    pre3 = HybridSequential()
+    pre3.add(_conv(448, 1, layout=layout),
+             _conv(384, 3, pad=1, layout=layout))
+    t3 = _SplitConcat(pre3,
+                      _conv(384, (1, 3), pad=(0, 1), layout=layout),
+                      _conv(384, (3, 1), pad=(1, 0), layout=layout), ax)
+    t4 = _Tower(); t4.add(nn.AvgPool2D(3, 1, 1, layout=layout),
+                          _conv(192, 1, layout=layout))
+    out.add(t1, t2, t3, t4)
+    return out
+
+
+class Inception3(HybridBlock):
+    """Inception-V3 (input 3x299x299 upstream; any size >= 79 works —
+    the head global-pools)."""
+
+    def __init__(self, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(
+            _conv(32, 3, 2, layout=layout),
+            _conv(32, 3, layout=layout),
+            _conv(64, 3, pad=1, layout=layout),
+            nn.MaxPool2D(3, 2, layout=layout),
+            _conv(80, 1, layout=layout),
+            _conv(192, 3, layout=layout),
+            nn.MaxPool2D(3, 2, layout=layout),
+            _make_A(32, layout),
+            _make_A(64, layout),
+            _make_A(64, layout),
+            _make_B(layout),
+            _make_C(128, layout),
+            _make_C(160, layout),
+            _make_C(160, layout),
+            _make_C(192, layout),
+            _make_D(layout),
+            _make_E(layout),
+            _make_E(layout),
+            nn.GlobalAvgPool2D(layout=layout),
+            nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+@register_model("inception_v3")
+def inception_v3(**kwargs):
+    return Inception3(**kwargs)

@@ -227,10 +227,15 @@ class PSServer:
                 val = self._store[key][np.asarray(rows, np.int64)]
             return ("ok", val)
         if op == "set_optimizer":
+            # first-wins, like init: every worker's Trainer calls
+            # set_optimizer, and a late worker must NOT wipe the slot
+            # state (m/v) accumulated under the already-installed
+            # optimizer (upstream only broadcasts from rank 0)
             _, opt_bytes = msg
             with self._cv:
-                self._optimizer = pickle.loads(opt_bytes)
-                self._opt_states = {}
+                if self._optimizer is None:
+                    self._optimizer = pickle.loads(opt_bytes)
+                    self._opt_states = {}
             return ("ok",)
         if op == "barrier":
             with self._cv:

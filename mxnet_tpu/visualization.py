@@ -1,0 +1,78 @@
+"""mx.viz — network visualization (reference: mxnet/visualization.py
+print_summary / plot_network). TPU-first: the summary walks our lazy
+Symbol DAG (symbol.py); graphviz rendering is optional and gated on the
+library being present."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _sym_nodes(symbol):
+    """Topological walk of the lazy Symbol DAG — Symbol._topo() is the
+    single implementation of the traversal."""
+    return symbol._topo()
+
+
+def _op_label(s):
+    kind = getattr(s, "_kind", "?")
+    if kind == "var":
+        return "Variable"
+    if kind == "op":
+        return getattr(s, "_fn_name", None) or "op"
+    return kind  # 'item' | 'group'
+
+
+def print_summary(symbol, shape: Optional[Dict] = None,
+                  line_length=88):
+    """Print a layer table for a Symbol (reference:
+    mx.viz.print_summary). With `shape` (EVERY variable name -> shape),
+    output shapes are appended via symbolic shape inference."""
+    out_shapes = None
+    if shape is not None:
+        try:
+            _, out_shapes, _ = symbol.infer_shape(**shape)
+        except Exception:
+            out_shapes = None
+
+    nodes = _sym_nodes(symbol)
+    print("=" * line_length)
+    print(f"{'Layer (op)':<32}{'Name':<36}{'Inputs'}")
+    print("=" * line_length)
+    n_ops = 0
+    for s in nodes:
+        label = _op_label(s)
+        if label not in ("Variable",):
+            n_ops += 1
+        name = getattr(s, "name", None) or "?"
+        ins = ",".join(str(getattr(i, "name", "?"))
+                       for i in (getattr(s, "_inputs", ()) or ()))
+        print(f"{label:<32}{name:<36}{ins[:line_length - 68]}")
+    print("=" * line_length)
+    if out_shapes is not None:
+        print(f"Output shapes: {[tuple(s) for s in out_shapes]}")
+    print(f"Total ops: {n_ops}, total nodes: {len(nodes)}")
+    return len(nodes)
+
+
+def plot_network(symbol, title="plot", save_format="pdf",
+                 shape: Optional[Dict] = None, **kwargs):
+    """Graphviz digraph of the Symbol DAG (reference:
+    mx.viz.plot_network). Requires the optional `graphviz` package;
+    raises ImportError with a clear message if absent."""
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError(
+            "plot_network needs the optional 'graphviz' package; "
+            "use print_summary for a text view") from e
+    dot = graphviz.Digraph(name=title, format=save_format)
+    for s in _sym_nodes(symbol):
+        label = _op_label(s)
+        name = getattr(s, "name", None) or str(id(s))
+        dot.node(str(id(s)), f"{name}\n{label}",
+                 shape="oval" if label == "Variable" else "box")
+        for inp in getattr(s, "_inputs", ()) or ():
+            dot.edge(str(id(inp)), str(id(s)))
+    return dot

@@ -200,6 +200,17 @@ def test_densenet121_tiny():
     assert out.shape == (1, 10)
 
 
+def test_inception_v3_forward():
+    net = mx.models.get_model("inception_v3", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 96, 96, 3)))
+    assert out.shape == (1, 10)
+    # parameter count matches the reference model (~21.8M w/o aux head)
+    n = sum(int(np.prod(p.shape))
+            for p in net.collect_params().values())
+    assert 21.5e6 < n < 22.2e6, n
+
+
 def test_mlp_forward():
     net = mx.models.get_model("mlp", classes=10)
     net.initialize()

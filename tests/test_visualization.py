@@ -1,0 +1,42 @@
+"""mx.viz print_summary / plot_network over the lazy Symbol DAG
+(reference: mxnet/visualization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp_symbol():
+    x = mx.sym.Variable("x")
+    w1 = mx.sym.Variable("w1")
+    w2 = mx.sym.Variable("w2")
+    h = mx.sym.relu(mx.sym.dot(x, w1))
+    return mx.sym.dot(h, w2)
+
+
+def test_print_summary_counts_nodes(capsys):
+    out_sym = _mlp_symbol()
+    n = mx.viz.print_summary(out_sym)
+    text = capsys.readouterr().out
+    assert n >= 5  # 3 vars + >= 2 ops
+    assert "Variable" in text and "dot" in text and "relu" in text
+    assert "Total ops" in text
+
+
+def test_print_summary_with_shapes(capsys):
+    out_sym = _mlp_symbol()
+    mx.viz.print_summary(out_sym, shape={"x": (2, 4), "w1": (4, 8),
+                                         "w2": (8, 3)})
+    text = capsys.readouterr().out
+    assert "(2, 3)" in text  # inferred output shape
+
+
+def test_plot_network_needs_graphviz():
+    out_sym = _mlp_symbol()
+    try:
+        import graphviz  # noqa: F401
+        dot = mx.viz.plot_network(out_sym)
+        assert "dot" in dot.source or "digraph" in dot.source
+    except ImportError:
+        with pytest.raises(ImportError, match="graphviz"):
+            mx.viz.plot_network(out_sym)
