@@ -75,8 +75,11 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
     """Online-softmax flash forward in Pallas (TPU; interpret=True runs
     the same kernel under the Pallas interpreter for CPU testing).
 
-    With return_lse=True also returns the per-row log-sum-exp (B, H, T)
-    that the O(T)-memory backward needs to recompute softmax blocks."""
+    Internally the kernel works on (B, H, T, d) — Mosaic requires the
+    LAST TWO block dims be (8k, 128k) or span the array, which the
+    public (B, T, H, d) layout cannot satisfy when blocking one head.
+    Per-row log-sum-exp travels as (B, H, T, 1) for the same reason and
+    is returned squeezed to (B, H, T) when return_lse=True."""
     from jax.experimental import pallas as pl
 
     B, T, H, d = q.shape
@@ -123,33 +126,38 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
         # rows with no unmasked keys get lse=+inf so exp(s - lse) == 0
         # in the backward (cannot happen for full causal blocks, but
         # keeps the kernel total for arbitrary masks)
-        lse_ref[...] = jnp.where(l > 0, m + jnp.log(safe_l), jnp.inf)
+        lse_ref[...] = jnp.where(l > 0, m + jnp.log(safe_l),
+                                 jnp.inf)[:, None]
 
+    qt = q.transpose(0, 2, 1, 3)          # (B, H, T, d)
+    kt = k.transpose(0, 2, 1, 3)          # (B, Kh, T, d)
+    vt = v.transpose(0, 2, 1, 3)
     grid = (B, H, n_q)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, None, d),
-                         lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((None, T, None, d),
-                         lambda b, h, i: (b, 0, h // rep, 0)),
-            pl.BlockSpec((None, T, None, d),
-                         lambda b, h, i: (b, 0, h // rep, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, T, d),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((None, None, T, d),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, None, d),
-                         lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
-    return (out, lse) if return_lse else out
+    )(qt, kt, vt)
+    out = out.transpose(0, 2, 1, 3)       # back to (B, T, H, d)
+    return (out, lse[..., 0]) if return_lse else out
 
 
 def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
@@ -176,7 +184,7 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
         qi = pl.program_id(2)
         qblk = q_ref[...].astype(jnp.float32)          # (block_q, d)
         doblk = do_ref[...].astype(jnp.float32)
-        lseb = lse_ref[...].astype(jnp.float32)        # (block_q,)
+        lseb = lse_ref[...].astype(jnp.float32)        # (block_q, 1)
         deltb = delta_ref[...].astype(jnp.float32)
 
         def body(ki, acc_):
@@ -187,9 +195,9 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
             s = (qblk @ kblk.T) * scale
             if causal:
                 s = _mask_causal(s, qi, ki, block_q, block_k)
-            p = jnp.exp(s - lseb[:, None])             # 0 where masked
+            p = jnp.exp(s - lseb)                      # 0 where masked
             dp = doblk @ vblk.T
-            ds = p * (dp - deltb[:, None])
+            ds = p * (dp - deltb)
             return acc_ + ds @ kblk
 
         if causal:
@@ -213,17 +221,17 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
                 .astype(jnp.float32)
             doblk = do_ref[pl.dslice(qi * block_q, block_q), :] \
                 .astype(jnp.float32)
-            lseb = lse_ref[pl.dslice(qi * block_q, block_q)] \
-                .astype(jnp.float32)
-            deltb = delta_ref[pl.dslice(qi * block_q, block_q)] \
+            lseb = lse_ref[pl.dslice(qi * block_q, block_q), :] \
+                .astype(jnp.float32)                   # (block_q, 1)
+            deltb = delta_ref[pl.dslice(qi * block_q, block_q), :] \
                 .astype(jnp.float32)
             s = (qblk @ kblk.T) * scale                # (block_q, block_k)
             if causal:
                 s = _mask_causal(s, qi, ki, block_q, block_k)
-            p = jnp.exp(s - lseb[:, None])
+            p = jnp.exp(s - lseb)
             dv_ = dv_ + p.T @ doblk
             dp = doblk @ vblk.T
-            ds = p * (dp - deltb[:, None])
+            ds = p * (dp - deltb)
             dk_ = dk_ + ds.T @ qblk
             return dk_, dv_
 
@@ -233,45 +241,61 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
         dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
         dv_ref[...] = dv.astype(dv_ref.dtype)
 
-    qspec = pl.BlockSpec((None, block_q, None, d),
-                         lambda b, h, i: (b, i, h, 0))
-    full_q = pl.BlockSpec((None, T, None, d), lambda b, h, i: (b, 0, h, 0))
-    full_kv = pl.BlockSpec((None, T, None, d),
-                           lambda b, h, i: (b, 0, h // rep, 0))
-    row_blk = pl.BlockSpec((None, None, block_q), lambda b, h, i: (b, h, i))
-    row_full = pl.BlockSpec((None, None, T), lambda b, h, i: (b, h, 0))
+    # (B, H, T, d) internal layout (see _pallas_forward); lse/delta as
+    # (B, H, T, 1)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = dout.transpose(0, 2, 1, 3)
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda b, h, i: (b, h, i, 0))
+    full_q = pl.BlockSpec((None, None, T, d),
+                          lambda b, h, i: (b, h, 0, 0))
+    full_kv = pl.BlockSpec((None, None, T, d),
+                           lambda b, h, i: (b, h // rep, 0, 0))
+    row_blk = pl.BlockSpec((None, None, block_q, 1),
+                           lambda b, h, i: (b, h, i, 0))
+    row_full = pl.BlockSpec((None, None, T, 1),
+                            lambda b, h, i: (b, h, 0, 0))
 
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, H, n_q),
         in_specs=[qspec, full_kv, full_kv, row_blk, row_blk, qspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, lse, delta, dout)
+    )(qt, kt, vt, lse4, delta4, dot)
 
-    kspec = pl.BlockSpec((None, block_k, None, d),
-                         lambda b, h, i: (b, i, h // rep, 0))
-    dkv_out = pl.BlockSpec((None, block_k, None, d),
-                           lambda b, h, i: (b, i, h, 0))
+    kspec = pl.BlockSpec((None, None, block_k, d),
+                         lambda b, h, i: (b, h // rep, i, 0))
+    dkv_out = pl.BlockSpec((None, None, block_k, d),
+                           lambda b, h, i: (b, h, i, 0))
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
         grid=(B, H, n_k),
         in_specs=[full_q, kspec, kspec, row_full, row_full, full_q],
         out_specs=[dkv_out, dkv_out],
         out_shape=[
-            jax.ShapeDtypeStruct((B, T, H, d), q.dtype),
-            jax.ShapeDtypeStruct((B, T, H, d), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, lse, delta, dout)
+    )(qt, kt, vt, lse4, delta4, dot)
+    dq = dq.transpose(0, 2, 1, 3)                  # (B, T, H, d)
     # GQA: query head h reads kv head h//rep, so sum each group of rep
     # consecutive query heads back into its kv head
     if rep > 1:
-        dk = dk_h.reshape(B, T, Kh, rep, d).sum(axis=3).astype(k.dtype)
-        dv = dv_h.reshape(B, T, Kh, rep, d).sum(axis=3).astype(v.dtype)
+        dk = dk_h.reshape(B, Kh, rep, T, d).sum(axis=2) \
+            .transpose(0, 2, 1, 3).astype(k.dtype)
+        dv = dv_h.reshape(B, Kh, rep, T, d).sum(axis=2) \
+            .transpose(0, 2, 1, 3).astype(v.dtype)
     else:
-        dk, dv = dk_h, dv_h
+        dk = dk_h.transpose(0, 2, 1, 3)
+        dv = dv_h.transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 

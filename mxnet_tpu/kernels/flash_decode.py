@@ -2,17 +2,17 @@
 
 Reference analogue: the fork's fused decoder-attention kernels
 (interleaved_matmul_encdec_* / fmha inference paths). TPU-first: during
-autoregressive decoding the bottleneck is streaming the (B, S, K, d)
-cache from HBM; this kernel tiles the cache through VMEM with an
+autoregressive decoding the bottleneck is streaming the KV cache from
+HBM; this kernel tiles the cache through VMEM with an
 online-softmax accumulator and never materializes the GQA head
 repetition (q rows for one kv head attend to the SAME cache block, so
 the block is read once per kv head instead of once per query head —
 1/rep of the naive jnp.repeat traffic).
 
-Layout: q (B, H, d) for ONE decode position, caches (B, S, K, d) with
-H = K * rep, valid lengths (B,) masking the un-filled cache tail.
-Grid (B, K, S/blk); the S axis runs sequentially so VMEM scratch
-carries the running max / normalizer / accumulator across blocks.
+Layout: q (B, H, d) for ONE decode position, caches (B, K, S, d)
+("cache-native": kv-head major, so the kernel's blocked trailing dims
+span the array and NO per-step transpose/copy of the cache is needed)
+with H = K * rep, valid lengths (B,) masking the un-filled tail.
 """
 from __future__ import annotations
 
@@ -39,22 +39,22 @@ def __getattr__(name):
 
 def reference_decode_attention(q, k_cache, v_cache, valid_len,
                                scale=None):
-    """jnp reference. GQA WITHOUT jnp.repeat: fold the rep axis into
-    the einsum so XLA reads the cache once per kv head."""
+    """jnp reference on (B, K, S, d) caches. GQA WITHOUT jnp.repeat:
+    fold the rep axis into the einsum so XLA reads the cache once per
+    kv head."""
     B, H, d = q.shape
-    K = k_cache.shape[2]
+    K, S = k_cache.shape[1], k_cache.shape[2]
     rep = H // K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     qr = q.reshape(B, K, rep, d).astype(jnp.float32)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
-    s = jnp.einsum("bkrd,bskd->bkrs", qr, kf) * scale
-    S = k_cache.shape[1]
+    s = jnp.einsum("bkrd,bksd->bkrs", qr, kf) * scale
     mask = jnp.arange(S)[None, :] < valid_len[:, None]        # (B, S)
     s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkrs,bskd->bkrd", p, vf)
+    out = jnp.einsum("bkrs,bksd->bkrd", p, vf)
     return out.reshape(B, H, d).astype(q.dtype)
 
 
@@ -63,11 +63,17 @@ def _flash_decode_pallas(q, k_cache, v_cache, valid_len, scale,
     """Grid (B, K): one kernel instance owns a kv head's full cache
     (S, d) in VMEM and sweeps it in blocks with a fori_loop — the same
     walk as flash_attention's forward, but with one (rep, d) query
-    block and a valid-length mask instead of the causal mask."""
+    block and a valid-length mask instead of the causal mask.
+
+    Mosaic layout notes: caches arrive (B, K, S, d) — already the
+    layout whose blocked trailing dims span the array, so no per-step
+    copy; valid_len rides in SMEM via scalar prefetch (a rank-1 VMEM
+    block of size 1 is rejected)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, d = q.shape
-    S, K = k_cache.shape[1], k_cache.shape[2]
+    K, S = k_cache.shape[1], k_cache.shape[2]
     rep = H // K
     blk = max(1, min(block_s, S))
     while S % blk:
@@ -77,7 +83,7 @@ def _flash_decode_pallas(q, k_cache, v_cache, valid_len, scale,
 
     def kernel(vl_ref, q_ref, k_ref, v_ref, o_ref):
         qblk = q_ref[...].astype(jnp.float32) * scale    # (rep, d)
-        vl = vl_ref[0]
+        vl = vl_ref[pl.program_id(0)]
         m = jnp.full((rep,), -jnp.inf, jnp.float32)
         l = jnp.zeros((rep,), jnp.float32)
         acc = jnp.zeros((rep, d), jnp.float32)
@@ -106,17 +112,23 @@ def _flash_decode_pallas(q, k_cache, v_cache, valid_len, scale,
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
 
-    out = pl.pallas_call(
-        kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(B, K),
         in_specs=[
-            pl.BlockSpec((1,), lambda b, h: (b,)),
-            pl.BlockSpec((None, None, rep, d), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((None, S, None, d), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((None, S, None, d), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((None, None, rep, d),
+                         lambda b, h, vl: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, S, d),
+                         lambda b, h, vl: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, S, d),
+                         lambda b, h, vl: (b, h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, rep, d),
-                               lambda b, h: (b, h, 0, 0)),
+                               lambda b, h, vl: (b, h, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, rep, d), q.dtype),
         interpret=interpret,
     )(valid_len.astype(jnp.int32), qr, k_cache, v_cache)
@@ -148,7 +160,7 @@ _VMEM_CACHE_BUDGET_BYTES = 10 << 20
 
 
 def _pallas_mode(k_cache):
-    S, d = k_cache.shape[1], k_cache.shape[3]
+    S, d = k_cache.shape[2], k_cache.shape[3]
     if S % 128 != 0:
         return None
     if 2 * S * d * k_cache.dtype.itemsize > _VMEM_CACHE_BUDGET_BYTES:

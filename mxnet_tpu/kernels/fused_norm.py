@@ -48,12 +48,17 @@ _VMEM_BUDGET_BYTES = 4 << 20
 
 def _pick_rows(n, d, want=512):
     """Rows per block: bounded by a VMEM byte budget for the (rows, d)
-    fp32 block, then rounded down to a power of two. Callers pad the
-    row count up to a multiple (see _pad_rows) so odd n never degrades
-    to single-row blocks."""
-    budget = max(1, _VMEM_BUDGET_BYTES // (max(d, 1) * 4))
-    b = max(1, min(want, budget, n))
-    p = 1
+    fp32 block, rounded down to a power of two, MINIMUM 8 — Mosaic
+    requires the sublane (second-to-last) block dim be a multiple of 8
+    (callers pad the row count up to a multiple, see _pad_rows)."""
+    budget = max(8, _VMEM_BUDGET_BYTES // (max(d, 1) * 4))
+    # cap near n (next power of two) so tiny inputs are not padded up
+    # to the full budget-bound block
+    n_cap = 8
+    while n_cap < n:
+        n_cap *= 2
+    b = max(8, min(want, budget, n_cap))
+    p = 8
     while p * 2 <= b:
         p *= 2
     return p
@@ -77,13 +82,15 @@ def _rms_fwd_kernel(eps, x_ref, g_ref, o_ref, rrms_ref):
     rrms = jax.lax.rsqrt(ms + eps)                # (rows,)
     o_ref[...] = (x * rrms[:, None] *
                   g_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
-    rrms_ref[...] = rrms
+    # stats live as (rows, 1): Mosaic rejects rank-1 blocks that do not
+    # span the whole array
+    rrms_ref[...] = rrms[:, None]
 
 
 def _rms_bwd_kernel(eps, x_ref, g_ref, rrms_ref, dy_ref, dx_ref):
     x = x_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
-    rrms = rrms_ref[...].astype(jnp.float32)[:, None]
+    rrms = rrms_ref[...].astype(jnp.float32)      # (rows, 1)
     dy = dy_ref[...].astype(jnp.float32)
     d = x.shape[-1]
     wdy = dy * g
@@ -105,12 +112,12 @@ def _rms_pallas_fwd(x2, g, eps, interpret):
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
-                   pl.BlockSpec((rows,), lambda i: (i,))],
+                   pl.BlockSpec((rows, 1), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((np_, d), x2.dtype),
-                   jax.ShapeDtypeStruct((np_,), jnp.float32)],
+                   jax.ShapeDtypeStruct((np_, 1), jnp.float32)],
         interpret=interpret,
     )(x2p, g)
-    return out[:n], rrms[:n]
+    return out[:n], rrms[:n, 0]
 
 
 def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
@@ -118,7 +125,7 @@ def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
     n, d = x2.shape
     rows = _pick_rows(n, d)
     x2p = _pad_rows(x2, rows)
-    rrmsp = _pad_rows(rrms, rows)
+    rrmsp = _pad_rows(rrms[:, None], rows)
     dy2p = _pad_rows(dy2, rows)
     np_ = x2p.shape[0]
     grid = (np_ // rows,)
@@ -127,7 +134,7 @@ def _rms_pallas_dx(x2, g, rrms, dy2, eps, interpret):
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,)),
-                  pl.BlockSpec((rows,), lambda i: (i,)),
+                  pl.BlockSpec((rows, 1), lambda i: (i, 0)),
                   pl.BlockSpec((rows, d), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),
@@ -185,15 +192,15 @@ def _ln_fwd_kernel(eps, x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref):
     rstd = jax.lax.rsqrt(var + eps)
     o_ref[...] = (xc * rstd[:, None] * g_ref[...].astype(jnp.float32)
                   + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
-    mu_ref[...] = mu
-    rstd_ref[...] = rstd
+    mu_ref[...] = mu[:, None]
+    rstd_ref[...] = rstd[:, None]
 
 
 def _ln_bwd_kernel(eps, x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref):
     x = x_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
-    mu = mu_ref[...].astype(jnp.float32)[:, None]
-    rstd = rstd_ref[...].astype(jnp.float32)[:, None]
+    mu = mu_ref[...].astype(jnp.float32)       # (rows, 1)
+    rstd = rstd_ref[...].astype(jnp.float32)   # (rows, 1)
     dy = dy_ref[...].astype(jnp.float32)
     xhat = (x - mu) * rstd
     wdy = dy * g
@@ -217,14 +224,14 @@ def _ln_pallas_fwd(x2, g, b, eps, interpret):
                   pl.BlockSpec((d,), lambda i: (0,)),
                   pl.BlockSpec((d,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
-                   pl.BlockSpec((rows,), lambda i: (i,)),
-                   pl.BlockSpec((rows,), lambda i: (i,))],
+                   pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((rows, 1), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((np_, d), x2.dtype),
-                   jax.ShapeDtypeStruct((np_,), jnp.float32),
-                   jax.ShapeDtypeStruct((np_,), jnp.float32)],
+                   jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((np_, 1), jnp.float32)],
         interpret=interpret,
     )(x2p, g, b)
-    return out[:n], mu[:n], rstd[:n]
+    return out[:n], mu[:n, 0], rstd[:n, 0]
 
 
 def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
@@ -232,8 +239,8 @@ def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
     n, d = x2.shape
     rows = _pick_rows(n, d)
     x2p = _pad_rows(x2, rows)
-    mup = _pad_rows(mu, rows)
-    rstdp = _pad_rows(rstd, rows)
+    mup = _pad_rows(mu[:, None], rows)
+    rstdp = _pad_rows(rstd[:, None], rows)
     dy2p = _pad_rows(dy2, rows)
     np_ = x2p.shape[0]
     grid = (np_ // rows,)
@@ -242,8 +249,8 @@ def _ln_pallas_dx(x2, g, mu, rstd, dy2, eps, interpret):
         grid=grid,
         in_specs=[pl.BlockSpec((rows, d), lambda i: (i, 0)),
                   pl.BlockSpec((d,), lambda i: (0,)),
-                  pl.BlockSpec((rows,), lambda i: (i,)),
-                  pl.BlockSpec((rows,), lambda i: (i,)),
+                  pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((rows, 1), lambda i: (i, 0)),
                   pl.BlockSpec((rows, d), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((np_, d), x2.dtype),

@@ -77,29 +77,35 @@ def _rope_at(x, positions, base):
 
 
 def _attend(q, k_cache, v_cache, valid_len, cfg):
-    """q: (B, Tq, H, d); caches (B, S, K, d); attend to [0, valid_len).
+    """q: (B, Tq, H, d); caches in CACHE-NATIVE (B, K, S, d) layout —
+    kv-head major, matching the flash-decode kernel's block tiling so
+    no per-step transpose of the cache is ever materialized. Attend to
+    [0, valid_len).
 
     Tq == 1 (the decode step, HBM-bandwidth bound) dispatches to the
     Pallas flash-decode kernel, which streams the cache once per KV
     head with an online softmax (kernels/flash_decode.py); the general
-    path below is the prefill/fallback."""
+    path below is the fallback (GQA folded into the einsum — no
+    jnp.repeat)."""
     scale = 1.0 / math.sqrt(cfg.head_dim)
     if q.shape[1] == 1:
         from ..kernels.flash_decode import flash_decode
         out = flash_decode(q[:, 0], k_cache, v_cache, valid_len,
                            scale=scale)
         return out[:, None]
-    rep = cfg.num_heads // cfg.num_kv_heads
-    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
-    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    S = k.shape[1]
+    B, Tq, H, d = q.shape
+    K, S = k_cache.shape[1], k_cache.shape[2]
+    rep = H // K
+    qr = q.reshape(B, Tq, K, rep, d).astype(jnp.float32)
+    s = jnp.einsum("btkrd,bksd->bkrts", qr,
+                   k_cache.astype(jnp.float32)) * scale
     mask = jnp.arange(S)[None, :] < valid_len[:, None]  # (B, S)
-    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    s = jnp.where(mask[:, None, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
-    return out
+    out = jnp.einsum("bkrts,bksd->bkrtd", p,
+                     v_cache.astype(jnp.float32))
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, d) \
+        .astype(q.dtype)
 
 
 def build_decoder(net, max_len: int):
@@ -108,7 +114,9 @@ def build_decoder(net, max_len: int):
     prefill(params, ids, valid_len) -> (cache, last_logits): runs the
     prompt (right-padded to the jit shape) and fills the KV cache.
     step(params, cache, pos, tok) -> (cache, logits): one decode step.
-    cache: per layer {k, v} of (B, max_len, K, d).
+    cache: per layer {k, v} of (B, K, max_len, d) — kv-head-major
+    "cache-native" layout shared with the flash-decode kernel, so the
+    per-token hot loop never transposes the cache.
     """
     cfg = net.model.cfg
     params = _params_tree(net)
@@ -132,11 +140,15 @@ def build_decoder(net, max_len: int):
         cache = []
         for lp in params["layers"]:
             q, k, v = layer_fwd(lp, x, positions)
-            k_c = jnp.zeros((B, max_len, cfg.num_kv_heads,
+            # cache-native (B, K, S, d): one transpose per PREFILL, so
+            # the per-token decode loop never copies the cache
+            k_c = jnp.zeros((B, cfg.num_kv_heads, max_len,
                              cfg.head_dim), x.dtype)
             v_c = jnp.zeros_like(k_c)
-            k_c = lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0))
-            v_c = lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0))
+            k_c = lax.dynamic_update_slice(
+                k_c, k.transpose(0, 2, 1, 3), (0, 0, 0, 0))
+            v_c = lax.dynamic_update_slice(
+                v_c, v.transpose(0, 2, 1, 3), (0, 0, 0, 0))
             # causal within the prompt: token t sees <= t and < valid
             S = max_len
             pos_q = positions[None, :]
@@ -144,15 +156,18 @@ def build_decoder(net, max_len: int):
             causal = pos_k[:, None, :] <= pos_q[:, :, None]  # (1,T,S)
             vmask = pos_k[:, None, :] < valid_len[:, None, None]
             rep = cfg.num_heads // cfg.num_kv_heads
-            kf = jnp.repeat(k_c, rep, axis=2) if rep > 1 else k_c
-            vf = jnp.repeat(v_c, rep, axis=2) if rep > 1 else v_c
             scale = 1.0 / math.sqrt(cfg.head_dim)
-            s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                           kf.astype(jnp.float32)) * scale
-            m = (causal & vmask)[:, None, :, :]
+            qr = q.reshape(B, T, cfg.num_kv_heads, rep,
+                           cfg.head_dim).astype(jnp.float32)
+            s = jnp.einsum("btkrd,bksd->bkrts", qr,
+                           k_c.astype(jnp.float32)) * scale
+            m = (causal & vmask)[:, None, None, :, :]
             s = jnp.where(m, s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1)
-            att = jnp.einsum("bhts,bshd->bthd", p.astype(vf.dtype), vf)
+            att = jnp.einsum("bkrts,bksd->bkrtd", p,
+                             v_c.astype(jnp.float32))
+            att = att.transpose(0, 3, 1, 2, 4).reshape(
+                B, T, cfg.num_heads, cfg.head_dim).astype(x.dtype)
             x = x + att.reshape(B, T, -1) @ lp["wo"].T
             h2 = _rms(x, lp["ln2"], cfg.rms_eps)
             x = x + (jax.nn.silu(h2 @ lp["gate"].T) *
@@ -171,12 +186,16 @@ def build_decoder(net, max_len: int):
         new_cache = []
         for lp, c in zip(params["layers"], cache):
             q, k, v = layer_fwd(lp, x, pos[:, None])
+            # write the new token's K/V at (all kv heads, pos) in the
+            # (K, S, d) per-batch cache
             k_c = jax.vmap(
                 lambda buf, kk, p: lax.dynamic_update_slice(
-                    buf, kk, (p, 0, 0)))(c["k"], k, pos)
+                    buf, kk, (0, p, 0)))(c["k"],
+                                         k.transpose(0, 2, 1, 3), pos)
             v_c = jax.vmap(
                 lambda buf, vv, p: lax.dynamic_update_slice(
-                    buf, vv, (p, 0, 0)))(c["v"], v, pos)
+                    buf, vv, (0, p, 0)))(c["v"],
+                                         v.transpose(0, 2, 1, 3), pos)
             att = _attend(q, k_c, v_c, pos + 1, cfg)
             x = x + att.reshape(B, 1, -1) @ lp["wo"].T
             h2 = _rms(x, lp["ln2"], cfg.rms_eps)

@@ -227,15 +227,18 @@ class PSServer:
                 val = self._store[key][np.asarray(rows, np.int64)]
             return ("ok", val)
         if op == "set_optimizer":
-            # first-wins, like init: every worker's Trainer calls
-            # set_optimizer, and a late worker must NOT wipe the slot
-            # state (m/v) accumulated under the already-installed
-            # optimizer (upstream only broadcasts from rank 0)
+            # last-wins like the local KVStore (so hyperparameter
+            # updates, e.g. lr decay, reach the server), but slot state
+            # survives when the optimizer CLASS is unchanged — a late
+            # worker re-sending the same config must not wipe the
+            # accumulated Adam m/v (state is only meaningful within one
+            # optimizer family)
             _, opt_bytes = msg
             with self._cv:
-                if self._optimizer is None:
-                    self._optimizer = pickle.loads(opt_bytes)
+                new_opt = pickle.loads(opt_bytes)
+                if type(new_opt) is not type(self._optimizer):
                     self._opt_states = {}
+                self._optimizer = new_opt
             return ("ok",)
         if op == "barrier":
             with self._cv:

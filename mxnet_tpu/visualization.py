@@ -74,5 +74,6 @@ def plot_network(symbol, title="plot", save_format="pdf",
         dot.node(str(id(s)), f"{name}\n{label}",
                  shape="oval" if label == "Variable" else "box")
         for inp in getattr(s, "_inputs", ()) or ():
-            dot.edge(str(id(inp)), str(id(s)))
+            if hasattr(inp, "_kind"):  # skip scalar literals in the DAG
+                dot.edge(str(id(inp)), str(id(s)))
     return dot

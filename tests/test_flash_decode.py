@@ -16,8 +16,9 @@ from mxnet_tpu.kernels.flash_decode import (_flash_decode_pallas,
 def _data(B=2, S=256, H=8, K=2, d=16, seed=0):
     rs = np.random.RandomState(seed)
     q = jnp.asarray(rs.randn(B, H, d).astype(np.float32))
-    kc = jnp.asarray(rs.randn(B, S, K, d).astype(np.float32))
-    vc = jnp.asarray(rs.randn(B, S, K, d).astype(np.float32))
+    # cache-native (B, K, S, d) layout
+    kc = jnp.asarray(rs.randn(B, K, S, d).astype(np.float32))
+    vc = jnp.asarray(rs.randn(B, K, S, d).astype(np.float32))
     vl = jnp.asarray(rs.randint(1, S + 1, B).astype(np.int32))
     return q, kc, vc, vl
 
@@ -82,12 +83,11 @@ def test_vmem_gate_rejects_oversized_cache(monkeypatch):
     # caller's jit could not be caught by the fallback try/except)
     from mxnet_tpu.kernels import flash_decode as fd
     monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
-    small = jnp.zeros((1, 256, 1, 16), jnp.float32)
+    small = jnp.zeros((1, 1, 256, 16), jnp.float32)
     assert fd._pallas_mode(small) == "interpret"
-    big = jax.ShapeDtypeStruct((1, 16384, 1, 128), jnp.float32)
 
     class _Fake:
-        shape = big.shape
+        shape = (1, 1, 16384, 128)
         dtype = np.dtype(np.float32)
 
     assert fd._pallas_mode(_Fake()) is None

@@ -1,0 +1,97 @@
+"""Device-less TPU lowering of every Pallas kernel family.
+
+jax.export(platforms=['tpu']) runs the full Mosaic lowering pipeline
+(incl. the block-shape tiling validation) WITHOUT a TPU — these tests
+are the proof that the 'compiled' kernel paths are actually viable on
+hardware, which interpret-mode tests cannot give (the interpreter
+ignores tiling constraints; round 2 shipped kernels that passed
+interpret tests but could never have compiled on-chip)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _lowers(fn, *args):
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    n = exp.mlir_module().count("tpu_custom_call")
+    assert n > 0, "no Pallas custom call in the lowered TPU module"
+    return n
+
+
+def test_fused_rmsnorm_lowers_fwd_and_grad():
+    from mxnet_tpu.kernels.fused_norm import _rms
+    x = jax.ShapeDtypeStruct((96, 64), jnp.float32)
+    g = jax.ShapeDtypeStruct((64,), jnp.float32)
+    _lowers(lambda a, b: _rms(a, b, 1e-6, False), x, g)
+    _lowers(lambda a, b: jax.grad(
+        lambda p, q: (_rms(p, q, 1e-6, False) ** 2).sum(),
+        argnums=(0, 1))(a, b)[0], x, g)
+
+
+def test_fused_layernorm_lowers_fwd_and_grad():
+    from mxnet_tpu.kernels.fused_norm import _ln
+    x = jax.ShapeDtypeStruct((130, 256), jnp.bfloat16)
+    g = jax.ShapeDtypeStruct((256,), jnp.float32)
+    b = jax.ShapeDtypeStruct((256,), jnp.float32)
+    _lowers(lambda a, c, e: _ln(a, c, e, 1e-5, False), x, g, b)
+    _lowers(lambda a, c, e: jax.grad(
+        lambda p, q, r: (_ln(p, q, r, 1e-5, False)
+                         .astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2))(a, c, e)[0], x, g, b)
+
+
+def test_flash_attention_lowers_fwd_and_grad_gqa():
+    from mxnet_tpu.kernels.flash_attention import _flash_pallas
+    q = jax.ShapeDtypeStruct((2, 512, 8, 64), jnp.bfloat16)
+    k = jax.ShapeDtypeStruct((2, 512, 2, 64), jnp.bfloat16)
+    _lowers(lambda a, b, c: _flash_pallas(a, b, c, True, 0.125, False),
+            q, k, k)
+    _lowers(lambda a, b, c: jax.grad(
+        lambda p, s, t: _flash_pallas(p, s, t, True, 0.125, False)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2))(a, b, c)[0],
+        q, k, k)
+
+
+def test_flash_decode_lowers():
+    from mxnet_tpu.kernels.flash_decode import _flash_decode_pallas
+    q = jax.ShapeDtypeStruct((2, 8, 128), jnp.bfloat16)
+    kc = jax.ShapeDtypeStruct((2, 2, 1024, 128), jnp.bfloat16)
+    vl = jax.ShapeDtypeStruct((2,), jnp.int32)
+    _lowers(lambda a, b, c, d: _flash_decode_pallas(
+        a, b, c, d, 0.0884, False), q, kc, kc, vl)
+
+
+def test_full_llama_step_lowers_with_kernels():
+    """The flagship model's jitted forward lowers for TPU with the
+    fused-norm kernels actually inside (the _ops_nn dispatch routes
+    trailing-axis norms to Pallas when the backend is not cpu — the
+    export targets TPU, so patch the mode check the way the TPU
+    runtime would see it)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.kernels import fused_norm
+    from mxnet_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    mx.random.seed(0)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                      intermediate_size=256, num_layers=1, num_heads=4,
+                      num_kv_heads=2, max_seq_len=256, dtype="float32")
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    ids = mx.nd.array(np.zeros((2, 256), np.int32))
+    ent = net.trace_entry([ids], training=False)
+    tr = {n: net.collect_params()[n].data()._data for n in ent.tr_names}
+    aux = {n: net.collect_params()[n].data()._data
+           for n in ent.aux_names}
+    key = jax.random.PRNGKey(0)
+
+    def fwd(ids_):
+        flat, _ = ent.raw_fn(tr, aux, key, ids_)
+        return flat[0]
+
+    import unittest.mock as mock
+    with mock.patch.object(fused_norm, "_pallas_mode",
+                           lambda: "compiled"):
+        n = _lowers(fwd, jax.ShapeDtypeStruct((2, 256), jnp.int32))
+    assert n >= 2  # at least the norm kernels appear in the program
