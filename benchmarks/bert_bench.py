@@ -2,8 +2,10 @@
 
 Runs the fused train step (fwd+bwd+AdamW in one XLA executable) on
 synthetic MLM+NSP batches, bf16. Budget-guarded like bench.py: the
-BudgetGuard prints best-so-far and exits 0 if BENCH_BUDGET_S expires,
-and the flash-attention path is on via the model's attention layer.
+BudgetGuard prints best-so-far and exits 0 if BENCH_BUDGET_S expires.
+(BERT's bidirectional padding-mask attention uses the exact fused jnp
+path — the Pallas flash kernel is causal-only and at seq 128 the
+O(T^2) exact form is MXU-bound anyway.)
 """
 import json
 import os
