@@ -3,9 +3,9 @@
 Runs the fused train step (fwd+bwd+AdamW in one XLA executable) on
 synthetic MLM+NSP batches, bf16. Budget-guarded like bench.py: the
 BudgetGuard prints best-so-far and exits 0 if BENCH_BUDGET_S expires.
-(BERT's bidirectional padding-mask attention uses the exact fused jnp
-path — the Pallas flash kernel is causal-only and at seq 128 the
-O(T^2) exact form is MXU-bound anyway.)
+(The bench feeds full-length batches — no valid_length — so BERT's
+attention takes the exact fused jnp path; with ragged batches the
+Pallas flash kernel's key-padding `lengths` support engages instead.)
 """
 import json
 import os

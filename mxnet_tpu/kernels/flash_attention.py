@@ -32,8 +32,11 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
-def reference_attention(q, k, v, causal=True, scale=None):
-    """jnp reference: XLA fuses this into a few kernels; exact softmax."""
+def reference_attention(q, k, v, causal=True, scale=None,
+                        lengths=None):
+    """jnp reference: XLA fuses this into a few kernels; exact softmax.
+    lengths (B,) masks key positions >= lengths[b] (BERT-style key
+    padding)."""
     B, T, H, d = q.shape
     K = k.shape[2]
     if scale is None:
@@ -47,7 +50,13 @@ def reference_attention(q, k, v, causal=True, scale=None):
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
+    if lengths is not None:
+        keep = jnp.arange(T)[None, :] < lengths[:, None]   # (B, S)
+        s = jnp.where(keep[:, None, None, :], s, -jnp.inf)
+    # rows with no valid keys (query beyond lengths) -> zero output
     p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isfinite(jnp.max(s, axis=-1, keepdims=True)),
+                  p, 0.0)
     out = jnp.einsum("bhts,bshd->bthd", p.astype(vf.dtype), vf)
     return out.astype(q.dtype)
 
@@ -70,8 +79,16 @@ def _mask_causal(s, qi, ki, block_q, block_k):
     return jnp.where(qpos >= kpos, s, -jnp.inf)
 
 
+def _mask_lengths(s, ki, block_k, len_b):
+    """-inf for key positions >= len_b in score block column ki."""
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(kpos < len_b, s, -jnp.inf)
+
+
 def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
-                    interpret=False, return_lse=False):
+                    interpret=False, return_lse=False, lengths=None):
+    has_len = lengths is not None
     """Online-softmax flash forward in Pallas (TPU; interpret=True runs
     the same kernel under the Pallas interpreter for CPU testing).
 
@@ -89,9 +106,10 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
     block_k = _pick_block(T, block_k)
     n_q = T // block_q
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+    def kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
         # grid: (B, H, n_q). Block of Q rows vs full K/V sweep.
         qi = pl.program_id(2)
+        len_b = lens_ref[pl.program_id(0)]
         qblk = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
         m = jnp.full((block_q,), -jnp.inf, jnp.float32)
         l = jnp.zeros((block_q,), jnp.float32)
@@ -107,6 +125,8 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
             s = qblk @ kblk.T  # (block_q, block_k)
             if causal:
                 s = _mask_causal(s, qi, ki, block_q, block_k)
+            if has_len:
+                s = _mask_lengths(s, ki, block_k, len_b)
             m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
             p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
@@ -120,6 +140,9 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
                 n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
         else:
             upper = n_k
+        if has_len:
+            # key blocks past lengths[b] are fully masked: skip them
+            upper = jnp.minimum(upper, (len_b + block_k - 1) // block_k)
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
@@ -129,39 +152,48 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
         lse_ref[...] = jnp.where(l > 0, m + jnp.log(safe_l),
                                  jnp.inf)[:, None]
 
+    from jax.experimental.pallas import tpu as pltpu
+
     qt = q.transpose(0, 2, 1, 3)          # (B, H, T, d)
     kt = k.transpose(0, 2, 1, 3)          # (B, Kh, T, d)
     vt = v.transpose(0, 2, 1, 3)
-    grid = (B, H, n_q)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
+    if lengths is None:  # static no-padding case: kernels skip the
+        lengths = jnp.full((B,), T, jnp.int32)  # mask entirely
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H, n_q),
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
-                         lambda b, h, i: (b, h, i, 0)),
+                         lambda b, h, i, lens: (b, h, i, 0)),
             pl.BlockSpec((None, None, T, d),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
+                         lambda b, h, i, lens: (b, h // rep, 0, 0)),
             pl.BlockSpec((None, None, T, d),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
+                         lambda b, h, i, lens: (b, h // rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
-                         lambda b, h, i: (b, h, i, 0)),
+                         lambda b, h, i, lens: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, 1),
-                         lambda b, h, i: (b, h, i, 0)),
+                         lambda b, h, i, lens: (b, h, i, 0)),
         ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
             jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(lengths.astype(jnp.int32), qt, kt, vt)
     out = out.transpose(0, 2, 1, 3)       # back to (B, T, H, d)
     return (out, lse[..., 0]) if return_lse else out
 
 
 def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
-                     block_q=256, block_k=256, interpret=False):
+                     block_q=256, block_k=256, interpret=False,
+                     lengths=None):
+    has_len = lengths is not None
     """O(T)-memory flash backward: dQ/dK/dV via block recomputation
     against the saved log-sum-exp — no (T, T) score matrix is ever
     materialized. delta is rowsum(dO * O), shape (B, H, T).
@@ -179,9 +211,10 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
     n_q = T // block_q
     n_k = T // block_k
 
-    def dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
-                  dq_ref):
+    def dq_kernel(lens_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref,
+                  do_ref, dq_ref):
         qi = pl.program_id(2)
+        len_b = lens_ref[pl.program_id(0)]
         qblk = q_ref[...].astype(jnp.float32)          # (block_q, d)
         doblk = do_ref[...].astype(jnp.float32)
         lseb = lse_ref[...].astype(jnp.float32)        # (block_q, 1)
@@ -195,6 +228,8 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
             s = (qblk @ kblk.T) * scale
             if causal:
                 s = _mask_causal(s, qi, ki, block_q, block_k)
+            if has_len:
+                s = _mask_lengths(s, ki, block_k, len_b)
             p = jnp.exp(s - lseb)                      # 0 where masked
             dp = doblk @ vblk.T
             ds = p * (dp - deltb)
@@ -205,13 +240,16 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
                 n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
         else:
             upper = n_k
+        if has_len:
+            upper = jnp.minimum(upper, (len_b + block_k - 1) // block_k)
         acc = jax.lax.fori_loop(
             0, upper, body, jnp.zeros((block_q, d), jnp.float32))
         dq_ref[...] = (acc * scale).astype(dq_ref.dtype)
 
-    def dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref,
-                   dk_ref, dv_ref):
+    def dkv_kernel(lens_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref,
+                   do_ref, dk_ref, dv_ref):
         ki = pl.program_id(2)
+        len_b = lens_ref[pl.program_id(0)]
         kblk = k_ref[...].astype(jnp.float32)          # (block_k, d)
         vblk = v_ref[...].astype(jnp.float32)
 
@@ -228,6 +266,11 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
             s = (qblk @ kblk.T) * scale                # (block_q, block_k)
             if causal:
                 s = _mask_causal(s, qi, ki, block_q, block_k)
+            if has_len:
+                # NOTE: the q-block sweep is NOT truncated — query rows
+                # beyond lengths still attend valid keys (only KEYS are
+                # padded), so their cotangents legitimately reach dk/dv
+                s = _mask_lengths(s, ki, block_k, len_b)
             p = jnp.exp(s - lseb)
             dv_ = dv_ + p.T @ doblk
             dp = doblk @ vblk.T
@@ -243,48 +286,59 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
 
     # (B, H, T, d) internal layout (see _pallas_forward); lse/delta as
     # (B, H, T, 1)
+    from jax.experimental.pallas import tpu as pltpu
+
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     dot = dout.transpose(0, 2, 1, 3)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    lens = lengths.astype(jnp.int32)
 
     qspec = pl.BlockSpec((None, None, block_q, d),
-                         lambda b, h, i: (b, h, i, 0))
+                         lambda b, h, i, lens: (b, h, i, 0))
     full_q = pl.BlockSpec((None, None, T, d),
-                          lambda b, h, i: (b, h, 0, 0))
+                          lambda b, h, i, lens: (b, h, 0, 0))
     full_kv = pl.BlockSpec((None, None, T, d),
-                           lambda b, h, i: (b, h // rep, 0, 0))
+                           lambda b, h, i, lens: (b, h // rep, 0, 0))
     row_blk = pl.BlockSpec((None, None, block_q, 1),
-                           lambda b, h, i: (b, h, i, 0))
+                           lambda b, h, i, lens: (b, h, i, 0))
     row_full = pl.BlockSpec((None, None, T, 1),
-                            lambda b, h, i: (b, h, 0, 0))
+                            lambda b, h, i, lens: (b, h, 0, 0))
 
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, H, n_q),
-        in_specs=[qspec, full_kv, full_kv, row_blk, row_blk, qspec],
-        out_specs=qspec,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, n_q),
+            in_specs=[qspec, full_kv, full_kv, row_blk, row_blk,
+                      qspec],
+            out_specs=qspec),
         out_shape=jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, lse4, delta4, dot)
+    )(lens, qt, kt, vt, lse4, delta4, dot)
 
     kspec = pl.BlockSpec((None, None, block_k, d),
-                         lambda b, h, i: (b, h // rep, i, 0))
+                         lambda b, h, i, lens: (b, h // rep, i, 0))
     dkv_out = pl.BlockSpec((None, None, block_k, d),
-                           lambda b, h, i: (b, h, i, 0))
+                           lambda b, h, i, lens: (b, h, i, 0))
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
-        grid=(B, H, n_k),
-        in_specs=[full_q, kspec, kspec, row_full, row_full, full_q],
-        out_specs=[dkv_out, dkv_out],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, n_k),
+            in_specs=[full_q, kspec, kspec, row_full, row_full,
+                      full_q],
+            out_specs=[dkv_out, dkv_out]),
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
             jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
         ],
         interpret=interpret,
-    )(qt, kt, vt, lse4, delta4, dot)
+    )(lens, qt, kt, vt, lse4, delta4, dot)
     dq = dq.transpose(0, 2, 1, 3)                  # (B, T, H, d)
     # GQA: query head h reads kv head h//rep, so sum each group of rep
     # consecutive query heads back into its kv head
@@ -299,56 +353,73 @@ def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_pallas(q, k, v, causal, scale, interpret):
-    out, _ = _flash_pallas_fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_pallas(q, k, v, lengths, causal, scale, interpret):
+    out, _ = _flash_pallas_fwd(q, k, v, lengths, causal, scale,
+                               interpret)
     return out
 
 
-def _flash_pallas_fwd(q, k, v, causal, scale, interpret):
+def _flash_pallas_fwd(q, k, v, lengths, causal, scale, interpret):
     out, lse = _pallas_forward(q, k, v, causal, scale,
-                               interpret=interpret, return_lse=True)
-    return out, (q, k, v, out, lse)
+                               interpret=interpret, return_lse=True,
+                               lengths=lengths)
+    return out, (q, k, v, lengths, out, lse)
+
+
+def _len_cotangent(lengths):
+    # integer primal -> float0 cotangent (jax's convention); None stays
+    # None (the static no-padding case)
+    if lengths is None:
+        return None
+    import numpy as _np
+    return _np.zeros(lengths.shape, jax.dtypes.float0)
 
 
 def _flash_pallas_bwd(causal, scale, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, lengths, out, lse = res
     # delta_i = rowsum(dO_i * O_i): the softmax-jacobian correction term
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).transpose(0, 2, 1)  # (B, H, T)
     try:
-        return _pallas_backward(q, k, v, lse, delta, g.astype(q.dtype),
-                                causal, scale, interpret=interpret)
+        dq, dk, dv = _pallas_backward(q, k, v, lse, delta,
+                                      g.astype(q.dtype), causal, scale,
+                                      interpret=interpret,
+                                      lengths=lengths)
+        return dq, dk, dv, _len_cotangent(lengths)
     except Exception as e:
         # same contract as the forward: never let a kernel regression
         # crash training unless the user opted into strict mode
         _fallback.note(e)
         _, vjp = jax.vjp(lambda q_, k_, v_:
-                         reference_attention(q_, k_, v_, causal, scale),
+                         reference_attention(q_, k_, v_, causal, scale,
+                                             lengths),
                          q, k, v)
-        return vjp(g)
+        return vjp(g) + (_len_cotangent(lengths),)
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_ref(q, k, v, causal, scale):
-    return reference_attention(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_ref(q, k, v, lengths, causal, scale):
+    return reference_attention(q, k, v, causal, scale, lengths)
 
 
-def _flash_ref_fwd(q, k, v, causal, scale):
+def _flash_ref_fwd(q, k, v, lengths, causal, scale):
     # save only q/k/v; recompute the softmax in the backward instead of
     # storing the (B, H, T, T) probability matrix
-    return reference_attention(q, k, v, causal, scale), (q, k, v)
+    return (reference_attention(q, k, v, causal, scale, lengths),
+            (q, k, v, lengths))
 
 
 def _flash_ref_bwd(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, lengths = res
     _, vjp = jax.vjp(lambda q_, k_, v_:
-                     reference_attention(q_, k_, v_, causal, scale),
+                     reference_attention(q_, k_, v_, causal, scale,
+                                         lengths),
                      q, k, v)
-    return vjp(g)
+    return vjp(g) + (_len_cotangent(lengths),)
 
 
 _flash_ref.defvjp(_flash_ref_fwd, _flash_ref_bwd)
@@ -366,13 +437,18 @@ def _pallas_mode(T):
     return None
 
 
-def flash_attention_raw(q, k, v, causal=True, scale=None, use_flash=True):
+def flash_attention_raw(q, k, v, causal=True, scale=None,
+                        use_flash=True, lengths=None):
+    """lengths (B,) optionally masks key positions >= lengths[b]
+    (BERT-style key padding); composes with causal."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if lengths is not None:
+        lengths = jnp.asarray(lengths, jnp.int32)
     mode = _pallas_mode(q.shape[1]) if use_flash else None
     if mode is not None:
         try:
-            return _flash_pallas(q, k, v, causal, scale,
+            return _flash_pallas(q, k, v, lengths, causal, scale,
                                  mode == "interpret")
         except Exception as e:
             # fail loudly: a silently-degraded flash path hides O(T^2)
@@ -380,4 +456,4 @@ def flash_attention_raw(q, k, v, causal=True, scale=None, use_flash=True):
             # MXNET_TPU_STRICT_KERNELS=1) turns the fallback into an
             # error; otherwise warn once and count.
             _fallback.note(e)
-    return _flash_ref(q, k, v, causal, scale)
+    return _flash_ref(q, k, v, lengths, causal, scale)

@@ -34,8 +34,10 @@ class BERTEncoderLayer(HybridBlock):
         self.dropout = nn.Dropout(dropout) if dropout else None
         self.norm2 = nn.LayerNorm(in_channels=units)
 
-    def forward(self, x, mask=None):
-        out = self.attention(x, x, x, mask)
+    def forward(self, x, mask=None, lengths=None):
+        # positional call: kwargs would bypass the HybridBlock jit
+        # cache (gluon/block.py __call__)
+        out = self.attention(x, x, x, mask, lengths)
         x = self.norm1(x + out)
         out = self.ffn2(self.ffn1(x))
         if self.dropout is not None:
@@ -75,13 +77,13 @@ class BERTModel(HybridBlock):
         x = self.embed_norm(x)
         if self.embed_dropout is not None:
             x = self.embed_dropout(x)
-        mask = None
+        # key padding goes to the attention layers as (B,) lengths —
+        # the flash kernel masks natively, no (B, T, T) boolean mask
+        lengths = None
         if valid_length is not None:
-            ar = nd.arange(0, T).reshape(1, T)
-            keep = (ar < valid_length.reshape(-1, 1))
-            mask = keep.reshape(B, 1, T).broadcast_to((B, T, T))
+            lengths = valid_length.reshape(-1).astype("int32")
         for layer in self.layers:
-            x = layer(x, mask)
+            x = layer(x, None, lengths)  # positional: keeps the jit cache
         pooled = self.pooler(x.slice_axis(1, 0, 1).reshape(B, -1))
         return x, pooled
 

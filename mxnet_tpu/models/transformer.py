@@ -70,7 +70,7 @@ class MultiHeadAttention(HybridBlock):
         self.out_proj = nn.Dense(units, use_bias=use_bias, flatten=False)
         self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def forward(self, query, key, value, mask=None):
+    def forward(self, query, key, value, mask=None, lengths=None):
         B, T, _ = query.shape
         S = key.shape[1]
         H = self._heads
@@ -78,7 +78,23 @@ class MultiHeadAttention(HybridBlock):
         q = self.query_proj(query).reshape(B, T, H, d)
         k = self.key_proj(key).reshape(B, S, H, d)
         v = self.value_proj(value).reshape(B, S, H, d)
-        out = full_attention(q, k, v, mask)
+        if lengths is not None and mask is None and T == S:
+            # key-padding by lengths: the Pallas flash kernel handles
+            # this natively (no (B, T, S) boolean mask materialized)
+            from ..kernels.flash_attention import flash_attention_raw
+            out = invoke(
+                lambda q_, k_, v_, l_: flash_attention_raw(
+                    q_, k_, v_, causal=False, lengths=l_),
+                [q, k, v, lengths])
+        else:
+            if lengths is not None and mask is None:
+                # cross-attention (T != S): never silently drop the key
+                # padding — build the boolean mask from lengths
+                from .. import nd as _nd
+                ar = _nd.arange(0, S).reshape(1, S)
+                mask = (ar < lengths.reshape(-1, 1)) \
+                    .reshape(-1, 1, S).broadcast_to((B, T, S))
+            out = full_attention(q, k, v, mask)
         out = self.out_proj(out.reshape(B, T, self._units))
         if self.dropout is not None:
             out = self.dropout(out)

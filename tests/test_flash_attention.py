@@ -139,3 +139,124 @@ def test_uneven_block_sweep():
                           block_q=32, block_k=64, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_lengths_masking_matches_reference(causal):
+    # BERT-style key padding: positions >= lengths[b] contribute nothing
+    q, k, v = _qkv(B=3, T=256, seed=7)
+    lengths = jnp.asarray([256, 100, 1], jnp.int32)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = reference_attention(q, k, v, causal=causal, scale=scale,
+                              lengths=lengths)
+    out = _pallas_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=128, block_k=128, interpret=True,
+                          lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # padded-batch invariance: values beyond lengths must not leak
+    k2 = k.at[1, 100:].set(99.0)
+    v2 = v.at[1, 100:].set(-99.0)
+    out2 = _pallas_forward(q, k2, v2, causal=causal, scale=scale,
+                           block_q=128, block_k=128, interpret=True,
+                           lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lengths_backward_matches_reference_vjp(monkeypatch):
+    from mxnet_tpu.kernels.flash_attention import flash_attention_raw
+    monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
+    q, k, v = _qkv(B=2, T=128, seed=8)
+    lengths = jnp.asarray([128, 57], jnp.int32)
+
+    def loss_kernel(q_, k_, v_):
+        return (flash_attention_raw(q_, k_, v_, causal=False,
+                                    lengths=lengths)
+                .astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q_, k_, v_):
+        return (reference_attention(q_, k_, v_, causal=False,
+                                    lengths=lengths)
+                .astype(jnp.float32) ** 2).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_bert_valid_length_flash_vs_mask(monkeypatch):
+    """BERT's key-padding now rides the kernel's lengths support; the
+    kernel-on and fallback paths must agree, and padding tokens must
+    not influence the valid positions."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.bert import BERTModel
+
+    mx.random.seed(0)
+    net = BERTModel(vocab_size=64, units=32, hidden_size=64,
+                    num_layers=1, num_heads=4, max_length=128,
+                    dropout=0.0)
+    net.initialize()
+    rs = np.random.RandomState(9)
+    ids = mx.nd.array(rs.randint(0, 64, (2, 128)), dtype="int32")
+    vl = mx.nd.array(np.array([128, 40]), dtype="int32")
+    seq_ref, pooled_ref = net(ids, valid_length=vl)
+    monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
+    seq_k, pooled_k = net(ids, valid_length=vl)
+    np.testing.assert_allclose(seq_k.asnumpy(), seq_ref.asnumpy(),
+                               rtol=3e-4, atol=3e-4)
+    # changing PAD tokens must not change valid positions' output
+    ids2 = ids.asnumpy().copy()
+    ids2[1, 40:] = 1
+    seq_k2, _ = net(mx.nd.array(ids2, dtype="int32"), valid_length=vl)
+    np.testing.assert_allclose(seq_k2.asnumpy()[1, :40],
+                               seq_k.asnumpy()[1, :40],
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_bert_valid_length_keeps_jit_cache():
+    """lengths must ride POSITIONALLY through the layers: kwargs bypass
+    the HybridBlock compiled-call path, silently de-hybridizing BERT."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.bert import BERTModel
+
+    mx.random.seed(1)
+    net = BERTModel(vocab_size=32, units=16, hidden_size=32,
+                    num_layers=1, num_heads=2, max_length=32,
+                    dropout=0.0)
+    net.initialize()
+    ids = mx.nd.array(np.random.RandomState(2).randint(0, 32, (2, 32)),
+                      dtype="int32")
+    vl = mx.nd.array(np.array([32, 9]), dtype="int32")
+    eager, _ = net(ids, valid_length=vl)
+    for layer in net.layers:
+        layer.hybridize()
+    hyb, _ = net(ids, valid_length=vl)
+    np.testing.assert_allclose(hyb.asnumpy(), eager.asnumpy(),
+                               rtol=2e-4, atol=2e-4)
+    assert net.layers[0]._jit_cache, \
+        "valid_length path must not bypass the compiled-call cache"
+
+
+def test_cross_attention_lengths_fallback_masks():
+    """T != S with lengths: the padding mask must be derived, never
+    silently dropped."""
+    from mxnet_tpu.models.transformer import MultiHeadAttention
+    import mxnet_tpu as mx
+
+    mx.random.seed(2)
+    attn = MultiHeadAttention(16, 2, dropout=0.0)
+    attn.initialize()
+    rs = np.random.RandomState(3)
+    q = mx.nd.array(rs.rand(2, 5, 16).astype(np.float32))
+    mem = mx.nd.array(rs.rand(2, 8, 16).astype(np.float32))
+    lens = mx.nd.array(np.array([8, 3]), dtype="int32")
+    out = attn(q, mem, mem, None, lens)
+    # batch row 1 must ignore memory positions >= 3
+    mem2 = mem.asnumpy().copy()
+    mem2[1, 3:] = 77.0
+    out2 = attn(q, mx.nd.array(mem2), mx.nd.array(mem2), None, lens)
+    np.testing.assert_allclose(out2.asnumpy()[1], out.asnumpy()[1],
+                               rtol=1e-5, atol=1e-5)

@@ -46,12 +46,26 @@ def test_flash_attention_lowers_fwd_and_grad_gqa():
     from mxnet_tpu.kernels.flash_attention import _flash_pallas
     q = jax.ShapeDtypeStruct((2, 512, 8, 64), jnp.bfloat16)
     k = jax.ShapeDtypeStruct((2, 512, 2, 64), jnp.bfloat16)
-    _lowers(lambda a, b, c: _flash_pallas(a, b, c, True, 0.125, False),
-            q, k, k)
+    L = jnp.full((2,), 512, jnp.int32)
+    _lowers(lambda a, b, c: _flash_pallas(a, b, c, L, True, 0.125,
+                                          False), q, k, k)
     _lowers(lambda a, b, c: jax.grad(
-        lambda p, s, t: _flash_pallas(p, s, t, True, 0.125, False)
+        lambda p, s, t: _flash_pallas(p, s, t, L, True, 0.125, False)
         .astype(jnp.float32).sum(), argnums=(0, 1, 2))(a, b, c)[0],
         q, k, k)
+
+
+def test_flash_attention_with_lengths_lowers():
+    from mxnet_tpu.kernels.flash_attention import _flash_pallas
+    q = jax.ShapeDtypeStruct((2, 256, 4, 64), jnp.bfloat16)
+    k = jax.ShapeDtypeStruct((2, 256, 2, 64), jnp.bfloat16)
+    lens = jax.ShapeDtypeStruct((2,), jnp.int32)
+    _lowers(lambda a, b, c, L: _flash_pallas(
+        a, b, c, L, False, 0.125, False), q, k, k, lens)
+    _lowers(lambda a, b, c, L: jax.grad(
+        lambda p, s, t: _flash_pallas(p, s, t, L, False, 0.125, False)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2))(a, b, c)[0],
+        q, k, k, lens)
 
 
 def test_flash_decode_lowers():
