@@ -17,7 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 import numpy as np
 
-from bench import BudgetGuard, _acquire_backend, _enable_compile_cache
+from bench import (BudgetGuard, _acquire_backend, _build_net_on_cpu,
+                   _enable_compile_cache)
 
 REFERENCE_SAMPLES_PER_SEC = 107.0  # ptrendx MXNet BERT-base V100 AMP
 
@@ -25,8 +26,9 @@ REFERENCE_SAMPLES_PER_SEC = 107.0  # ptrendx MXNet BERT-base V100 AMP
 def main():
     guard = BudgetGuard("bert_base_pretrain_samples_per_sec_per_chip",
                         "samples/sec").install()
-    _enable_compile_cache()
     backend = _acquire_backend(max_wait=min(240.0, guard.budget_s / 3))
+    if backend not in ("cpu",):  # see bench.py: TPU-only cache
+        _enable_compile_cache()
 
     import jax
     import mxnet_tpu as mx
@@ -42,11 +44,18 @@ def main():
     vocab = 30522
 
     mx.random.seed(0)
-    net = BERTForPretraining(vocab_size=vocab)
-    net.initialize(init=mx.init.Normal(0.02))
-    if on_tpu:
-        amp.init("bfloat16")
-        amp.convert_block(net)
+
+    def build():
+        net = BERTForPretraining(vocab_size=vocab)
+        net.initialize(init=mx.init.Normal(0.02))
+        if on_tpu:
+            amp.init("bfloat16")
+            amp.convert_block(net)
+        return net
+
+    # init + deferred materialization on the local CPU backend (no
+    # per-op tunnel RPCs), then one device_put per parameter
+    net = _build_net_on_cpu(build, (2, 16), "int32", on_tpu)
 
     mlm_ce = gluon.loss.SoftmaxCrossEntropyLoss()
     nsp_ce = gluon.loss.SoftmaxCrossEntropyLoss()
