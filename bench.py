@@ -284,40 +284,48 @@ def _build_net_on_cpu(builder, sample_shape, sample_dtype, on_tpu):
     return net
 
 
-def _resnet_infer_phase(on_tpu, backend):
-    """ResNet-50 inference img/s — the reference's benchmark_score.py
-    metric. Forward-only compiles several times faster than the fused
-    train step, so this lands a real model number even when the train
-    compile would blow the budget."""
+def _build_resnet(on_tpu):
+    """One ResNet-50 shared by the infer and train phases (building +
+    CPU materialization + ~160 device_puts is paid once, inside the
+    first phase that needs it)."""
     import mxnet_tpu as mx
-    from mxnet_tpu import amp, autograd
+    from mxnet_tpu import amp
     from mxnet_tpu.models.resnet import resnet50_v1
-
-    batch = int(os.environ.get("BENCH_INFER_BATCH",
-                               128 if on_tpu else 8))
-    image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
-    it_lo, it_hi = (4, 20) if on_tpu else (1, 3)
 
     mx.random.seed(0)
 
     def build():
         net = resnet50_v1(classes=1000, layout="NHWC")
         net.initialize(init=mx.init.Xavier())
-        if on_tpu:
-            amp.init("bfloat16")
-            amp.convert_block(net)
+        amp.init("bfloat16")
+        amp.convert_block(net)
         return net
 
     # materialize with a tiny spatial size (channel inference does not
     # depend on it; eager CPU ops stay fast), hybridize after — so the
     # only forward compile is the real-shape one on the TPU
-    net = _build_net_on_cpu(build, (2, 32, 32, 3),
-                            "bfloat16" if on_tpu else "float32", on_tpu)
+    return _build_net_on_cpu(build, (2, 32, 32, 3), "bfloat16", on_tpu)
+
+
+def _resnet_infer_phase(on_tpu, backend):
+    """ResNet-50 inference img/s — the reference's benchmark_score.py
+    metric. Forward-only compiles several times faster than the fused
+    train step, so this lands a real model number even when the train
+    compile would blow the budget. Returns the built net for the train
+    phase to reuse."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    batch = int(os.environ.get("BENCH_INFER_BATCH",
+                               128 if on_tpu else 8))
+    image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
+    it_lo, it_hi = (4, 20) if on_tpu else (1, 3)
+
+    net = _build_resnet(on_tpu)
     net.hybridize()
 
     x = mx.nd.array(np.random.rand(batch, image, image, 3)
-                    .astype(np.float32), dtype="bfloat16"
-                    if on_tpu else "float32")
+                    .astype(np.float32), dtype="bfloat16")
     t_c = time.perf_counter()
     with autograd.predict_mode():
         float(net(x).sum().asscalar())  # compile + full sync
@@ -342,6 +350,11 @@ def _resnet_infer_phase(on_tpu, backend):
     dd = dt_hi - dt_lo
     ips = batch * (it_hi - it_lo) / dd if dd > 1e-4 \
         else batch * it_hi / dt_hi
+    # forward-only ~4.1 GFLOP/img at 224px; scale by pixel count
+    fwd_flops = 4.1e9 * (image / 224.0) ** 2
+    peak = V5E_PEAK_TFLOPS * 1e12 if on_tpu else 1e12
+    for stale in ("probe_dt_lo_s", "probe_dt_hi_s"):
+        _best.pop(stale, None)
     _best.update({
         "metric": "resnet50_infer_images_per_sec_per_chip",
         "value": round(ips, 2),
@@ -349,32 +362,25 @@ def _resnet_infer_phase(on_tpu, backend):
         "vs_baseline": round(ips / REFERENCE_IMG_PER_SEC, 3),
         "backend": backend, "batch": batch, "image": image,
         "compile_s": round(compile_s, 1),
+        "mfu": round(ips * fwd_flops / peak, 4),
         "phase": "resnet50_infer",
     })
     _emit()
-    return ips
+    return net
 
 
-def _resnet_phase(on_tpu, backend, probe_tflops):
+def _resnet_phase(on_tpu, backend, probe_tflops, net=None):
     import mxnet_tpu as mx
-    from mxnet_tpu import amp
-    from mxnet_tpu.models.resnet import resnet50_v1
     from mxnet_tpu.parallel.data_parallel import FusedTrainStep
 
     batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 32))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
 
-    mx.random.seed(0)
-
-    def build():
-        net = resnet50_v1(classes=1000, layout="NHWC")
-        net.initialize(init=mx.init.Xavier())
-        amp.init("bfloat16")
-        amp.convert_block(net)
-        return net
-
-    net = _build_net_on_cpu(build, (2, 32, 32, 3), "bfloat16", on_tpu)
+    if net is None:  # infer phase skipped/failed: build here
+        net = _build_resnet(on_tpu)
+    for stale in ("probe_dt_lo_s", "probe_dt_hi_s"):
+        _best.pop(stale, None)
 
     loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
     opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
@@ -486,9 +492,10 @@ def main():
 
     # forward-only ResNet-50 score: a real model number with a much
     # cheaper compile than the fused train step
+    net = None
     if _remaining() > 90.0:
         try:
-            _resnet_infer_phase(on_tpu, backend)
+            net = _resnet_infer_phase(on_tpu, backend)
         except Exception as e:
             import traceback
 
@@ -500,7 +507,7 @@ def main():
     # plausibly finish (cached recompile needs far less)
     if _remaining() > 60.0:
         try:
-            _resnet_phase(on_tpu, backend, probe_tflops)
+            _resnet_phase(on_tpu, backend, probe_tflops, net=net)
         except Exception as e:
             import traceback
 

@@ -92,6 +92,27 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def forward(self, pred, label, sample_weight=None):
+        if (self._sparse and not self._from_logits
+                and self._axis in (-1, pred.ndim - 1) and pred.ndim >= 2):
+            from ..kernels import fused_ce
+
+            if fused_ce.eligible(pred.shape[-1]):
+                # LM hot path: one fused Pallas pass over the (N, V)
+                # logits, no materialized log-probabilities
+                from ..ndarray import invoke
+
+                vocab = pred.shape[-1]
+                lbl_shape = pred.shape[:-1]
+
+                def f(x, lbl):
+                    per_row = fused_ce.fused_softmax_ce_raw(
+                        x.reshape(-1, vocab),
+                        lbl.reshape(-1).astype(jnp.int32))
+                    return per_row.reshape(lbl_shape + (1,))
+
+                loss = invoke(f, [pred, label])
+                loss = _apply_weighting(loss, self._weight, sample_weight)
+                return self._mean(loss)
         if not self._from_logits:
             pred = nd.log_softmax(pred, axis=self._axis)
         if self._sparse:

@@ -8,7 +8,55 @@ from __future__ import annotations
 import os
 import warnings
 
-__all__ = ["KernelFallback", "fallback_counts"]
+__all__ = ["KernelFallback", "fallback_counts", "operand_on_cpu",
+           "pick_rows", "pad_rows"]
+
+
+def operand_on_cpu(x) -> bool:
+    """True when a CONCRETE array lives wholly on CPU devices.
+
+    Kernel gating by `jax.default_backend()` alone is wrong for eager
+    calls on CPU-committed arrays while a TPU backend exists (e.g.
+    model init under `with mx.context.cpu():`): Mosaic lowering would
+    run against CPU operands and fail. Tracers have no devices — this
+    returns False for them and the backend gate decides."""
+    try:
+        devs = x.devices()
+        return bool(devs) and all(d.platform == "cpu" for d in devs)
+    except Exception:
+        return False
+
+
+#: VMEM is ~16 MiB/core; keep one fp32 block + temps well under it
+VMEM_BUDGET_BYTES = 4 << 20
+
+
+def pick_rows(n, d, want=512, budget_bytes=VMEM_BUDGET_BYTES):
+    """Rows per block for a (rows, d) fp32 VMEM-resident block: bounded
+    by the byte budget, power of two, MINIMUM 8 — Mosaic requires the
+    sublane (second-to-last) block dim be a multiple of 8 (callers pad
+    the row count up to a multiple, see pad_rows)."""
+    budget = max(8, budget_bytes // (max(d, 1) * 4))
+    n_cap = 8
+    while n_cap < n:
+        n_cap *= 2
+    b = max(8, min(want, budget, n_cap))
+    p = 8
+    while p * 2 <= b:
+        p *= 2
+    return p
+
+
+def pad_rows(a, rows, fill=0):
+    """Pad axis 0 up to a multiple of `rows` (callers slice the kernel
+    outputs back to the original row count)."""
+    import jax.numpy as jnp
+
+    pad = (-a.shape[0]) % rows
+    if pad:
+        a = jnp.concatenate(
+            [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0)
+    return a
 
 #: every KernelFallback registers itself here so the profiler can report
 #: per-family fallback counts (kernel regressions are never invisible)

@@ -446,6 +446,11 @@ def flash_attention_raw(q, k, v, causal=True, scale=None,
     if lengths is not None:
         lengths = jnp.asarray(lengths, jnp.int32)
     mode = _pallas_mode(q.shape[1]) if use_flash else None
+    if mode == "compiled":
+        from .dispatch import operand_on_cpu
+
+        if operand_on_cpu(q):
+            mode = None  # eager call on CPU-committed data: no Mosaic
     if mode is not None:
         try:
             return _flash_pallas(q, k, v, lengths, causal, scale,

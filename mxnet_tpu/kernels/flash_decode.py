@@ -168,5 +168,8 @@ def _pallas_mode(k_cache):
     if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
         return "interpret"
     if jax.default_backend() not in ("cpu",):
-        return "compiled"
+        from .dispatch import operand_on_cpu
+
+        # eager call on CPU-committed data: Mosaic cannot run there
+        return None if operand_on_cpu(k_cache) else "compiled"
     return None

@@ -42,36 +42,10 @@ def _pallas_mode():
     return None
 
 
-# VMEM is ~16 MiB/core; keep x-block + out-block + temps well under it
-_VMEM_BUDGET_BYTES = 4 << 20
-
-
-def _pick_rows(n, d, want=512):
-    """Rows per block: bounded by a VMEM byte budget for the (rows, d)
-    fp32 block, rounded down to a power of two, MINIMUM 8 — Mosaic
-    requires the sublane (second-to-last) block dim be a multiple of 8
-    (callers pad the row count up to a multiple, see _pad_rows)."""
-    budget = max(8, _VMEM_BUDGET_BYTES // (max(d, 1) * 4))
-    # cap near n (next power of two) so tiny inputs are not padded up
-    # to the full budget-bound block
-    n_cap = 8
-    while n_cap < n:
-        n_cap *= 2
-    b = max(8, min(want, budget, n_cap))
-    p = 8
-    while p * 2 <= b:
-        p *= 2
-    return p
-
-
-def _pad_rows(x2, rows):
-    """Zero-pad axis 0 up to a multiple of `rows` (callers slice the
-    kernel outputs back to the original row count)."""
-    pad = (-x2.shape[0]) % rows
-    if pad:
-        x2 = jnp.concatenate(
-            [x2, jnp.zeros((pad,) + x2.shape[1:], x2.dtype)], axis=0)
-    return x2
+# block sizing/padding shared across kernel families (dispatch.py):
+# 4 MiB fp32 VMEM budget, power-of-two rows, 8-sublane minimum
+from .dispatch import pad_rows as _pad_rows  # noqa: E402
+from .dispatch import pick_rows as _pick_rows  # noqa: E402
 
 
 # ---------------------------------------------------------------- RMSNorm
@@ -169,6 +143,11 @@ _rms.defvjp(_rms_fwd, _rms_bwd)
 def fused_rmsnorm(x, gamma, eps=1e-6):
     """RMSNorm over the trailing axis; Pallas on TPU, jnp elsewhere."""
     mode = _pallas_mode()
+    if mode == "compiled":
+        from .dispatch import operand_on_cpu
+
+        if operand_on_cpu(x):
+            mode = None  # eager call on CPU-committed data: no Mosaic
     if mode is not None:
         try:
             x2 = x.reshape(-1, x.shape[-1])
@@ -287,6 +266,11 @@ _ln.defvjp(_ln_fwd, _ln_bwd)
 def fused_layernorm(x, gamma, beta, eps=1e-5):
     """LayerNorm over the trailing axis; Pallas on TPU, jnp elsewhere."""
     mode = _pallas_mode()
+    if mode == "compiled":
+        from .dispatch import operand_on_cpu
+
+        if operand_on_cpu(x):
+            mode = None  # eager call on CPU-committed data: no Mosaic
     if mode is not None:
         try:
             x2 = x.reshape(-1, x.shape[-1])

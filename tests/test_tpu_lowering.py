@@ -109,3 +109,14 @@ def test_full_llama_step_lowers_with_kernels():
                            lambda: "compiled"):
         n = _lowers(fwd, jax.ShapeDtypeStruct((2, 256), jnp.int32))
     assert n >= 2  # at least the norm kernels appear in the program
+
+
+def test_fused_ce_lowers_fwd_and_grad():
+    from mxnet_tpu.kernels.fused_ce import _ce_pallas
+    # BERT-base vocab (30522: exercises the 128-lane padding) at a
+    # realistic (B*T) row count
+    x = jax.ShapeDtypeStruct((256, 30522), jnp.bfloat16)
+    lbl = jax.ShapeDtypeStruct((256,), jnp.int32)
+    _lowers(lambda a, b: _ce_pallas(a, b, False), x, lbl)
+    _lowers(lambda a, b: jax.grad(
+        lambda p: _ce_pallas(p, b, False).sum())(a), x, lbl)
