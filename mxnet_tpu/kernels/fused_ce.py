@@ -49,7 +49,8 @@ def _pallas_mode():
 
 #: one (rows, V) fp32 block must fit the VMEM budget even at the
 #: 8-row minimum — beyond this vocab the block cannot be staged
-_MAX_VOCAB = (4 << 20) // 4 // 8 * 8  # ~1M columns at 8 rows
+#: (4 MiB budget / 4 bytes / 8 rows = 128k columns)
+_MAX_VOCAB = (4 << 20) // 4 // 8
 
 
 def eligible(vocab: int) -> bool:

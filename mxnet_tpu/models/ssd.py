@@ -1,0 +1,152 @@
+"""SSD single-shot detector (reference: example/ssd/ +
+src/operator/contrib/multibox_*.cc; gluoncv ssd family).
+
+TPU-first: one fused forward emits flat per-anchor class/box
+predictions for EVERY scale (static anchor count — no dynamic shapes),
+anchors are compile-time constants from `nd.contrib.multibox_prior`,
+and the training loss (SSDLoss) does hard-negative mining with a
+rank-based top-k that keeps every shape static so the whole train step
+jits into one XLA executable. Default layout NHWC (TPU conv tiling).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock, HybridSequential
+from ..gluon.loss import Loss
+from . import register_model
+
+__all__ = ["SSD", "SSDLoss", "ssd_300"]
+
+
+def _conv_block(channels, stride=1, layout="NHWC"):
+    ax = layout.index("C")
+    out = HybridSequential()
+    out.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False,
+                      layout=layout),
+            nn.BatchNorm(axis=ax), nn.Activation("relu"))
+    return out
+
+
+def _down_block(channels, layout="NHWC"):
+    """3x3 stride-2 downsampler between detection scales."""
+    out = HybridSequential()
+    out.add(_conv_block(channels // 2, 1, layout),
+            _conv_block(channels, 2, layout))
+    return out
+
+
+class SSD(HybridBlock):
+    """Multi-scale SSD head over a small conv trunk.
+
+    forward(x) -> (anchors (1, A, 4), cls_preds (B, A, classes+1),
+    box_preds (B, A*4)); A = sum over scales of H*W*K.
+    """
+
+    def __init__(self, classes=20, base_channels=32,
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+                        (0.71, 0.79), (0.88, 0.961)),
+                 ratios=((1.0, 2.0, 0.5),) * 5, layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert layout == "NHWC", "SSD is TPU-native: NHWC only"
+        self.classes = classes
+        self.sizes = sizes
+        self.ratios = ratios
+        self.num_scales = len(sizes)
+        self.anchors_per_pos = [len(s) + len(r) - 1
+                                for s, r in zip(sizes, ratios)]
+
+        # trunk: 3 stride-2 conv blocks (image/8), then one extra
+        # down block per remaining scale, global pool for the last
+        self.trunk = HybridSequential()
+        for ch in (base_channels, base_channels * 2, base_channels * 4):
+            self.trunk.add(_conv_block(ch, 1, layout))
+            self.trunk.add(nn.MaxPool2D(2, 2, layout=layout))
+        self.blocks = HybridSequential()
+        self.cls_heads = HybridSequential()
+        self.box_heads = HybridSequential()
+        for i in range(self.num_scales):
+            if i > 0:
+                self.blocks.add(_down_block(base_channels * 4, layout))
+            k = self.anchors_per_pos[i]
+            self.cls_heads.add(nn.Conv2D(k * (classes + 1), 3, 1, 1,
+                                         layout=layout))
+            self.box_heads.add(nn.Conv2D(k * 4, 3, 1, 1, layout=layout))
+
+    def forward(self, x):
+        feats = self.trunk(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for i in range(self.num_scales):
+            if i > 0:
+                feats = self.blocks[i - 1](feats)
+            anchors.append(nd.contrib.multibox_prior(
+                feats, sizes=self.sizes[i], ratios=self.ratios[i]))
+            cp = self.cls_heads[i](feats)      # (B, H, W, K*(C+1))
+            bp = self.box_heads[i](feats)      # (B, H, W, K*4)
+            B = cp.shape[0]
+            cls_preds.append(cp.reshape(B, -1, self.classes + 1))
+            box_preds.append(bp.reshape(B, -1))
+        return (nd.concat(*anchors, dim=1),
+                nd.concat(*cls_preds, dim=1),
+                nd.concat(*box_preds, dim=1))
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45,
+               nms_topk=400):
+        """Inference: decoded + NMS'd detections (B, A, 6) rows
+        [cls_id, score, xmin, ymin, xmax, ymax]."""
+        anchors, cls_preds, box_preds = self(x)
+        cls_prob = nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+        return nd.contrib.multibox_detection(
+            cls_prob, box_preds, anchors, threshold=threshold,
+            nms_threshold=nms_threshold, nms_topk=nms_topk)
+
+
+class SSDLoss(Loss):
+    """Class CE with 3:1 hard-negative mining + SmoothL1 box loss
+    (reference: example/ssd training objective). Rank-based mining:
+    negatives are sorted by confidence loss and the top 3*num_pos per
+    image are kept — a static-shape formulation (argsort-of-argsort)
+    that jits cleanly."""
+
+    def __init__(self, negative_mining_ratio=3.0, lambd=1.0,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._ratio = negative_mining_ratio
+        self._lambd = lambd
+
+    def forward(self, cls_preds, box_preds, cls_target, box_target,
+                box_mask):
+        # per-anchor CE (B, A)
+        lp = nd.log_softmax(cls_preds, axis=-1)
+        per = -nd.pick(lp, cls_target, axis=-1)
+        pos = (cls_target > 0).astype("float32")        # (B, A)
+        num_pos = pos.sum(axis=1, keepdims=True)        # (B, 1)
+
+        # hard-negative mining: rank negatives by loss, keep top
+        # ratio*num_pos (static shapes via double argsort)
+        neg_loss = per * (1.0 - pos)
+        rank = nd.argsort(nd.argsort(neg_loss, axis=1,
+                                     is_ascend=False), axis=1,
+                          is_ascend=True)
+        neg = (rank < self._ratio * num_pos).astype("float32") \
+            * (1.0 - pos)
+        cls_loss = (per * (pos + neg)).sum(axis=1) \
+            / nd.maximum(num_pos[:, 0], nd.ones_like(num_pos[:, 0]))
+
+        # SmoothL1 on encoded offsets, positives only
+        diff = (box_preds - box_target) * box_mask
+        ad = nd.abs(diff)
+        sl1 = nd.where(ad > 1.0, ad - 0.5, 0.5 * ad * ad)
+        box_loss = sl1.sum(axis=1) \
+            / nd.maximum(num_pos[:, 0] * 4,
+                         nd.ones_like(num_pos[:, 0]))
+        return cls_loss + self._lambd * box_loss
+
+
+@register_model("ssd_300")
+def ssd_300(classes=20, **kwargs):
+    """SSD sized for ~300px inputs (5 scales)."""
+    return SSD(classes=classes, **kwargs)

@@ -15,7 +15,9 @@ __all__ = ["isnan", "isinf", "isfinite", "index_copy", "index_array",
            "allclose",
            "interleaved_matmul_selfatt_qk", "rotary_embedding",
            "foreach", "while_loop", "cond",
-           "ROIAlign", "box_nms", "box_iou", "DeformableConvolution"]
+           "ROIAlign", "box_nms", "box_iou", "DeformableConvolution",
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+           "multibox_prior", "multibox_target", "multibox_detection"]
 
 # vision contrib ops live in vision_ops.py; re-export under the
 # upstream contrib names (src/operator/contrib/roi_align.cc,
@@ -23,6 +25,13 @@ __all__ = ["isnan", "isinf", "isfinite", "index_copy", "index_array",
 from .vision_ops import (roi_align as ROIAlign,  # noqa: E402,F401
                          box_nms, box_iou,
                          deformable_convolution as DeformableConvolution)
+# SSD multibox family (src/operator/contrib/multibox_*.cc)
+from .multibox import (multibox_prior,  # noqa: E402,F401
+                       multibox_target, multibox_detection)
+
+MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
+MultiBoxDetection = multibox_detection
 
 
 def isnan(data):

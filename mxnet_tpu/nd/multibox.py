@@ -1,0 +1,198 @@
+"""SSD multibox ops (reference: src/operator/contrib/multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc — the ops behind example/ssd).
+
+TPU-first formulations: anchor grids are compile-time constants (pure
+functions of static feature-map shapes, built with numpy so XLA sees a
+constant); target assignment and detection decoding are fully
+vectorized over fixed-size anchor/label tensors — no data-dependent
+shapes, no host round trips inside a training step.
+
+Conventions (upstream-compatible):
+- anchors: (1, A, 4) corner format [xmin, ymin, xmax, ymax], normalized.
+- labels:  (B, M, 5) rows [cls, xmin, ymin, xmax, ymax]; cls = -1 pads.
+- box encoding: SSD center-offset with variances (0.1, 0.1, 0.2, 0.2).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, invoke
+
+__all__ = ["multibox_prior", "multibox_target", "multibox_detection"]
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=None,
+                   offsets=(0.5, 0.5), layout="NHWC"):
+    """Anchor boxes for one feature map: (1, H*W*K, 4) corner boxes,
+    K = len(sizes) + len(ratios) - 1 (upstream convention: all sizes
+    at ratio[0], plus ratios[1:] at size[0])."""
+    shape = data.shape
+    if layout == "NHWC":
+        h, w = shape[1], shape[2]
+    else:  # NCHW
+        h, w = shape[2], shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    step_y = steps[0] if steps else 1.0 / h
+    step_x = steps[1] if steps else 1.0 / w
+    cy = (np.arange(h) + offsets[0]) * step_y
+    cx = (np.arange(w) + offsets[1]) * step_x
+    cyx = np.stack(np.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h,w,2)
+
+    wh = []
+    for s in sizes:
+        r = ratios[0]
+        wh.append((s * np.sqrt(r), s / np.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        wh.append((s * np.sqrt(r), s / np.sqrt(r)))
+    wh = np.asarray(wh, np.float32)                      # (K, 2) w,h
+
+    cyx = np.broadcast_to(cyx[:, :, None, :], (h, w, len(wh), 2))
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    xmin = cyx[..., 1] - half_w
+    ymin = cyx[..., 0] - half_h
+    xmax = cyx[..., 1] + half_w
+    ymax = cyx[..., 0] + half_h
+    anchors = np.stack([xmin, ymin, xmax, ymax], axis=-1) \
+        .reshape(1, -1, 4).astype(np.float32)
+    return NDArray(jnp.asarray(anchors),
+                   ctx=data._ctx if isinstance(data, NDArray) else None)
+
+
+def _corner_to_center(b):
+    x1, y1, x2, y2 = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def _pair_iou(a, b):
+    """(..., N, 4) x (..., M, 4) corner IoU -> (..., N, M)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)
+    bx1, by1, bx2, by2 = jnp.split(b, 4, axis=-1)
+    ix1 = jnp.maximum(ax1, jnp.swapaxes(bx1, -1, -2))
+    iy1 = jnp.maximum(ay1, jnp.swapaxes(by1, -1, -2))
+    ix2 = jnp.minimum(ax2, jnp.swapaxes(bx2, -1, -2))
+    iy2 = jnp.minimum(ay2, jnp.swapaxes(by2, -1, -2))
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = (ax2 - ax1) * (ay2 - ay1)
+    area_b = (bx2 - bx1) * (by2 - by1)
+    union = area_a + jnp.swapaxes(area_b, -1, -2) - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def multibox_target(anchors, labels, overlap_threshold=0.5):
+    """SSD target assignment -> (box_target (B, A*4), box_mask (B, A*4),
+    cls_target (B, A)). cls_target: 0 = background, gt class + 1
+    otherwise. Matching: per-anchor best gt with IoU >= threshold,
+    plus each valid gt's single best anchor (forced match, overrides)."""
+    def f(anc, lab):
+        anc2 = anc[0]                                   # (A, 4)
+        A = anc2.shape[0]
+        B, M, _ = lab.shape
+        gt_cls = lab[..., 0]                            # (B, M)
+        gt_box = lab[..., 1:5]                          # (B, M, 4)
+        valid = gt_cls >= 0                             # (B, M)
+
+        iou = _pair_iou(jnp.broadcast_to(anc2[None], (B, A, 4)),
+                        gt_box)                         # (B, A, M)
+        iou = jnp.where(valid[:, None, :], iou, -1.0)
+
+        best_gt = jnp.argmax(iou, axis=-1)              # (B, A)
+        best_iou = jnp.max(iou, axis=-1)                # (B, A)
+        assigned = best_iou >= overlap_threshold        # (B, A)
+
+        # forced match: gt j claims its best anchor (overrides the
+        # threshold rule there)
+        best_anchor = jnp.argmax(iou, axis=1)           # (B, M)
+        onehot = (jax.nn.one_hot(best_anchor, A, dtype=jnp.float32)
+                  * valid[..., None])                   # (B, M, A)
+        forced = jnp.sum(onehot, axis=1) > 0            # (B, A)
+        # which gt forced this anchor (last valid gt wins on collision)
+        forced_gt = jnp.argmax(
+            onehot * (1.0 + jnp.arange(M)[None, :, None]), axis=1) \
+            .astype(jnp.int32)
+
+        pos = assigned | forced
+        gt_idx = jnp.where(forced, forced_gt, best_gt)  # (B, A)
+
+        take = jax.vmap(lambda gb, gi: gb[gi])          # per batch row
+        match_box = take(gt_box, gt_idx)                # (B, A, 4)
+        match_cls = take(gt_cls, gt_idx)                # (B, A)
+
+        # encode center offsets with variances
+        a_c = _corner_to_center(anc2)                   # (A, 4)
+        g_c = _corner_to_center(match_box)              # (B, A, 4)
+        acx, acy, aw, ah = (a_c[..., 0], a_c[..., 1],
+                            a_c[..., 2], a_c[..., 3])
+        tx = (g_c[..., 0] - acx) / jnp.maximum(aw, 1e-12) / _VARIANCES[0]
+        ty = (g_c[..., 1] - acy) / jnp.maximum(ah, 1e-12) / _VARIANCES[1]
+        tw = jnp.log(jnp.maximum(g_c[..., 2], 1e-12)
+                     / jnp.maximum(aw, 1e-12)) / _VARIANCES[2]
+        th = jnp.log(jnp.maximum(g_c[..., 3], 1e-12)
+                     / jnp.maximum(ah, 1e-12)) / _VARIANCES[3]
+        enc = jnp.stack([tx, ty, tw, th], axis=-1)      # (B, A, 4)
+
+        posf = pos.astype(jnp.float32)
+        box_target = (enc * posf[..., None]).reshape(B, A * 4)
+        box_mask = jnp.broadcast_to(posf[..., None],
+                                    (B, A, 4)).reshape(B, A * 4)
+        cls_target = jnp.where(pos, match_cls + 1, 0.0)
+        return box_target, box_mask, cls_target
+
+    return invoke(f, [anchors, labels], n_out=3)
+
+
+def multibox_detection(cls_prob, loc_pred, anchors, threshold=0.01,
+                       nms_threshold=0.45, force_suppress=False,
+                       nms_topk=400, clip=True):
+    """Decode + per-class NMS -> (B, A, 6) rows
+    [cls_id, score, xmin, ymin, xmax, ymax]; suppressed/background rows
+    have cls_id = -1 (upstream multibox_detection contract).
+    cls_prob (B, C+1, A) class-major like upstream (class 0 =
+    background); loc_pred (B, A*4); anchors (1, A, 4)."""
+    from .vision_ops import box_nms
+
+    def decode(cp, lp, anc):
+        B = cp.shape[0]
+        A = anc.shape[1]
+        a_c = _corner_to_center(anc[0])                 # (A, 4)
+        off = lp.reshape(B, A, 4)
+        cx = off[..., 0] * _VARIANCES[0] * a_c[..., 2] + a_c[..., 0]
+        cy = off[..., 1] * _VARIANCES[1] * a_c[..., 3] + a_c[..., 1]
+        w = jnp.exp(jnp.clip(off[..., 2] * _VARIANCES[2], -10, 10)) \
+            * a_c[..., 2]
+        h = jnp.exp(jnp.clip(off[..., 3] * _VARIANCES[3], -10, 10)) \
+            * a_c[..., 3]
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = cp[:, 1:, :]                               # (B, C, A)
+        cls_id = jnp.argmax(fg, axis=1).astype(jnp.float32)  # (B, A)
+        score = jnp.max(fg, axis=1)                     # (B, A)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        score = jnp.where(keep, score, -1.0)
+        return jnp.concatenate(
+            [cls_id[..., None], score[..., None], boxes], axis=-1)
+
+    out = invoke(decode, [cls_prob, loc_pred, anchors])
+    out = box_nms(out, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1,
+                  id_index=0, force_suppress=force_suppress)
+
+    def finalize(o):
+        # box_nms marks suppressed rows by score=-1; mirror upstream by
+        # also clearing their class id
+        return o.at[..., 0].set(jnp.where(o[..., 1] < 0, -1.0,
+                                          o[..., 0]))
+
+    return invoke(finalize, [out])

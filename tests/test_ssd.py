@@ -1,0 +1,131 @@
+"""SSD multibox ops + model (reference: src/operator/contrib/
+multibox_*.cc + example/ssd): anchor math against hand-computed values,
+target assignment on constructed cases, decode/NMS round trip, model
+forward shapes, and a tiny overfit sanity run."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_counts_and_values():
+    x = nd.zeros((1, 2, 2, 8))                    # NHWC 2x2 map
+    anc = nd.contrib.multibox_prior(x, sizes=(0.5, 0.25),
+                                    ratios=(1.0, 2.0))
+    # K = len(sizes) + len(ratios) - 1 = 3; A = 2*2*3
+    assert anc.shape == (1, 12, 4)
+    a = anc.asnumpy()[0]
+    # first anchor: center (0.25, 0.25), size 0.5, ratio 1
+    np.testing.assert_allclose(a[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # second anchor at same center: size 0.25
+    np.testing.assert_allclose(a[1], [0.125, 0.125, 0.375, 0.375],
+                               atol=1e-6)
+    # third: size 0.5, ratio 2 -> w=0.5*sqrt(2), h=0.5/sqrt(2)
+    w, h = 0.5 * np.sqrt(2), 0.5 / np.sqrt(2)
+    np.testing.assert_allclose(a[2], [0.25 - w / 2, 0.25 - h / 2,
+                                      0.25 + w / 2, 0.25 + h / 2],
+                               atol=1e-6)
+    # all centers in [0,1]
+    cx = (a[:, 0] + a[:, 2]) / 2
+    assert cx.min() > 0 and cx.max() < 1
+
+
+def test_multibox_target_exact_match():
+    # anchor 1 exactly equals the gt box -> positive, zero offsets
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.2, 0.2],
+                                  [0.4, 0.4, 0.8, 0.8],
+                                  [0.0, 0.5, 0.3, 0.9]]],
+                                dtype=np.float32))
+    labels = nd.array(np.array([[[2, 0.4, 0.4, 0.8, 0.8],
+                                 [-1, 0, 0, 0, 0]]], dtype=np.float32))
+    bt, bm, ct = nd.contrib.multibox_target(anchors, labels)
+    ct = ct.asnumpy()[0]
+    assert ct[1] == 3.0                     # class 2 -> target 2+1
+    assert ct[0] == 0.0 and ct[2] == 0.0    # background
+    bt = bt.asnumpy()[0].reshape(3, 4)
+    bm = bm.asnumpy()[0].reshape(3, 4)
+    np.testing.assert_allclose(bt[1], 0.0, atol=1e-5)  # exact match
+    np.testing.assert_allclose(bm[1], 1.0)
+    np.testing.assert_allclose(bm[0], 0.0)
+
+
+def test_multibox_target_forced_match():
+    # no anchor reaches the 0.5 IoU threshold, but the gt's best
+    # anchor is still forced positive
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.3, 0.3],
+                                  [0.5, 0.5, 1.0, 1.0]]],
+                                dtype=np.float32))
+    labels = nd.array(np.array([[[0, 0.25, 0.25, 0.55, 0.55]]],
+                               dtype=np.float32))
+    _, _, ct = nd.contrib.multibox_target(anchors, labels)
+    assert ct.asnumpy()[0].max() == 1.0     # one forced positive
+
+
+def test_multibox_detection_decodes_anchors():
+    # zero offsets decode back to the anchors; NMS keeps the top box
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.12, 0.1, 0.52, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9]]],
+                                dtype=np.float32))
+    A = 3
+    cls_prob = nd.array(np.array(
+        [[[0.1, 0.2, 0.05], [0.6, 0.7, 0.05], [0.3, 0.1, 0.9]]],
+        dtype=np.float32))                   # (B, C+1=3, A) class-major
+    loc = nd.zeros((1, A * 4))
+    out = nd.contrib.multibox_detection(cls_prob, loc, anchors,
+                                        nms_threshold=0.5).asnumpy()[0]
+    # anchor 0/1 are class 0 (fg), heavily overlapping: one suppressed
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2                    # one of 0/1 plus anchor 2
+    cls0 = kept[kept[:, 0] == 0.0]
+    assert len(cls0) == 1                    # lower-scored twin gone
+    np.testing.assert_allclose(cls0[0, 2:], [0.12, 0.1, 0.52, 0.5],
+                               atol=1e-5)    # the 0.7-scored anchor 1
+    cls2 = out[2]
+    assert cls2[0] == 1.0                    # anchor 2 -> class 1
+
+
+def test_ssd_forward_shapes():
+    net = mx.models.get_model("ssd_300", classes=4, base_channels=8)
+    net.initialize()
+    x = nd.zeros((2, 64, 64, 3))
+    anchors, cls_preds, box_preds = net(x)
+    A = anchors.shape[1]
+    assert anchors.shape == (1, A, 4)
+    assert cls_preds.shape == (2, A, 5)
+    assert box_preds.shape == (2, A * 4)
+    det = net.detect(x)
+    assert det.shape == (2, A, 6)
+    # hybridize parity: the traced forward (anchor constants embedded)
+    # matches eager
+    e = cls_preds.asnumpy()
+    net.hybridize()
+    _, cls_h, _ = net(x)
+    np.testing.assert_allclose(e, cls_h.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ssd_overfits_one_batch():
+    mx.random.seed(0)
+    net = mx.models.get_model("ssd_300", classes=2, base_channels=8)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 64, 64, 3).astype(np.float32))
+    labels = nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.45, 0.45]], [[1, 0.5, 0.5, 0.95, 0.95]]],
+        dtype=np.float32))
+    anchors, _, _ = net(x)
+    bt, bm, ct = nd.contrib.multibox_target(anchors, labels)
+    loss_fn = mx.models.ssd.SSDLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 5e-3})
+    losses = []
+    for _ in range(12):
+        with mx.autograd.record():
+            _, cls_preds, box_preds = net(x)
+            l = loss_fn(cls_preds, box_preds, ct, bt, bm).mean()
+        l.backward()
+        tr.step(1)
+        losses.append(float(l.asscalar()))
+    assert losses[-1] < losses[0] * 0.8, losses
