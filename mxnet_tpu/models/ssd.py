@@ -10,8 +10,6 @@ jits into one XLA executable. Default layout NHWC (TPU conv tiling).
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import nd
 from ..gluon import nn
 from ..gluon.block import HybridBlock, HybridSequential
@@ -118,7 +116,7 @@ class SSDLoss(Loss):
         self._lambd = lambd
 
     def forward(self, cls_preds, box_preds, cls_target, box_target,
-                box_mask):
+                box_mask, sample_weight=None):
         # per-anchor CE (B, A)
         lp = nd.log_softmax(cls_preds, axis=-1)
         per = -nd.pick(lp, cls_target, axis=-1)
@@ -143,7 +141,10 @@ class SSDLoss(Loss):
         box_loss = sl1.sum(axis=1) \
             / nd.maximum(num_pos[:, 0] * 4,
                          nd.ones_like(num_pos[:, 0]))
-        return cls_loss + self._lambd * box_loss
+        from ..gluon.loss import _apply_weighting
+
+        return _apply_weighting(cls_loss + self._lambd * box_loss,
+                                self._weight, sample_weight)
 
 
 @register_model("ssd_300")

@@ -65,10 +65,22 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
 
     def f_nb(x, w):
         # bf16 in/out; the MXU accumulates in fp32 internally
-        return lax.conv_general_dilated(
+        out = lax.conv_general_dilated(
             x, w, window_strides=stride, padding=pads,
             lhs_dilation=(1,) * nd_, rhs_dilation=dilate,
             dimension_numbers=dn, feature_group_count=num_group)
+        if 0 in out.shape and 0 not in x.shape:
+            # almost always a layout mismatch (NHWC data through an
+            # NCHW-configured layer); fail here with the shapes instead
+            # of letting an empty tensor corrupt downstream inference.
+            # (a genuinely empty input, e.g. a batch-0 bucket tail,
+            # passes through)
+            raise ValueError(
+                f"Convolution produced an empty output {out.shape} "
+                f"(input {x.shape}, weight {w.shape}, layout "
+                f"{layout!r}) — check the layer's `layout` matches the "
+                "data")
+        return out
 
     def f(x, w, b):
         out = f_nb(x, w)

@@ -72,19 +72,7 @@ def _corner_to_center(b):
         [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
 
 
-def _pair_iou(a, b):
-    """(..., N, 4) x (..., M, 4) corner IoU -> (..., N, M)."""
-    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)
-    bx1, by1, bx2, by2 = jnp.split(b, 4, axis=-1)
-    ix1 = jnp.maximum(ax1, jnp.swapaxes(bx1, -1, -2))
-    iy1 = jnp.maximum(ay1, jnp.swapaxes(by1, -1, -2))
-    ix2 = jnp.minimum(ax2, jnp.swapaxes(bx2, -1, -2))
-    iy2 = jnp.minimum(ay2, jnp.swapaxes(by2, -1, -2))
-    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
-    area_a = (ax2 - ax1) * (ay2 - ay1)
-    area_b = (bx2 - bx1) * (by2 - by1)
-    union = area_a + jnp.swapaxes(area_b, -1, -2) - inter
-    return inter / jnp.maximum(union, 1e-12)
+from .vision_ops import iou_corner as _pair_iou  # noqa: E402
 
 
 def multibox_target(anchors, labels, overlap_threshold=0.5):

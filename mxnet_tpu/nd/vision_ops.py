@@ -193,6 +193,22 @@ def SpatialTransformer(data, loc, target_shape,
     return BilinearSampler(data, grid)
 
 
+def iou_corner(a, b):
+    """Raw-jnp pairwise corner IoU (..., N, 4) x (..., M, 4) ->
+    (..., N, M); shared by box_iou/box_nms and the multibox ops."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)   # (..., N, 1)
+    bx1, by1, bx2, by2 = jnp.split(b, 4, axis=-1)   # (..., M, 1)
+    ix1 = jnp.maximum(ax1, jnp.swapaxes(bx1, -1, -2))
+    iy1 = jnp.maximum(ay1, jnp.swapaxes(by1, -1, -2))
+    ix2 = jnp.minimum(ax2, jnp.swapaxes(bx2, -1, -2))
+    iy2 = jnp.minimum(ay2, jnp.swapaxes(by2, -1, -2))
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = (ax2 - ax1) * (ay2 - ay1)
+    area_b = (bx2 - bx1) * (by2 - by1)
+    union = area_a + jnp.swapaxes(area_b, -1, -2) - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
 def box_iou(lhs, rhs, fmt="corner"):
     """Pairwise IoU of (..., N, 4) x (..., M, 4) boxes (reference:
     src/operator/contrib/bounding_box.cc box_iou)."""
@@ -204,17 +220,7 @@ def box_iou(lhs, rhs, fmt="corner"):
                     [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
                     axis=-1)
             a, b = to_corner(a), to_corner(b)
-        ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)   # (..., N, 1)
-        bx1, by1, bx2, by2 = jnp.split(b, 4, axis=-1)   # (..., M, 1)
-        ix1 = jnp.maximum(ax1, jnp.swapaxes(bx1, -1, -2))
-        iy1 = jnp.maximum(ay1, jnp.swapaxes(by1, -1, -2))
-        ix2 = jnp.minimum(ax2, jnp.swapaxes(bx2, -1, -2))
-        iy2 = jnp.minimum(ay2, jnp.swapaxes(by2, -1, -2))
-        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
-        area_a = (ax2 - ax1) * (ay2 - ay1)
-        area_b = (bx2 - bx1) * (by2 - by1)
-        union = area_a + jnp.swapaxes(area_b, -1, -2) - inter
-        return inter / jnp.maximum(union, 1e-12)
+        return iou_corner(a, b)
 
     return invoke(f, [lhs, rhs])
 

@@ -23,6 +23,8 @@ CASES = {
                          "--seq-len", "32", "--vocab", "128",
                          "--units", "32", "--layers", "1"],
     "dist_train_ps.py": ["--cpu", "--steps", "4", "--workers", "2"],
+    "train_ssd.py": ["--cpu", "--steps", "6", "--batch-size", "4"],
+    "dcgan.py": ["--cpu", "--steps", "4", "--batch-size", "4"],
 }
 
 
