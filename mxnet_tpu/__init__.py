@@ -30,6 +30,8 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv              # reference alias: mx.kv.create
 from .kvstore import create as _kv_create  # noqa: F401
+from . import numpy as np              # reference: from mxnet import np
+from . import numpy_extension as npx   # reference: from mxnet import npx
 from . import gluon
 from . import models
 from . import amp

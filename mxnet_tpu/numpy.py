@@ -1,0 +1,193 @@
+"""`mx.np` — the numpy-compatible interface of MXNet 1.6+ (reference:
+python/mxnet/numpy/: `from mxnet import np, npx`). Functions take and
+return `NDArray` with standard numpy semantics; everything dispatches
+through the same `invoke` chokepoint as `mx.nd`, so autograd recording,
+async dispatch, and hybrid tracing all work unchanged.
+
+Most members are thin numpy-named wrappers over jax.numpy (whose
+semantics already ARE numpy's); data-dependent-shape ops (`unique`)
+run eagerly through the host like the reference's fallback ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _onp
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, invoke
+from . import ndarray as _ndmod
+from . import random  # noqa: F401  (mx.np.random.uniform(...) etc.)
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+ndarray = NDArray
+
+float32 = "float32"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float64 = "float64"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool_ = "bool"
+
+
+def _wrap(fn, name=None):
+    """numpy-named op over NDArray/scalar args: kwargs pass through to
+    the jnp function, NDArray positions join the autograd tape."""
+    @functools.wraps(fn)
+    def f(*args, **kwargs):
+        def g(*raw):
+            return fn(*raw, **kwargs)
+        return invoke(g, list(args))
+    if name:
+        f.__name__ = name
+    return f
+
+
+_UNARY_BINARY = [
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "mod", "remainder", "floor_divide", "negative", "reciprocal",
+    "abs", "absolute", "fabs", "sign", "sqrt", "cbrt", "square",
+    "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "floor", "ceil", "rint", "trunc",
+    "maximum", "minimum", "fmax", "fmin", "hypot", "clip",
+    "logaddexp", "gcd", "lcm",
+    # comparison / logic
+    "equal", "not_equal", "greater", "greater_equal", "less",
+    "less_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "isnan", "isinf", "isfinite", "isposinf",
+    "isneginf",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "std", "var", "argmax",
+    "argmin", "cumsum", "cumprod", "all", "any", "median",
+    "nanmax", "nanmin", "nansum", "nanmean",
+    # shape
+    "reshape", "transpose", "swapaxes", "moveaxis", "expand_dims",
+    "squeeze", "ravel", "tile", "repeat", "flip", "roll",
+    "broadcast_to", "atleast_1d", "atleast_2d", "atleast_3d",
+    "triu", "tril", "diag",
+    # linalg-ish
+    "dot", "matmul", "tensordot", "inner", "outer", "trace", "kron",
+    "vdot", "cross",
+    # sorting / search
+    "sort", "argsort", "searchsorted", "take", "take_along_axis",
+    "where",
+]
+
+for _name in _UNARY_BINARY:
+    globals()[_name] = _wrap(getattr(jnp, _name), _name)
+
+fix = globals()["trunc"]  # jnp.fix is deprecated; numpy fix == trunc
+del _name
+
+
+def einsum(subscripts, *operands):
+    return invoke(lambda *raw: jnp.einsum(subscripts, *raw),
+                  list(operands))
+
+
+def concatenate(seq, axis=0):
+    return invoke(lambda *raw: jnp.concatenate(raw, axis=axis),
+                  list(seq))
+
+
+def stack(seq, axis=0):
+    return invoke(lambda *raw: jnp.stack(raw, axis=axis), list(seq))
+
+
+def vstack(seq):
+    return invoke(lambda *raw: jnp.vstack(raw), list(seq))
+
+
+def hstack(seq):
+    return invoke(lambda *raw: jnp.hstack(raw), list(seq))
+
+
+def split(ary, indices_or_sections, axis=0):
+    n = (indices_or_sections if isinstance(indices_or_sections, int)
+         else len(indices_or_sections) + 1)
+    if n == 1:  # n_out=1 would wrap the 1-tuple itself
+        return [invoke(lambda raw: jnp.split(
+            raw, indices_or_sections, axis=axis)[0], [ary])]
+    return list(invoke(
+        lambda raw: tuple(jnp.split(raw, indices_or_sections,
+                                    axis=axis)),
+        [ary], n_out=n))
+
+
+# -- creation ---------------------------------------------------------------
+
+def array(obj, dtype=None, ctx=None):
+    return _ndmod.array(obj, dtype=dtype, ctx=ctx)
+
+
+zeros = _ndmod.zeros
+ones = _ndmod.ones
+full = _ndmod.full
+empty = _ndmod.empty
+arange = _ndmod.arange
+zeros_like = _ndmod.zeros_like
+ones_like = _ndmod.ones_like
+
+
+def full_like(a, fill_value, dtype=None):
+    return invoke(lambda x: jnp.full_like(
+        x, fill_value, dtype=_ndmod.resolve_dtype(dtype)
+        if dtype else None), [a])
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    raw = jnp.linspace(start, stop, num, endpoint=endpoint,
+                       dtype=_ndmod.resolve_dtype(dtype)
+                       if dtype else None)
+    return NDArray(raw, ctx=ctx, _place=True)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return NDArray(jnp.eye(N, M, k=k,
+                           dtype=_ndmod.resolve_dtype(dtype)),
+                   ctx=ctx, _place=True)
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def meshgrid(*xs, indexing="xy"):
+    n = len(xs)
+    if n == 1:  # n_out=1 would wrap the 1-tuple itself
+        return [invoke(lambda raw: jnp.meshgrid(
+            raw, indexing=indexing)[0], [xs[0]])]
+    return list(invoke(
+        lambda *raw: tuple(jnp.meshgrid(*raw, indexing=indexing)),
+        list(xs), n_out=n))
+
+
+# -- host-side (data-dependent output shapes) -------------------------------
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """Eager host op (output shape is data-dependent — upstream also
+    treats this as a fallback op outside the compiled graph)."""
+    res = _onp.unique(ar.asnumpy() if isinstance(ar, NDArray) else ar,
+                      return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def may_share_memory(a, b):  # numpy API parity; XLA arrays never do
+    return False

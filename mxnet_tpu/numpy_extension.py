@@ -1,0 +1,72 @@
+"""`mx.npx` — numpy_extension: the MXNet-specific operators that have
+no numpy counterpart (reference: python/mxnet/numpy_extension/ —
+`from mxnet import np, npx`). Neural-net primitives, device control,
+and the npz save/load helpers, all over NDArray.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from . import nd as _nd
+from .ndarray import NDArray, waitall  # noqa: F401 (re-export)
+from .context import cpu, tpu, gpu, num_tpus, num_gpus  # noqa: F401
+from .random import seed  # noqa: F401
+
+# activation / nn primitives (npx namespace in the reference)
+relu = _nd.relu
+sigmoid = _nd.sigmoid
+softmax = _nd.softmax
+log_softmax = _nd.log_softmax
+one_hot = _nd.one_hot
+pick = _nd.pick
+topk = _nd.topk
+batch_dot = _nd.batch_dot
+gamma = _nd.gamma
+erf = _nd.erf
+gelu = _nd.gelu
+
+# npx.reshape supports -2/-3/-4 magic the same way nd.reshape does
+reshape = _nd.reshape
+reshape_like = _nd.reshape_like
+
+_NP_ARRAY = False
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Reference API parity: mxnet flips global numpy semantics with
+    npx.set_np(). This framework is numpy-semantics native, so the
+    switch only records intent."""
+    global _NP_ARRAY
+    _NP_ARRAY = bool(array)
+
+
+def reset_np():
+    global _NP_ARRAY
+    _NP_ARRAY = False
+
+
+def is_np_array():
+    return _NP_ARRAY
+
+
+def save(file, arrays):
+    """npx.save: dict or list of NDArray -> .npz-style file."""
+    if isinstance(arrays, dict):
+        _onp.savez(file, **{k: v.asnumpy() for k, v in arrays.items()})
+    elif isinstance(arrays, (list, tuple)):
+        _onp.savez(file, *[a.asnumpy() for a in arrays])
+    else:
+        _onp.savez(file, arrays.asnumpy())
+
+
+def load(file):
+    """npx.load: {name: NDArray} for dict-saved files, [NDArray] for
+    list-saved ones (positional `arr_0..arr_{n-1}` keys), matching the
+    reference round trip."""
+    from . import numpy as _np
+
+    with _onp.load(file) as data:
+        files = list(data.files)
+        if files == [f"arr_{i}" for i in range(len(files))]:
+            return [_np.array(data[k]) for k in files]
+        return {k: _np.array(data[k]) for k in files}

@@ -61,3 +61,13 @@ def trace_key(key):
 
 def is_tracing_rng() -> bool:
     return bool(_st().trace_stack)
+
+
+# reference parity: mx.random.uniform/normal/... (python/mxnet/random.py
+# delegates to nd.random the same way). Imported at the bottom because
+# nd._ops_random draws its keys from next_key() above.
+from .nd._ops_random import (uniform, normal, randn,  # noqa: E402,F401
+                             randint, exponential, gamma, poisson,
+                             negative_binomial,
+                             generalized_negative_binomial, bernoulli,
+                             multinomial, shuffle)

@@ -1,0 +1,119 @@
+"""mx.np / mx.npx numpy-compatible interface (reference:
+python/mxnet/numpy/ + numpy_extension/ — `from mxnet import np, npx`):
+numpy-parity values, autograd through np ops, npz save/load."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.ndarray import NDArray
+
+
+def test_creation_and_constants():
+    assert np.pi == onp.pi
+    z = np.zeros((2, 3))
+    assert isinstance(z, NDArray) and z.shape == (2, 3)
+    o = np.ones_like(z)
+    assert float(o.sum().asscalar()) == 6.0
+    e = np.eye(3)
+    onp.testing.assert_allclose(e.asnumpy(), onp.eye(3))
+    ls = np.linspace(0, 1, 5)
+    onp.testing.assert_allclose(ls.asnumpy(), onp.linspace(0, 1, 5),
+                                rtol=1e-6)
+    ar = np.arange(6).reshape(2, 3)
+    assert ar.shape == (2, 3)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("sqrt", ([4.0, 9.0],)),
+    ("exp", ([0.0, 1.0],)),
+    ("tanh", ([0.5, -0.5],)),
+    ("floor", ([1.7, -1.2],)),
+    ("sign", ([-3.0, 2.0],)),
+])
+def test_unary_parity(name, args):
+    x = onp.asarray(args[0], onp.float32)
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_binary_and_reduction_parity():
+    rs = onp.random.RandomState(0)
+    a = rs.rand(3, 4).astype(onp.float32)
+    b = rs.rand(3, 4).astype(onp.float32)
+    na, nb = np.array(a), np.array(b)
+    onp.testing.assert_allclose(np.add(na, nb).asnumpy(), a + b,
+                                rtol=1e-6)
+    onp.testing.assert_allclose(np.maximum(na, nb).asnumpy(),
+                                onp.maximum(a, b))
+    onp.testing.assert_allclose(np.sum(na, axis=1).asnumpy(),
+                                a.sum(axis=1), rtol=1e-6)
+    onp.testing.assert_allclose(np.std(na).asnumpy(), a.std(),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.matmul(na, np.transpose(nb))
+                                .asnumpy(), a @ b.T, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,kj->ik", na, nb).asnumpy(), a @ b.T, rtol=1e-5)
+
+
+def test_shape_ops_parity():
+    a = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    na = np.array(a)
+    onp.testing.assert_allclose(
+        np.concatenate([na, na], axis=0).asnumpy(),
+        onp.concatenate([a, a], axis=0))
+    onp.testing.assert_allclose(np.stack([na, na], axis=1).asnumpy(),
+                                onp.stack([a, a], axis=1))
+    parts = np.split(na, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    one = np.split(na, 1, axis=0)
+    assert len(one) == 1 and one[0].shape == (3, 4)
+    g1 = np.meshgrid(np.arange(4))
+    assert len(g1) == 1 and g1[0].shape == (4,)
+    onp.testing.assert_allclose(np.where(na > 5, na, np.zeros(
+        (3, 4))).asnumpy(), onp.where(a > 5, a, 0))
+    g = np.meshgrid(np.arange(2), np.arange(3))
+    assert g[0].shape == (3, 2)
+
+
+def test_unique_host_fallback():
+    x = np.array(onp.asarray([3, 1, 2, 1, 3], onp.int32))
+    u = np.unique(x)
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+    u, c = np.unique(x, return_counts=True)
+    onp.testing.assert_array_equal(c.asnumpy(), [2, 1, 2])
+
+
+def test_autograd_through_np_ops():
+    x = np.array(onp.asarray([1.0, 2.0, 3.0], onp.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.square(x) * 2.0)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0, 8.0, 12.0],
+                                rtol=1e-6)
+
+
+def test_npx_primitives_and_npz(tmp_path):
+    x = np.array(onp.asarray([[1.0, 2.0], [3.0, 4.0]], onp.float32))
+    sm = npx.softmax(x, axis=-1).asnumpy()
+    onp.testing.assert_allclose(sm.sum(axis=-1), [1.0, 1.0], rtol=1e-6)
+    oh = npx.one_hot(np.array(onp.asarray([0, 1], onp.int32)), 3)
+    assert oh.shape == (2, 3)
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"a": x})
+    back = npx.load(f)
+    onp.testing.assert_allclose(back["a"].asnumpy(), x.asnumpy())
+    f2 = str(tmp_path / "arrs_list.npz")
+    npx.save(f2, [x, x * 2])
+    back2 = npx.load(f2)
+    assert isinstance(back2, list) and len(back2) == 2
+    onp.testing.assert_allclose(back2[1].asnumpy(), (x * 2).asnumpy())
+    # mx.np.random re-export (reference: np.random.uniform)
+    r = np.random.uniform(0, 1, shape=(2, 2))
+    assert r.shape == (2, 2)
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
