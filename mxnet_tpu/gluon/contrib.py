@@ -98,3 +98,8 @@ class SyncBatchNorm(_BatchNorm):
 
     def __init__(self, in_channels=0, num_devices=None, **kwargs):
         super().__init__(in_channels=in_channels, **kwargs)
+
+
+# estimator facade (reference: gluon/contrib/estimator/) — imported as
+# a submodule-style attribute: gluon.contrib.estimator.Estimator
+from . import estimator  # noqa: E402,F401

@@ -1,0 +1,260 @@
+"""gluon.contrib.estimator — the fit-loop facade of MXNet 1.6+
+(reference: python/mxnet/gluon/contrib/estimator/estimator.py +
+event_handler.py). Estimator wraps net/loss/trainer/metrics into
+`fit(train_data, val_data, epochs)` with an event-handler pipeline
+(train begin/end, epoch begin/end, batch begin/end).
+
+TPU-first detail: the inner step is the standard record/backward/step
+triple over NDArrays — with a hybridized net every batch shape hits the
+per-shape jit cache, so the fit loop dispatches one compiled executable
+per batch like the reference's CachedOp path.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from .. import autograd, metric as _metric
+from .trainer import Trainer
+
+__all__ = ["Estimator", "EventHandler", "TrainBegin", "TrainEnd",
+           "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
+           "StoppingHandler", "MetricHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler"]
+
+
+class EventHandler:
+    """Mixin base; concrete handlers override any subset of hooks."""
+
+    def train_begin(self, estimator):
+        pass
+
+    def train_end(self, estimator):
+        pass
+
+    def epoch_begin(self, estimator):
+        pass
+
+    def epoch_end(self, estimator):
+        pass
+
+    def batch_begin(self, estimator):
+        pass
+
+    def batch_end(self, estimator):
+        pass
+
+
+# reference exposes these as separate marker bases; alias for parity
+TrainBegin = TrainEnd = EpochBegin = EpochEnd = EventHandler
+BatchBegin = BatchEnd = EventHandler
+
+
+class StoppingHandler(EventHandler):
+    """Stop after max_epoch epochs or max_batch total batches."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+
+    def batch_end(self, estimator):
+        if self.max_batch and estimator.global_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator):
+        if self.max_epoch and estimator.epoch + 1 >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EventHandler):
+    """Resets train metrics at epoch begin, updates them at batch end
+    (installed automatically by Estimator)."""
+
+    def epoch_begin(self, estimator):
+        for m in estimator.train_metrics:
+            m.reset()
+
+    def batch_end(self, estimator):
+        preds, labels = estimator._last_pred, estimator._last_label
+        if preds is None:
+            return
+        for m in estimator.train_metrics:
+            m.update(labels, preds)
+
+
+class LoggingHandler(EventHandler):
+    """Per-epoch (and optional per-N-batch) metric logging."""
+
+    def __init__(self, log_interval=None, printer=print):
+        self.log_interval = log_interval
+        self._print = printer
+
+    def epoch_begin(self, estimator):
+        self._t0 = time.time()
+
+    def batch_end(self, estimator):
+        if self.log_interval and \
+                estimator.global_batch % self.log_interval == 0:
+            self._print(f"[epoch {estimator.epoch} batch "
+                        f"{estimator.global_batch}] "
+                        + self._fmt(estimator.train_metrics))
+
+    def epoch_end(self, estimator):
+        dt = time.time() - self._t0
+        msg = (f"[epoch {estimator.epoch}] time {dt:.1f}s "
+               + self._fmt(estimator.train_metrics))
+        if estimator.val_metrics:
+            msg += " " + self._fmt(estimator.val_metrics)
+        self._print(msg)
+
+    @staticmethod
+    def _fmt(metrics):
+        parts = []
+        for m in metrics:
+            name, val = m.get()
+            parts.append(f"{name}={val:.4f}"
+                         if isinstance(val, float) else f"{name}={val}")
+        return " ".join(parts)
+
+
+class CheckpointHandler(EventHandler):
+    """Save parameters every epoch; optionally keep the best by a
+    monitored metric."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False):
+        import os
+
+        self.dir = model_dir
+        self.prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self._better = ((lambda a, b: a < b) if mode == "min"
+                        else (lambda a, b: a > b))
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator):
+        import os
+
+        path = os.path.join(
+            self.dir, f"{self.prefix}-epoch{estimator.epoch}.params")
+        estimator.net.save_parameters(path)
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            if isinstance(val, float) and self._better(val, self.best):
+                self.best = val
+                estimator.net.save_parameters(
+                    os.path.join(self.dir, f"{self.prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EventHandler):
+    """Stop when the monitored metric stops improving."""
+
+    def __init__(self, monitor, mode="min", patience=2, min_delta=0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self._better = ((lambda a, b: a < b - min_delta)
+                        if mode == "min"
+                        else (lambda a, b: a > b + min_delta))
+        self.wait = 0
+
+    def epoch_end(self, estimator):
+        _, val = self.monitor.get()
+        if not isinstance(val, float):
+            return
+        if self._better(val, self.best):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                estimator.stop_training = True
+
+
+class Estimator:
+    """fit()-style training facade (reference:
+    gluon/contrib/estimator/estimator.py).
+
+    net: a (Hybrid)Block; loss: a gluon Loss; trainer: gluon.Trainer
+    (built from `optimizer`/`optimizer_params` if omitted);
+    train_metrics: list of mx.metric.EvalMetric.
+    """
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 optimizer="sgd", optimizer_params=None,
+                 val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = (list(train_metrics)
+                              if train_metrics else [_metric.Accuracy()])
+        self.val_metrics = list(val_metrics) if val_metrics else []
+        self.trainer = trainer or Trainer(
+            net.collect_params(), optimizer, optimizer_params
+            or {"learning_rate": 0.01})
+        self.stop_training = False
+        self.epoch = 0
+        self.global_batch = 0
+        self._last_pred = None
+        self._last_label = None
+
+    def _fire(self, handlers, hook):
+        for h in handlers:
+            getattr(h, hook)(self)
+
+    def evaluate(self, val_data, metrics=None):
+        """Run validation: updates `metrics` (default self.val_metrics)."""
+        metrics = metrics if metrics is not None else self.val_metrics
+        for m in metrics:
+            m.reset()
+        with autograd.predict_mode():
+            for x, y in val_data:
+                pred = self.net(x)
+                for m in metrics:
+                    m.update(y, pred)
+        return [m.get() for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1,
+            event_handlers: Optional[Sequence[EventHandler]] = None,
+            batches=None):
+        import copy
+        import itertools
+
+        handlers: List[EventHandler] = [MetricHandler()]
+        handlers += list(event_handlers or [])
+        if batches is not None or epochs is not None:
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if val_data is not None and not self.val_metrics:
+            # reference behavior: derive validation metrics from the
+            # train metrics rather than silently skipping validation
+            self.val_metrics = [copy.deepcopy(m)
+                                for m in self.train_metrics]
+        self.stop_training = False
+        self.global_batch = 0  # per-fit counter (StoppingHandler limit)
+        self._fire(handlers, "train_begin")
+        epoch_iter = (range(epochs) if epochs is not None
+                      else itertools.count())
+        for self.epoch in epoch_iter:
+            if self.stop_training:
+                break
+            self._fire(handlers, "epoch_begin")
+            for x, y in train_data:
+                if self.stop_training:
+                    break
+                self._fire(handlers, "batch_begin")
+                with autograd.record():
+                    pred = self.net(x)
+                    l = self.loss(pred, y).mean()
+                l.backward()
+                self.trainer.step(x.shape[0])
+                self._last_pred, self._last_label = pred, y
+                self.global_batch += 1
+                self._fire(handlers, "batch_end")
+            if val_data is not None and self.val_metrics:
+                self.evaluate(val_data)
+            self._fire(handlers, "epoch_end")
+        self._fire(handlers, "train_end")
+        return self

@@ -18,7 +18,60 @@ import jax.numpy as jnp
 
 from .ndarray import NDArray, invoke
 from . import ndarray as _ndmod
-from . import random  # noqa: F401  (mx.np.random.uniform(...) etc.)
+from . import random as _mx_random
+
+
+class _NpRandom:
+    """mx.np.random — numpy's `size=` convention over the framework
+    samplers (reference: python/mxnet/numpy/random.py)."""
+
+    seed = staticmethod(_mx_random.seed)
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None):
+        return _mx_random.uniform(low, high, shape=size, dtype=dtype,
+                                  ctx=ctx)
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None):
+        return _mx_random.normal(loc, scale, shape=size, dtype=dtype,
+                                 ctx=ctx)
+
+    @staticmethod
+    def randint(low, high=None, size=None, dtype="int32", ctx=None):
+        if high is None:
+            low, high = 0, low
+        return _mx_random.randint(low, high, shape=size, dtype=dtype,
+                                  ctx=ctx)
+
+    @staticmethod
+    def rand(*shape):
+        return _mx_random.uniform(0.0, 1.0, shape=shape or None)
+
+    @staticmethod
+    def randn(*shape):
+        return _mx_random.normal(0.0, 1.0, shape=shape or None)
+
+    @staticmethod
+    def exponential(scale=1.0, size=None):
+        return _mx_random.exponential(1.0 / scale, shape=size)
+
+    @staticmethod
+    def gamma(shape=1.0, scale=1.0, size=None):
+        # numpy names the concentration param `shape`
+        return _mx_random.gamma(alpha=shape, beta=scale, shape=size)
+
+    @staticmethod
+    def shuffle(x):
+        return _mx_random.shuffle(x)
+
+    @staticmethod
+    def multinomial(n=None, pvals=None, size=None, data=None, **kw):
+        src = data if data is not None else pvals
+        return _mx_random.multinomial(src, shape=size, **kw)
+
+
+random = _NpRandom()
 
 pi = _onp.pi
 e = _onp.e
@@ -40,13 +93,22 @@ bool_ = "bool"
 
 
 def _wrap(fn, name=None):
-    """numpy-named op over NDArray/scalar args: kwargs pass through to
-    the jnp function, NDArray positions join the autograd tape."""
+    """numpy-named op over NDArray/scalar args. NDArray operands —
+    positional AND keyword — route through invoke so they join the
+    autograd tape; non-array kwargs pass straight to the jnp fn."""
     @functools.wraps(fn)
     def f(*args, **kwargs):
+        kw_names = [k for k, v in kwargs.items()
+                    if isinstance(v, NDArray)]
+        static_kw = {k: v for k, v in kwargs.items()
+                     if k not in kw_names}
+        n_pos = len(args)
+
         def g(*raw):
-            return fn(*raw, **kwargs)
-        return invoke(g, list(args))
+            kws = dict(zip(kw_names, raw[n_pos:]))
+            return fn(*raw[:n_pos], **kws, **static_kw)
+
+        return invoke(g, list(args) + [kwargs[k] for k in kw_names])
     if name:
         f.__name__ = name
     return f
@@ -114,16 +176,21 @@ def hstack(seq):
     return invoke(lambda *raw: jnp.hstack(raw), list(seq))
 
 
+def _invoke_seq(g, operands, n):
+    """invoke() for tuple-returning fns: n_out=1 would wrap the
+    1-tuple itself, so unwrap that case here (shared by every
+    variadic-output op)."""
+    if n == 1:
+        return [invoke(lambda *raw: g(*raw)[0], operands)]
+    return list(invoke(g, operands, n_out=n))
+
+
 def split(ary, indices_or_sections, axis=0):
     n = (indices_or_sections if isinstance(indices_or_sections, int)
          else len(indices_or_sections) + 1)
-    if n == 1:  # n_out=1 would wrap the 1-tuple itself
-        return [invoke(lambda raw: jnp.split(
-            raw, indices_or_sections, axis=axis)[0], [ary])]
-    return list(invoke(
+    return _invoke_seq(
         lambda raw: tuple(jnp.split(raw, indices_or_sections,
-                                    axis=axis)),
-        [ary], n_out=n))
+                                    axis=axis)), [ary], n)
 
 
 # -- creation ---------------------------------------------------------------
@@ -165,13 +232,9 @@ def identity(n, dtype="float32", ctx=None):
 
 
 def meshgrid(*xs, indexing="xy"):
-    n = len(xs)
-    if n == 1:  # n_out=1 would wrap the 1-tuple itself
-        return [invoke(lambda raw: jnp.meshgrid(
-            raw, indexing=indexing)[0], [xs[0]])]
-    return list(invoke(
+    return _invoke_seq(
         lambda *raw: tuple(jnp.meshgrid(*raw, indexing=indexing)),
-        list(xs), n_out=n))
+        list(xs), len(xs))
 
 
 # -- host-side (data-dependent output shapes) -------------------------------

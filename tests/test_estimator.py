@@ -1,0 +1,108 @@
+"""gluon.contrib.estimator fit-loop facade (reference:
+python/mxnet/gluon/contrib/estimator/): fit trains, handlers fire in
+order, early stopping and checkpointing work."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib import estimator as est
+
+
+def _data(n=64, d=8, classes=3, batch=16, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, classes).astype(np.float32)
+    X = rs.randn(n, d).astype(np.float32)
+    y = (X @ w).argmax(axis=1).astype(np.float32)
+    batches = [(mx.nd.array(X[i:i + batch]), mx.nd.array(y[i:i + batch]))
+               for i in range(0, n, batch)]
+    return batches
+
+
+def _net(classes=3):
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(classes))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_fit_trains_and_metrics_update():
+    data = _data()
+    net = _net()
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                      optimizer="adam",
+                      optimizer_params={"learning_rate": 0.01})
+    e.fit(data, epochs=5)
+    name, acc = e.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.5
+    assert e.global_batch == 5 * len(data)
+
+
+def test_handler_order_and_stopping():
+    data = _data()
+    net = _net()
+    events = []
+
+    class Spy(est.EventHandler):
+        def train_begin(self, e): events.append("tb")
+        def epoch_begin(self, e): events.append("eb")
+        def batch_end(self, e): events.append("be")
+        def epoch_end(self, e): events.append("ee")
+        def train_end(self, e): events.append("te")
+
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    e.fit(data, epochs=3, event_handlers=[Spy()], batches=6)
+    # stopped after 6 batches: fewer than 3 full epochs of batch events
+    assert events[0] == "tb" and events[-1] == "te"
+    assert events.count("be") == 6
+    assert e.global_batch == 6
+
+
+def test_early_stopping_and_checkpoint(tmp_path):
+    data = _data()
+    net = _net()
+    acc = mx.metric.Accuracy()
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                      train_metrics=[acc],
+                      optimizer="adam",
+                      optimizer_params={"learning_rate": 0.01})
+    ckpt = est.CheckpointHandler(str(tmp_path), monitor=acc,
+                                 mode="max", save_best=True)
+    early = est.EarlyStoppingHandler(monitor=acc, mode="max",
+                                     patience=2)
+    e.fit(data, epochs=4, event_handlers=[ckpt, early])
+    import os
+    files = os.listdir(tmp_path)
+    assert any(f.endswith("best.params") for f in files)
+    assert any("epoch0" in f for f in files)
+
+
+def test_batch_limited_fit_no_epochs():
+    data = _data()
+    net = _net()
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    e.fit(data, epochs=None, batches=5)
+    assert e.global_batch == 5
+    # second fit: per-fit batch counter resets
+    e.fit(data, epochs=None, batches=3)
+    assert e.global_batch == 3
+
+
+def test_val_metrics_derived_from_train():
+    data = _data()
+    net = _net()
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    assert not e.val_metrics
+    e.fit(data, val_data=data, epochs=1)
+    assert e.val_metrics and e.val_metrics[0].get()[0] == "accuracy"
+
+
+def test_evaluate():
+    data = _data()
+    net = _net()
+    e = est.Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                      val_metrics=[mx.metric.Accuracy()])
+    e.fit(data, val_data=data, epochs=2)
+    name, acc = e.val_metrics[0].get()
+    assert 0.0 <= acc <= 1.0

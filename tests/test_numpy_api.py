@@ -110,9 +110,21 @@ def test_npx_primitives_and_npz(tmp_path):
     back2 = npx.load(f2)
     assert isinstance(back2, list) and len(back2) == 2
     onp.testing.assert_allclose(back2[1].asnumpy(), (x * 2).asnumpy())
-    # mx.np.random re-export (reference: np.random.uniform)
-    r = np.random.uniform(0, 1, shape=(2, 2))
+    # mx.np.random uses numpy's size= convention
+    r = np.random.uniform(0, 1, size=(2, 2))
     assert r.shape == (2, 2)
+    assert np.random.randn(3, 2).shape == (3, 2)
+    assert np.random.randint(5, size=(4,)).shape == (4,)
+
+
+def test_ndarray_kwarg_unwrapped():
+    # an NDArray passed by KEYWORD (jnp operand kwargs are rare but
+    # real, e.g. take's indices=) must be unwrapped through invoke,
+    # not handed to jnp raw
+    x = np.array(onp.asarray([[1.0, 2.0], [3.0, 4.0]], onp.float32))
+    idx = np.array(onp.asarray([1, 0], onp.int32))
+    got = np.take(x, indices=idx, axis=1)
+    onp.testing.assert_allclose(got.asnumpy(), [[2, 1], [4, 3]])
     npx.set_np()
     assert npx.is_np_array()
     npx.reset_np()
