@@ -1,0 +1,106 @@
+"""Seeded op-parity sweep (SURVEY §4: "numeric parity vs numpy, dtype
+sweeps, broadcasting cases, gradient checks via finite differences").
+Complements the targeted per-op tests with breadth: many ops x dtypes x
+broadcast shapes in one parametrized pass."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_UNARY = {
+    "exp": onp.exp, "log": onp.log, "sqrt": onp.sqrt, "abs": onp.abs,
+    "sign": onp.sign, "floor": onp.floor, "ceil": onp.ceil,
+    "tanh": onp.tanh, "square": onp.square,
+    "sigmoid": lambda x: 1 / (1 + onp.exp(-x)),
+    "relu": lambda x: onp.maximum(x, 0),
+}
+_PRE = {"log": lambda x: onp.abs(x) + 0.5,
+        "sqrt": lambda x: onp.abs(x)}
+
+
+@pytest.mark.parametrize("name", sorted(_UNARY))
+@pytest.mark.parametrize("shape", [(7,), (3, 5), (2, 3, 4)])
+def test_unary_sweep(name, shape):
+    rs = onp.random.RandomState(hash((name, shape)) % 2 ** 31)
+    x = (rs.randn(*shape) * 2).astype(onp.float32)
+    x = _PRE.get(name, lambda v: v)(x)
+    got = getattr(nd, name)(mx.nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, _UNARY[name](x).astype(onp.float32),
+                                rtol=2e-5, atol=2e-5)
+
+
+_BINARY = {
+    "add": onp.add, "subtract": onp.subtract, "multiply": onp.multiply,
+    "maximum": onp.maximum, "minimum": onp.minimum,
+}
+
+
+@pytest.mark.parametrize("name", sorted(_BINARY))
+@pytest.mark.parametrize("sa,sb", [
+    ((3, 4), (3, 4)), ((3, 1), (1, 4)), ((2, 3, 4), (4,)),
+    ((5,), (1,)),
+])
+def test_binary_broadcast_sweep(name, sa, sb):
+    rs = onp.random.RandomState(hash((name, sa, sb)) % 2 ** 31)
+    a = rs.randn(*sa).astype(onp.float32)
+    b = rs.randn(*sb).astype(onp.float32)
+    got = getattr(nd, name)(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, _BINARY[name](a, b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "int32"])
+def test_dtype_sweep(dtype):
+    rs = onp.random.RandomState(3)
+    a = (rs.rand(4, 5) * 10).astype(dtype)
+    b = (rs.rand(4, 5) * 10 + 1).astype(dtype)
+    na, nb = mx.nd.array(a, dtype=dtype), mx.nd.array(b, dtype=dtype)
+    assert str(na.dtype) == dtype
+    s = (na + nb).asnumpy()
+    onp.testing.assert_allclose(s.astype(onp.float64),
+                                (a + b).astype(onp.float64), rtol=1e-2)
+    tot = (na * nb).sum().asnumpy()
+    onp.testing.assert_allclose(tot.astype(onp.float64),
+                                (a.astype(onp.float64)
+                                 * b.astype(onp.float64)).sum(),
+                                rtol=2e-2)
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "max", "min", "prod"])
+@pytest.mark.parametrize("axis,keepdims", [
+    (None, False), (0, False), (1, True), ((0, 2), False),
+])
+def test_reduce_sweep(name, axis, keepdims):
+    rs = onp.random.RandomState(hash((name, str(axis))) % 2 ** 31)
+    x = (rs.rand(2, 3, 4).astype(onp.float32) + 0.5)
+    got = getattr(nd, name)(mx.nd.array(x), axis=axis,
+                            keepdims=keepdims).asnumpy()
+    want = getattr(onp, name if name != "mean" else "mean")(
+        x, axis=axis, keepdims=keepdims)
+    onp.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+@pytest.mark.parametrize("name", ["exp", "tanh", "square", "sigmoid"])
+def test_grad_finite_difference(name):
+    """Central-difference gradient check on a scalar objective."""
+    rs = onp.random.RandomState(hash(name) % 2 ** 31)
+    x0 = rs.randn(6).astype(onp.float64).astype(onp.float32) * 0.5
+    fn = getattr(nd, name)
+
+    def f(v):
+        return float(fn(mx.nd.array(v)).sum().asscalar())
+
+    x = mx.nd.array(x0)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = fn(x).sum()
+    y.backward()
+    got = x.grad.asnumpy()
+
+    eps = 1e-3
+    fd = onp.zeros_like(x0)
+    for i in range(x0.size):
+        hi = x0.copy(); hi[i] += eps
+        lo = x0.copy(); lo[i] -= eps
+        fd[i] = (f(hi) - f(lo)) / (2 * eps)
+    onp.testing.assert_allclose(got, fd, rtol=2e-2, atol=2e-3)
