@@ -209,12 +209,14 @@ def build_decoder(net, max_len: int):
 
 
 def generate(net, prompt_ids, max_new_tokens: int, temperature=0.0,
-             top_k: int = 0, seed: int = 0,
+             top_k: int = 0, top_p: float = 0.0, seed: int = 0,
              max_len: Optional[int] = None):
     """Autoregressive generation. prompt_ids: (B, T) NDArray/array of
     int32 (right-pad shorter rows with any token and pass
     `valid_len`-style ragged prompts as equal lengths for now).
-    temperature 0 = greedy. Returns (B, T + max_new_tokens) numpy."""
+    temperature 0 = greedy; top_k keeps the k best logits; top_p keeps
+    the smallest nucleus whose probability mass reaches p (both compose
+    with temperature). Returns (B, T + max_new_tokens) numpy."""
     ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
         else jnp.asarray(prompt_ids)
     ids = ids.astype(jnp.int32)
@@ -233,6 +235,19 @@ def generate(net, prompt_ids, max_new_tokens: int, temperature=0.0,
         if top_k:
             kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
             lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if top_p and 0.0 < top_p < 1.0:
+            # nucleus: drop tokens outside the smallest prefix (by
+            # descending prob) whose cumulative mass reaches top_p;
+            # the top token always survives
+            sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_lg, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p        # prefix mass < p
+            # threshold logit = smallest kept logit per row
+            thresh = jnp.min(
+                jnp.where(keep_sorted, sorted_lg, jnp.inf),
+                axis=-1, keepdims=True)
+            lg = jnp.where(lg < thresh, -jnp.inf, lg)
         return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
     key = jax.random.PRNGKey(seed)

@@ -45,19 +45,39 @@ def _send_msg(sock, obj):
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact_into(sock, view):
+    """Fill `view` completely from the socket (short-read loop)."""
+    n = len(view)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        got += r
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
 
 
 def _recv_msg(sock):
+    """Length-prefixed pickle. The payload stages through the pooled
+    host arena (runtime/arena.py — MXNet storage-manager analogue):
+    recv_into a pooled buffer, deserialize, release. pickle.loads
+    copies everything it needs, so the buffer is reusable immediately
+    — steady-state gradient traffic allocates nothing per message."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    from .runtime.arena import default_arena
+
+    ar = default_arena()
+    buf = ar.alloc_ndarray(n)
+    try:
+        _recv_exact_into(sock, memoryview(buf)[:n])
+        return pickle.loads(memoryview(buf)[:n])
+    finally:
+        ar.release(buf)
 
 
 class PSServer:

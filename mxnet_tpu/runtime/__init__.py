@@ -5,3 +5,4 @@ framework works before `python -m mxnet_tpu.runtime.build` compiles them.
 """
 from . import recordio  # noqa: F401
 from . import engine  # noqa: F401
+from . import arena  # noqa: F401

@@ -1,0 +1,183 @@
+"""Pooled host arena allocator (reference analogue: MXNet's storage
+manager, src/storage/pooled_storage_manager.h). Size-class free lists
+in C++ (cc/arena.cc, ctypes-bound) make repeated same-size staging
+buffers — RecordIO batch assembly, DataLoader scratch — effectively
+free after the first allocation. Pure-Python fallback keeps the API
+available before the native build.
+
+    from mxnet_tpu.runtime.arena import Arena
+    a = Arena()
+    buf = a.alloc_ndarray(1 << 20, dtype="uint8")  # pooled numpy view
+    a.release(buf)                                  # back to the pool
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from . import build as _build
+
+_LIB = None
+_LIB_TRIED = False
+_LOCK = threading.Lock()
+
+
+def _lib():
+    global _LIB, _LIB_TRIED
+    with _LOCK:
+        if _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        # never trigger a synchronous g++ compile from an allocation
+        # path — use the native lib only if it is already built (the
+        # engine/recordio lazy builds, the runtime tests, or an
+        # explicit `python -m mxnet_tpu.runtime.build` produce it)
+        so = _build.build(build_if_missing=False)
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.mxa_create.restype = ctypes.c_void_p
+            lib.mxa_alloc.restype = ctypes.c_void_p
+            lib.mxa_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.mxa_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64]
+            lib.mxa_destroy.argtypes = [ctypes.c_void_p]
+            lib.mxa_trim.argtypes = [ctypes.c_void_p]
+            lib.mxa_set_cap.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.mxa_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64 * 4)]
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+class Arena:
+    """Thread-safe pooled allocator; hands out aligned numpy views."""
+
+    def __init__(self, cap_bytes: Optional[int] = None,
+                 force_python: bool = False):
+        self._lib = None if force_python else _lib()
+        self._native = None
+        self._py_pool = {}          # size-class -> [ndarray]
+        self._py_lock = threading.Lock()
+        self._py_stats = [0, 0, 0, 0]
+        self._cap = cap_bytes if cap_bytes is not None else (1 << 31)
+        if self._lib is not None:
+            self._native = ctypes.c_void_p(self._lib.mxa_create())
+            if cap_bytes is not None:
+                self._lib.mxa_set_cap(self._native, cap_bytes)
+        #: ndarray id -> (pointer|raw, nbytes, weakref) — the weakref
+        #: callback auto-returns a buffer its caller dropped without
+        #: release() (and guarantees no stale-id collisions: an entry
+        #: dies with its array)
+        self._live = {}
+
+    @property
+    def native(self) -> bool:
+        return self._native is not None
+
+    # -- allocation --------------------------------------------------------
+    def alloc_ndarray(self, nbytes: int, dtype="uint8") -> np.ndarray:
+        """A 1-D numpy array of `nbytes` bytes viewed as `dtype`,
+        backed by pooled storage. Release with `release()`."""
+        dt = np.dtype(dtype)
+        n_el = nbytes // dt.itemsize
+        if self._native is not None:
+            ptr = self._lib.mxa_alloc(self._native,
+                                      ctypes.c_uint64(nbytes))
+            if ptr:
+                buf = (ctypes.c_char * nbytes).from_address(ptr)
+                arr = np.frombuffer(buf, dtype=dt, count=n_el).view()
+                self._register(arr, ptr, nbytes)
+                return arr
+        # python fallback: size-class pooled ndarrays
+        cls = 1 << max(8, (nbytes - 1).bit_length())
+        with self._py_lock:
+            self._py_stats[2] += 1
+            lst = self._py_pool.get(cls)
+            if lst:
+                raw = lst.pop()
+                self._py_stats[1] -= cls
+                self._py_stats[3] += 1
+            else:
+                raw = np.empty(cls, np.uint8)
+            self._py_stats[0] += cls
+        arr = raw[:n_el * dt.itemsize].view(dt)
+        self._register(arr, raw, nbytes)
+        return arr
+
+    def _register(self, arr, handle, nbytes):
+        key = id(arr)
+
+        def _auto(_ref, key=key):
+            rec = self._live.pop(key, None)
+            if rec is not None:
+                self._return(rec[0], rec[1])
+
+        self._live[key] = (handle, nbytes, weakref.ref(arr, _auto))
+
+    def release(self, arr: np.ndarray):
+        """Return a buffer from alloc_ndarray to the pool (dropping the
+        array without calling this also returns it, at gc time)."""
+        rec = self._live.pop(id(arr), None)
+        if rec is None:
+            return
+        self._return(rec[0], rec[1])
+
+    def _return(self, handle, nbytes):
+        if self._native is not None and isinstance(handle, int):
+            self._lib.mxa_free(self._native, ctypes.c_void_p(handle),
+                               ctypes.c_uint64(nbytes))
+            return
+        raw = handle
+        cls = raw.nbytes
+        with self._py_lock:
+            self._py_stats[0] -= cls
+            if self._py_stats[1] + cls <= self._cap:
+                self._py_pool.setdefault(cls, []).append(raw)
+                self._py_stats[1] += cls
+
+    # -- maintenance -------------------------------------------------------
+    def trim(self):
+        if self._native is not None:
+            self._lib.mxa_trim(self._native)
+        with self._py_lock:
+            self._py_pool.clear()
+            self._py_stats[1] = 0
+
+    def stats(self) -> dict:
+        """{live, pooled, total_allocs, pool_hits} in bytes/counts."""
+        if self._native is not None:
+            out = (ctypes.c_int64 * 4)()
+            self._lib.mxa_stats(self._native, ctypes.byref(out))
+            return {"live": out[0], "pooled": out[1],
+                    "total_allocs": out[2], "pool_hits": out[3]}
+        with self._py_lock:
+            s = list(self._py_stats)
+        return {"live": s[0], "pooled": s[1], "total_allocs": s[2],
+                "pool_hits": s[3]}
+
+    def __del__(self):
+        try:
+            if self._native is not None:
+                self._lib.mxa_destroy(self._native)
+                self._native = None
+        except Exception:
+            pass
+
+
+#: process-wide default arena (RecordIO batch staging uses this)
+_default = None
+
+
+def default_arena() -> Arena:
+    global _default
+    if _default is None:
+        _default = Arena()
+    return _default

@@ -11,16 +11,22 @@ import tempfile
 
 _CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
 _SO = os.path.join(_CC_DIR, "libmxtpu_runtime.so")
-_SRCS = ["engine.cc", "recordio.cc"]
+_SRCS = ["engine.cc", "recordio.cc", "arena.cc"]
 
 
-def build(force: bool = False, quiet: bool = True) -> str | None:
-    """Compile (if needed) and return the .so path, or None on failure."""
+def build(force: bool = False, quiet: bool = True,
+          build_if_missing: bool = True) -> str | None:
+    """Compile (if needed) and return the .so path, or None on failure.
+    build_if_missing=False never invokes the compiler — callers on a
+    latency-sensitive path (e.g. the PS message loop) use it to pick up
+    an already-built library without risking a synchronous g++ run."""
     if os.path.exists(_SO) and not force:
         srcs_mtime = max(os.path.getmtime(os.path.join(_CC_DIR, s))
                          for s in _SRCS)
         if os.path.getmtime(_SO) >= srcs_mtime:
             return _SO
+    if not build_if_missing:
+        return None
     try:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CC_DIR)
         os.close(fd)

@@ -1,0 +1,143 @@
+// Pooled host arena allocator (reference analogue: MXNet's storage
+// manager, src/storage/pooled_storage_manager.h — the GPU/CPU memory
+// pool that makes repeated same-size allocations free). Host-side role
+// here: staging buffers for RecordIO batches and DataLoader assembly,
+// where per-batch malloc/free of multi-MB buffers costs more than the
+// copy itself.
+//
+// Design: size-class free lists (powers of two >= 256 B), thread-safe
+// via one mutex per class, 64-byte alignment (cache line; also the
+// alignment dmlc/recordio buffers want). Oversize requests fall through
+// to aligned malloc and are freed eagerly. Stats are exact and cheap.
+//
+// C ABI (ctypes): every function prefixed mxa_.
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr int kMinShift = 8;    // 256 B smallest class
+constexpr int kMaxShift = 30;   // 1 GiB largest pooled class
+constexpr int kClasses = kMaxShift - kMinShift + 1;
+constexpr size_t kAlign = 64;
+
+struct Class {
+  std::mutex mu;
+  std::vector<void*> free_list;
+};
+
+struct Arena {
+  Class cls[kClasses];
+  std::atomic<int64_t> live{0};        // outstanding bytes (user view)
+  std::atomic<int64_t> pooled{0};      // bytes parked in free lists
+  std::atomic<int64_t> total_allocs{0};
+  std::atomic<int64_t> pool_hits{0};
+  std::atomic<int64_t> cap_bytes{int64_t(1) << 31};  // 2 GiB default
+
+  ~Arena() { trim(); }
+
+  static int class_of(size_t n) {
+    size_t c = size_t(1) << kMinShift;
+    int idx = 0;
+    while (c < n) { c <<= 1; ++idx; }
+    return idx >= kClasses ? -1 : idx;
+  }
+
+  static size_t class_bytes(int idx) {
+    return size_t(1) << (kMinShift + idx);
+  }
+
+  void* alloc(size_t n) {
+    if (n == 0) n = 1;
+    total_allocs.fetch_add(1, std::memory_order_relaxed);
+    int idx = class_of(n);
+    void* p = nullptr;
+    if (idx >= 0) {
+      Class& c = cls[idx];
+      std::lock_guard<std::mutex> g(c.mu);
+      if (!c.free_list.empty()) {
+        p = c.free_list.back();
+        c.free_list.pop_back();
+        pooled.fetch_sub(int64_t(class_bytes(idx)),
+                         std::memory_order_relaxed);
+        pool_hits.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    if (p == nullptr) {
+      size_t want = idx >= 0 ? class_bytes(idx) : n;
+      size_t padded = (want + kAlign - 1) / kAlign * kAlign;
+      if (posix_memalign(&p, kAlign, padded) != 0) return nullptr;
+    }
+    live.fetch_add(int64_t(idx >= 0 ? class_bytes(idx) : n),
+                   std::memory_order_relaxed);
+    return p;
+  }
+
+  void free(void* p, size_t n) {
+    if (p == nullptr) return;
+    int idx = class_of(n == 0 ? 1 : n);
+    live.fetch_sub(int64_t(idx >= 0 ? class_bytes(idx) : n),
+                   std::memory_order_relaxed);
+    if (idx < 0) { ::free(p); return; }
+    int64_t limit = cap_bytes.load(std::memory_order_relaxed);
+    if (pooled.load(std::memory_order_relaxed)
+        + int64_t(class_bytes(idx)) > limit) {
+      ::free(p);  // pool full: release to the OS
+      return;
+    }
+    Class& c = cls[idx];
+    std::lock_guard<std::mutex> g(c.mu);
+    c.free_list.push_back(p);
+    pooled.fetch_add(int64_t(class_bytes(idx)),
+                     std::memory_order_relaxed);
+  }
+
+  void trim() {
+    for (int i = 0; i < kClasses; ++i) {
+      Class& c = cls[i];
+      std::lock_guard<std::mutex> g(c.mu);
+      for (void* p : c.free_list) ::free(p);
+      pooled.fetch_sub(int64_t(c.free_list.size() * class_bytes(i)),
+                       std::memory_order_relaxed);
+      c.free_list.clear();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxa_create() { return new (std::nothrow) Arena(); }
+
+void mxa_destroy(void* a) { delete static_cast<Arena*>(a); }
+
+void* mxa_alloc(void* a, uint64_t n) {
+  return static_cast<Arena*>(a)->alloc(size_t(n));
+}
+
+void mxa_free(void* a, void* p, uint64_t n) {
+  static_cast<Arena*>(a)->free(p, size_t(n));
+}
+
+void mxa_trim(void* a) { static_cast<Arena*>(a)->trim(); }
+
+void mxa_set_cap(void* a, int64_t bytes) {
+  static_cast<Arena*>(a)->cap_bytes.store(bytes);
+}
+
+// stats: [live, pooled, total_allocs, pool_hits]
+void mxa_stats(void* a, int64_t* out4) {
+  Arena* ar = static_cast<Arena*>(a);
+  out4[0] = ar->live.load();
+  out4[1] = ar->pooled.load();
+  out4[2] = ar->total_allocs.load();
+  out4[3] = ar->pool_hits.load();
+}
+
+}  // extern "C"

@@ -68,3 +68,17 @@ def test_generate_sampling_valid_tokens(net):
                    top_k=10, seed=7)
     assert out.shape == (1, 9)
     assert (out >= 0).all() and (out < 256).all()
+
+
+def test_generate_top_p_nucleus(net):
+    rs = np.random.RandomState(4)
+    prompt = rs.randint(0, 256, (2, 4)).astype(np.int32)
+    out = generate(net, prompt, max_new_tokens=5, temperature=1.0,
+                   top_p=0.9, seed=11)
+    assert out.shape == (2, 9)
+    assert (out >= 0).all() and (out < 256).all()
+    # a tiny nucleus (p -> 0) collapses to greedy
+    greedy = generate(net, prompt, max_new_tokens=5, temperature=0.0)
+    near_greedy = generate(net, prompt, max_new_tokens=5,
+                           temperature=1.0, top_p=1e-6, seed=3)
+    np.testing.assert_array_equal(greedy, near_greedy)

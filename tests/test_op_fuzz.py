@@ -2,8 +2,16 @@
 sweeps, broadcasting cases, gradient checks via finite differences").
 Complements the targeted per-op tests with breadth: many ops x dtypes x
 broadcast shapes in one parametrized pass."""
+import zlib
+
 import numpy as onp
 import pytest
+
+
+def _seed(*parts):
+    """Stable across interpreter runs (hash() is PYTHONHASHSEED-salted,
+    which would make 'seeded' failures unreproducible)."""
+    return zlib.crc32(repr(parts).encode()) % 2 ** 31
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -22,7 +30,7 @@ _PRE = {"log": lambda x: onp.abs(x) + 0.5,
 @pytest.mark.parametrize("name", sorted(_UNARY))
 @pytest.mark.parametrize("shape", [(7,), (3, 5), (2, 3, 4)])
 def test_unary_sweep(name, shape):
-    rs = onp.random.RandomState(hash((name, shape)) % 2 ** 31)
+    rs = onp.random.RandomState(_seed(name, shape))
     x = (rs.randn(*shape) * 2).astype(onp.float32)
     x = _PRE.get(name, lambda v: v)(x)
     got = getattr(nd, name)(mx.nd.array(x)).asnumpy()
@@ -42,7 +50,7 @@ _BINARY = {
     ((5,), (1,)),
 ])
 def test_binary_broadcast_sweep(name, sa, sb):
-    rs = onp.random.RandomState(hash((name, sa, sb)) % 2 ** 31)
+    rs = onp.random.RandomState(_seed(name, sa, sb))
     a = rs.randn(*sa).astype(onp.float32)
     b = rs.randn(*sb).astype(onp.float32)
     got = getattr(nd, name)(mx.nd.array(a), mx.nd.array(b)).asnumpy()
@@ -71,7 +79,7 @@ def test_dtype_sweep(dtype):
     (None, False), (0, False), (1, True), ((0, 2), False),
 ])
 def test_reduce_sweep(name, axis, keepdims):
-    rs = onp.random.RandomState(hash((name, str(axis))) % 2 ** 31)
+    rs = onp.random.RandomState(_seed(name, str(axis)))
     x = (rs.rand(2, 3, 4).astype(onp.float32) + 0.5)
     got = getattr(nd, name)(mx.nd.array(x), axis=axis,
                             keepdims=keepdims).asnumpy()
@@ -83,7 +91,7 @@ def test_reduce_sweep(name, axis, keepdims):
 @pytest.mark.parametrize("name", ["exp", "tanh", "square", "sigmoid"])
 def test_grad_finite_difference(name):
     """Central-difference gradient check on a scalar objective."""
-    rs = onp.random.RandomState(hash(name) % 2 ** 31)
+    rs = onp.random.RandomState(_seed(name))
     x0 = rs.randn(6).astype(onp.float64).astype(onp.float32) * 0.5
     fn = getattr(nd, name)
 
