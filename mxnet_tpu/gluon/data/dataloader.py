@@ -17,7 +17,63 @@ import numpy as _np
 from ...ndarray import NDArray, array
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "DevicePrefetcher", "default_batchify_fn"]
+
+
+class DevicePrefetcher:
+    """Double-buffered device feed (the pinned-memory prefetch
+    analogue): a background thread pulls batches ahead of the consumer
+    so host batchify + the host->device transfer of batch i+1 overlap
+    with the device compute of batch i. NDArray creation already
+    enqueues the transfer asynchronously; the prefetch thread's job is
+    to keep pulling so those transfers are in flight before the
+    training loop asks."""
+
+    def __init__(self, loader, depth: int = 2):
+        self._loader = loader
+        self._depth = max(1, depth)
+
+    def __len__(self):
+        return len(self._loader)  # loaders only; generators raise
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        _END = object()
+        stop = threading.Event()
+
+        def _put(item):
+            # bounded put that aborts when the consumer went away, so
+            # an early `break` in the training loop cannot leak a
+            # thread blocked forever on a full queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self._loader:
+                    if not _put(item):
+                        return
+                _put(_END)
+            except Exception as e:  # surface in the consumer
+                _put(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
 
 def default_batchify_fn(data):
@@ -53,6 +109,7 @@ class DataLoader:
         self._num_workers = num_workers
         self._prefetch = max(2, prefetch or 2 * max(num_workers, 1))
         self._timeout = timeout
+        self._pin = pin_memory
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -61,6 +118,12 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        it = self._iter_impl()
+        if self._pin:  # double-buffered device feed
+            return iter(DevicePrefetcher(it))
+        return it
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)

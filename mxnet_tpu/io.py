@@ -31,13 +31,15 @@ class DataBatch:
 
     def __init__(self, data: Sequence[NDArray],
                  label: Optional[Sequence[NDArray]] = None, pad: int = 0,
-                 index=None, provide_data=None, provide_label=None):
+                 index=None, provide_data=None, provide_label=None,
+                 bucket_key=None):
         self.data = list(data)
         self.label = list(label) if label is not None else []
         self.pad = pad
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.bucket_key = bucket_key  # BucketingModule routing
 
     def __repr__(self):
         shapes = [d.shape for d in self.data]

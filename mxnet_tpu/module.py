@@ -319,3 +319,129 @@ class Module(BaseModule):
             f"{prefix}-{epoch:04d}.params")
         mod._preloaded = (arg_p, aux_p)
         return mod, arg_p, aux_p
+
+
+class BucketingModule(BaseModule):
+    """Variable-shape training over a family of executors sharing one
+    parameter set (reference: python/mxnet/module/bucketing_module.py —
+    the classic variable-length RNN workflow).
+
+    sym_gen(bucket_key) -> (symbol, data_names, label_names). Each
+    bucket key gets its own bound Module (its own compiled executables
+    — the per-shape jit cache in symbolic form). Parameters, aux
+    states, the optimizer, AND its state dict are shared by REFERENCE:
+    in-place NDArray updates rebind ._data on the same objects every
+    bucket holds, so all buckets train one weight set with one
+    optimizer (reference semantics: a single updater across all
+    executors) and switching costs nothing. DataBatch.bucket_key
+    selects the bucket per batch."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None):
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._logger = logger
+        self._context = context
+        self._buckets: Dict[object, Module] = {}
+        self._curr: Optional[Module] = None
+        self._bind_args = None
+        self._init_args = None
+        self.binded = False
+        self.params_initialized = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             grad_req="write", **_):
+        self._bind_args = dict(for_training=for_training,
+                               grad_req=grad_req)
+        self._switch(self._default_key, data_shapes, label_shapes)
+        self.binded = True
+        return self
+
+    def init_params(self, initializer=None, **kw):
+        assert self.binded, "bind before init_params"
+        self._init_args = dict(initializer=initializer, **kw)
+        self._default_mod().init_params(initializer=initializer, **kw)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       **kw):
+        anchor = self._default_mod()
+        anchor.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                              optimizer_params=optimizer_params, **kw)
+        for mod in self._buckets.values():
+            if mod is not anchor:
+                self._share_optimizer(anchor, mod)
+
+    def _default_mod(self) -> Module:
+        return self._buckets[self._default_key]
+
+    @staticmethod
+    def _share_optimizer(src: Module, dst: Module):
+        assert dst._param_names == src._param_names, \
+            "bucket symbols must declare the same parameters"
+        dst._optimizer = src._optimizer
+        dst._opt_states = src._opt_states
+        dst._kvstore = src._kvstore
+        dst.optimizer_initialized = True
+
+    # -- bucket switching ---------------------------------------------------
+    def _switch(self, key, data_shapes, label_shapes=None):
+        mod = self._buckets.get(key)
+        if mod is None:
+            if data_shapes is None:
+                raise ValueError(
+                    f"bucket {key!r} is not bound yet — the DataBatch "
+                    "must carry provide_data (and provide_label for "
+                    "training) so the new bucket can bind")
+            sym, data_names, label_names = self._sym_gen(key)
+            mod = Module(sym, data_names=data_names,
+                         label_names=label_names, logger=self._logger,
+                         context=self._context)
+            mod.bind(data_shapes, label_shapes, **self._bind_args)
+            anchor = self._buckets.get(self._default_key)
+            if anchor is not None and anchor.params_initialized:
+                arg_p, aux_p = anchor.get_params()
+                # by REFERENCE: same NDArray objects -> in-place
+                # optimizer/aux updates are visible to every bucket
+                mod.init_params(arg_params=arg_p, aux_params=aux_p)
+            elif self._init_args is not None:
+                mod.init_params(**self._init_args)
+            if anchor is not None and anchor.optimizer_initialized:
+                self._share_optimizer(anchor, mod)
+            self._buckets[key] = mod
+        self._curr = mod
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        self._switch(bucket_key, data_shapes, label_shapes)
+
+    # -- train/predict loop -------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._default_key
+        self._switch(key, data_batch.provide_data,
+                     data_batch.provide_label)
+        self._curr.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._curr.update()  # weights/state aliased: visible everywhere
+
+    def get_outputs(self):
+        return self._curr.get_outputs()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._default_mod().get_params()
+
+    def set_params(self, arg_params, aux_params=None, **kw):
+        # assign the SAME arrays into every bucket (re-establishes the
+        # aliasing invariant)
+        for mod in self._buckets.values():
+            mod.set_params(arg_params, aux_params, **kw)

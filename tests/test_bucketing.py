@@ -1,0 +1,155 @@
+"""BucketingModule (reference: python/mxnet/module/bucketing_module.py)
+and the DevicePrefetcher double-buffered feed."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import sym
+
+
+def _sym_gen(seq_len):
+    """Length-independent params: mean over time then FC — the bucketing
+    contract (same weights across buckets)."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    pooled = sym.mean(data, axis=1)                  # (B, D)
+    w = sym.Variable("fc_weight", shape=(4, 8))
+    b = sym.Variable("fc_bias", shape=(4,))
+    fc = sym.FullyConnected(pooled, w, b, num_hidden=4)
+    out = sym.SoftmaxOutput(fc, label, name="softmax")
+    return out, ("data",), ("softmax_label",)
+
+
+def _batch(rs, bucket, batch=6):
+    x = rs.rand(batch, bucket, 8).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0.5).astype(np.float32)
+    return mio.DataBatch(
+        [mx.nd.array(x)], [mx.nd.array(y)],
+        provide_data=[mio.DataDesc("data", (batch, bucket, 8))],
+        provide_label=[mio.DataDesc("softmax_label", (batch,))],
+        bucket_key=bucket)
+
+
+def test_bucketing_module_trains_shared_params():
+    rs = np.random.RandomState(0)
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[mio.DataDesc("data", (6, 10, 8))],
+             label_shapes=[mio.DataDesc("softmax_label", (6,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    metric = mx.metric.Accuracy()
+    buckets = [10, 5, 20, 10, 5, 20] * 5
+    first_params = None
+    for i, bucket in enumerate(buckets):
+        batch = _batch(rs, bucket)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        mod.update_metric(metric, batch.label)
+        if i == 0:
+            first_params = {k: v.asnumpy().copy()
+                            for k, v in mod.get_params()[0].items()}
+    # three buckets were bound
+    assert set(mod._buckets) == {5, 10, 20}
+    # params actually moved and are SHARED: every bucket agrees
+    final, _ = mod.get_params()
+    assert any((final[k].asnumpy() != first_params[k]).any()
+               for k in final)
+    # a bucket may lag one sync; after explicit set_params all agree
+    arg_p, aux_p = mod.get_params()
+    mod.set_params(arg_p, aux_p)
+    a5b = mod._buckets[5].get_params()[0]["fc_weight"].asnumpy()
+    a20 = mod._buckets[20].get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(a5b, a20)
+
+
+def test_bucketing_predict_path():
+    rs = np.random.RandomState(1)
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[mio.DataDesc("data", (6, 10, 8))],
+             label_shapes=[mio.DataDesc("softmax_label", (6,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = _batch(rs, 7)
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (6, 4)
+
+
+def test_device_prefetcher_order_and_errors():
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataloader import DevicePrefetcher
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    X = np.arange(40, dtype=np.float32).reshape(20, 2)
+    ds = ArrayDataset(X)
+    dl = DataLoader(ds, batch_size=4, shuffle=False, pin_memory=True)
+    seen = np.concatenate([b.asnumpy() for b in dl], axis=0)
+    np.testing.assert_allclose(seen, X)   # order preserved
+
+    def boom():
+        yield mx.nd.zeros((1,))
+        raise RuntimeError("producer failed")
+
+    pf = DevicePrefetcher(boom())
+    it = iter(pf)
+    next(it)
+    import pytest
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(it)
+
+
+def test_bucketing_default_optimizer_params():
+    # init_optimizer() with no args must not crash (reference default)
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[mio.DataDesc("data", (2, 10, 8))],
+             label_shapes=[mio.DataDesc("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer()
+
+
+def test_bucketing_unseen_key_without_shapes_errors():
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[mio.DataDesc("data", (2, 10, 8))],
+             label_shapes=[mio.DataDesc("softmax_label", (2,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    import pytest
+    with pytest.raises(ValueError, match="not bound yet"):
+        mod.switch_bucket(99, None)
+
+
+def test_bucketing_shared_adam_state():
+    # one Adam across buckets: update count advances globally
+    rs = np.random.RandomState(2)
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[mio.DataDesc("data", (4, 10, 8))],
+             label_shapes=[mio.DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-2})
+    for bucket in (10, 5, 10, 5):
+        b = _batch(rs, bucket, batch=4)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    m10 = mod._buckets[10]
+    m5 = mod._buckets[5]
+    assert m10._optimizer is m5._optimizer
+    assert m10._opt_states is m5._opt_states
+
+
+def test_device_prefetcher_early_break_no_leak():
+    import threading as _t
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    before = _t.active_count()
+    X = np.arange(200, dtype=np.float32).reshape(100, 2)
+    dl = DataLoader(ArrayDataset(X), batch_size=2, pin_memory=True)
+    for _ in range(5):
+        for b in dl:
+            break  # abandon mid-epoch
+    import time
+    time.sleep(1.0)  # producers notice stop and exit
+    assert _t.active_count() <= before + 1
