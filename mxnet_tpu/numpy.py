@@ -254,3 +254,52 @@ def unique(ar, return_index=False, return_inverse=False,
 
 def may_share_memory(a, b):  # numpy API parity; XLA arrays never do
     return False
+
+
+class _NpLinalg:
+    """mx.np.linalg (reference: python/mxnet/numpy/linalg.py)."""
+
+    norm = staticmethod(_wrap(jnp.linalg.norm, "norm"))
+    inv = staticmethod(_wrap(jnp.linalg.inv, "inv"))
+    det = staticmethod(_wrap(jnp.linalg.det, "det"))
+    slogdet = staticmethod(lambda a: _invoke_seq(
+        lambda raw: tuple(jnp.linalg.slogdet(raw)), [a], 2))
+    cholesky = staticmethod(_wrap(jnp.linalg.cholesky, "cholesky"))
+    solve = staticmethod(_wrap(jnp.linalg.solve, "solve"))
+    lstsq = staticmethod(lambda a, b, rcond=None: _invoke_seq(
+        lambda ra, rb: tuple(jnp.linalg.lstsq(ra, rb, rcond=rcond)),
+        [a, b], 4))
+    eigh = staticmethod(lambda a: _invoke_seq(
+        lambda raw: tuple(jnp.linalg.eigh(raw)), [a], 2))
+    svd = staticmethod(lambda a, full_matrices=True: _invoke_seq(
+        lambda raw: tuple(jnp.linalg.svd(
+            raw, full_matrices=full_matrices)), [a], 3))
+    qr = staticmethod(lambda a: _invoke_seq(
+        lambda raw: tuple(jnp.linalg.qr(raw)), [a], 2))
+    matrix_rank = staticmethod(_wrap(jnp.linalg.matrix_rank,
+                                     "matrix_rank"))
+    pinv = staticmethod(_wrap(jnp.linalg.pinv, "pinv"))
+    eigvalsh = staticmethod(_wrap(jnp.linalg.eigvalsh, "eigvalsh"))
+    matrix_power = staticmethod(_wrap(jnp.linalg.matrix_power,
+                                      "matrix_power"))
+
+
+class _NpFFT:
+    """mx.np.fft (numpy.fft surface over XLA's FFT HLO)."""
+
+    fft = staticmethod(_wrap(jnp.fft.fft, "fft"))
+    ifft = staticmethod(_wrap(jnp.fft.ifft, "ifft"))
+    rfft = staticmethod(_wrap(jnp.fft.rfft, "rfft"))
+    irfft = staticmethod(_wrap(jnp.fft.irfft, "irfft"))
+    fft2 = staticmethod(_wrap(jnp.fft.fft2, "fft2"))
+    ifft2 = staticmethod(_wrap(jnp.fft.ifft2, "ifft2"))
+    fftn = staticmethod(_wrap(jnp.fft.fftn, "fftn"))
+    ifftn = staticmethod(_wrap(jnp.fft.ifftn, "ifftn"))
+    fftshift = staticmethod(_wrap(jnp.fft.fftshift, "fftshift"))
+    ifftshift = staticmethod(_wrap(jnp.fft.ifftshift, "ifftshift"))
+    fftfreq = staticmethod(lambda n, d=1.0: array(
+        _onp.fft.fftfreq(n, d).astype(_onp.float32)))
+
+
+linalg = _NpLinalg()
+fft = _NpFFT()

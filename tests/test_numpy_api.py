@@ -129,3 +129,37 @@ def test_ndarray_kwarg_unwrapped():
     assert npx.is_np_array()
     npx.reset_np()
     assert not npx.is_np_array()
+
+
+def test_np_linalg():
+    rs = onp.random.RandomState(5)
+    a = rs.randn(4, 4).astype(onp.float32)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    na = np.array(spd)
+    onp.testing.assert_allclose(np.linalg.det(na).asnumpy(),
+                                onp.linalg.det(spd), rtol=1e-4)
+    onp.testing.assert_allclose(
+        (np.linalg.inv(na).asnumpy() @ spd), onp.eye(4), atol=1e-4)
+    L = np.linalg.cholesky(na).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    w, v = np.linalg.eigh(na)
+    onp.testing.assert_allclose(
+        v.asnumpy() @ onp.diag(w.asnumpy()) @ v.asnumpy().T, spd,
+        rtol=1e-3, atol=1e-3)
+    u, s, vt = np.linalg.svd(na, full_matrices=False)
+    onp.testing.assert_allclose(
+        u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy(), spd,
+        rtol=1e-3, atol=1e-3)
+    b = rs.randn(4).astype(onp.float32)
+    x = np.linalg.solve(na, np.array(b)).asnumpy()
+    onp.testing.assert_allclose(spd @ x, b, rtol=1e-3, atol=1e-3)
+
+
+def test_np_fft_roundtrip():
+    rs = onp.random.RandomState(6)
+    x = rs.randn(8).astype(onp.float32)
+    X = np.fft.fft(np.array(x))
+    back = np.fft.ifft(X).asnumpy()
+    onp.testing.assert_allclose(back.real, x, atol=1e-5)
+    onp.testing.assert_allclose(
+        np.fft.fftfreq(8).asnumpy(), onp.fft.fftfreq(8).astype(onp.float32))
