@@ -62,8 +62,11 @@ def invoke(fn, args: Sequence[Any], kwargs: Optional[dict] = None,
     grad_positions = []
     if autograd.is_recording():
         for i, a in enumerate(args):
+            # inexact = floating OR complex: fft chains (spectral
+            # losses) are differentiable through jax.vjp too
             if isinstance(a, NDArray) and a._in_graph \
-                    and jnp.issubdtype(jnp.result_type(raw[i]), jnp.floating):
+                    and jnp.issubdtype(jnp.result_type(raw[i]),
+                                       jnp.inexact):
                 grad_positions.append(i)
 
     if grad_positions:

@@ -256,26 +256,29 @@ def may_share_memory(a, b):  # numpy API parity; XLA arrays never do
     return False
 
 
+def _tuple_op(fn, n, **fixed):
+    """Multi-output linalg op over NDArrays (shared n_out plumbing)."""
+    def f(*arrays, **kw):
+        return _invoke_seq(
+            lambda *raw: tuple(fn(*raw, **fixed, **kw)), list(arrays), n)
+    return staticmethod(f)
+
+
 class _NpLinalg:
     """mx.np.linalg (reference: python/mxnet/numpy/linalg.py)."""
 
     norm = staticmethod(_wrap(jnp.linalg.norm, "norm"))
     inv = staticmethod(_wrap(jnp.linalg.inv, "inv"))
     det = staticmethod(_wrap(jnp.linalg.det, "det"))
-    slogdet = staticmethod(lambda a: _invoke_seq(
-        lambda raw: tuple(jnp.linalg.slogdet(raw)), [a], 2))
+    slogdet = _tuple_op(jnp.linalg.slogdet, 2)
     cholesky = staticmethod(_wrap(jnp.linalg.cholesky, "cholesky"))
     solve = staticmethod(_wrap(jnp.linalg.solve, "solve"))
-    lstsq = staticmethod(lambda a, b, rcond=None: _invoke_seq(
-        lambda ra, rb: tuple(jnp.linalg.lstsq(ra, rb, rcond=rcond)),
-        [a, b], 4))
-    eigh = staticmethod(lambda a: _invoke_seq(
-        lambda raw: tuple(jnp.linalg.eigh(raw)), [a], 2))
-    svd = staticmethod(lambda a, full_matrices=True: _invoke_seq(
-        lambda raw: tuple(jnp.linalg.svd(
-            raw, full_matrices=full_matrices)), [a], 3))
-    qr = staticmethod(lambda a: _invoke_seq(
-        lambda raw: tuple(jnp.linalg.qr(raw)), [a], 2))
+    lstsq = _tuple_op(jnp.linalg.lstsq, 4)
+    eigh = _tuple_op(jnp.linalg.eigh, 2)
+    # reduced SVD like the reference's np.linalg.svd (full_matrices
+    # also has no JVP, so the default must be the differentiable form)
+    svd = _tuple_op(jnp.linalg.svd, 3, full_matrices=False)
+    qr = _tuple_op(jnp.linalg.qr, 2)
     matrix_rank = staticmethod(_wrap(jnp.linalg.matrix_rank,
                                      "matrix_rank"))
     pinv = staticmethod(_wrap(jnp.linalg.pinv, "pinv"))

@@ -25,6 +25,7 @@ CASES = {
     "dist_train_ps.py": ["--cpu", "--steps", "4", "--workers", "2"],
     "train_ssd.py": ["--cpu", "--steps", "6", "--batch-size", "4"],
     "dcgan.py": ["--cpu", "--steps", "4", "--batch-size", "4"],
+    "lstm_bucketing.py": ["--cpu", "--steps", "9"],
 }
 
 

@@ -146,13 +146,26 @@ def test_np_linalg():
     onp.testing.assert_allclose(
         v.asnumpy() @ onp.diag(w.asnumpy()) @ v.asnumpy().T, spd,
         rtol=1e-3, atol=1e-3)
-    u, s, vt = np.linalg.svd(na, full_matrices=False)
+    u, s, vt = np.linalg.svd(na)
     onp.testing.assert_allclose(
         u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy(), spd,
         rtol=1e-3, atol=1e-3)
     b = rs.randn(4).astype(onp.float32)
     x = np.linalg.solve(na, np.array(b)).asnumpy()
     onp.testing.assert_allclose(spd @ x, b, rtol=1e-3, atol=1e-3)
+
+
+def test_complex_grad_through_fft():
+    # spectral loss: real -> fft -> |.| -> sum must backprop (complex
+    # intermediates join the tape via the inexact dtype filter)
+    x = np.array(onp.asarray([1.0, -2.0, 0.5, 3.0], onp.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = np.sum(np.abs(np.fft.fft(x)) ** 2)
+    loss.backward()
+    # Parseval: d/dx sum |FFT(x)|^2 = 2 * N * x
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * 4 * x.asnumpy(),
+                                rtol=1e-5)
 
 
 def test_np_fft_roundtrip():
