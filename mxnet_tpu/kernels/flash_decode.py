@@ -25,7 +25,9 @@ import jax.numpy as jnp
 
 from .dispatch import KernelFallback
 
-__all__ = ["flash_decode", "reference_decode_attention"]
+__all__ = ["flash_decode", "flash_decode_quantized",
+           "quantize_kv", "dequantize_kv",
+           "reference_decode_attention"]
 
 _fallback = KernelFallback("flash-decode",
                            strict_envs=("MXNET_TPU_STRICT_FLASH",))
@@ -152,6 +154,134 @@ def flash_decode(q, k_cache, v_cache, valid_len, scale=None,
                                       scale)
 
 
+# -- int8-quantized KV cache ------------------------------------------------
+# Decode is HBM-bandwidth-bound (the whole cache streams per token);
+# an int8 cache with per-token scales halves that HBM traffic vs bf16
+# — that is the win. Inside VMEM the blocks upcast to fp32 for the
+# dot (scales fold into the (rep, blk) score/probability matrices, so
+# the per-row rescale never touches the (blk, d) axis). Reference
+# analogue: the fork's int8 inference identity
+# (src/operator/quantization/) applied to the KV cache.
+
+def quantize_kv(k_cache, v_cache):
+    """(B, K, S, d) caches -> int8 data + per-token fp32 scales
+    (B, K, S, 1). Symmetric abs-max over d."""
+    def one(c):
+        cf = c.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(cf), axis=-1, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q8 = jnp.clip(jnp.round(cf / scale), -127, 127).astype(jnp.int8)
+        return q8, scale
+
+    k8, ks = one(k_cache)
+    v8, vs = one(v_cache)
+    return k8, ks, v8, vs
+
+
+def dequantize_kv(q8, scale, dtype=jnp.bfloat16):
+    return (q8.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _flash_decode_pallas_q8(q, k8, ks, v8, vs, valid_len, scale,
+                            interpret, block_s=256):
+    """Same sweep as _flash_decode_pallas with int8 cache blocks;
+    k scales fold into the score rows (s = (q @ k8^T) * ks^T) and v
+    scales into the probability rows (p * vs^T) — both exact."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, d = q.shape
+    K, S = k8.shape[1], k8.shape[2]
+    rep = H // K
+    blk = max(1, min(block_s, S))
+    while S % blk:
+        blk //= 2
+    qr = q.reshape(B, K, rep, d)
+    n_s = S // blk
+
+    def kernel(vl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref):
+        qblk = q_ref[...].astype(jnp.float32) * scale    # (rep, d)
+        vl = vl_ref[pl.program_id(0)]
+        m = jnp.full((rep,), -jnp.inf, jnp.float32)
+        l = jnp.zeros((rep,), jnp.float32)
+        acc = jnp.zeros((rep, d), jnp.float32)
+
+        def body(sj, carry):
+            m_, l_, acc_ = carry
+            kblk = k_ref[pl.dslice(sj * blk, blk), :] \
+                .astype(jnp.float32)                     # (blk, d) i8
+            vblk = v_ref[pl.dslice(sj * blk, blk), :] \
+                .astype(jnp.float32)
+            ksb = ks_ref[pl.dslice(sj * blk, blk), :]    # (blk, 1) f32
+            vsb = vs_ref[pl.dslice(sj * blk, blk), :]
+            s = (qblk @ kblk.T) * ksb[:, 0][None, :]     # (rep, blk)
+            pos = sj * blk + jax.lax.broadcasted_iota(
+                jnp.int32, (rep, blk), 1)
+            s = jnp.where(pos < vl, s, -jnp.inf)
+            m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
+            corr = jnp.where(jnp.isfinite(m_),
+                             jnp.exp(m_ - m_new), 0.0)
+            ps = p * vsb[:, 0][None, :]                  # fold v scale
+            return (m_new, corr * l_ + jnp.sum(p, axis=-1),
+                    corr[:, None] * acc_ + ps @ vblk)
+
+        upper = jnp.minimum(n_s, (vl + blk - 1) // blk)
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+
+    cache_spec = pl.BlockSpec((None, None, S, d),
+                              lambda b, h, vl: (b, h, 0, 0))
+    scale_spec = pl.BlockSpec((None, None, S, 1),
+                              lambda b, h, vl: (b, h, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, K),
+        in_specs=[
+            pl.BlockSpec((None, None, rep, d),
+                         lambda b, h, vl: (b, h, 0, 0)),
+            cache_spec, scale_spec, cache_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((None, None, rep, d),
+                               lambda b, h, vl: (b, h, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, rep, d), q.dtype),
+        interpret=interpret,
+    )(valid_len.astype(jnp.int32), qr, k8, ks, v8, vs)
+    return out.reshape(B, H, d)
+
+
+def flash_decode_quantized(q, k8, ks, v8, vs, valid_len, scale=None,
+                           use_flash=True):
+    """Single-position attention against an int8 cache with per-token
+    scales (see quantize_kv). Pallas on TPU; dequantize + the
+    no-repeat jnp formulation elsewhere."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mode = _pallas_mode_q8(k8) if use_flash else None
+    if mode is not None:
+        try:
+            return _flash_decode_pallas_q8(q, k8, ks, v8, vs,
+                                           valid_len, scale,
+                                           mode == "interpret")
+        except Exception as e:
+            _fallback.note(e)
+    return reference_decode_attention(
+        q, dequantize_kv(k8, ks, jnp.float32),
+        dequantize_kv(v8, vs, jnp.float32), valid_len, scale)
+
+
+def _pallas_mode_q8(k8):
+    # int8 halves the cache bytes; fp32 scales add 4 per token
+    S, d = k8.shape[2], k8.shape[3]
+    return _gate(k8, cache_bytes=2 * S * (d + 4))
+
+
 # one kv head's K+V must fit VMEM (~16 MiB/core) next to the working
 # blocks; beyond this the (B, K)-grid kernel would fail at Mosaic
 # compile time INSIDE the caller's jit — where the try/except above
@@ -161,15 +291,22 @@ _VMEM_CACHE_BUDGET_BYTES = 10 << 20
 
 def _pallas_mode(k_cache):
     S, d = k_cache.shape[2], k_cache.shape[3]
-    if S % 128 != 0:
+    return _gate(k_cache,
+                 cache_bytes=2 * S * d * k_cache.dtype.itemsize)
+
+
+def _gate(cache_operand, cache_bytes):
+    """Shared dispatch gate for both cache dtypes: Mosaic tiling needs
+    S % 128 == 0, one kv head's cache must fit the VMEM budget, and an
+    eager call on CPU-committed data must never attempt Mosaic."""
+    if cache_operand.shape[2] % 128 != 0:
         return None
-    if 2 * S * d * k_cache.dtype.itemsize > _VMEM_CACHE_BUDGET_BYTES:
+    if cache_bytes > _VMEM_CACHE_BUDGET_BYTES:
         return None
     if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
         return "interpret"
     if jax.default_backend() not in ("cpu",):
         from .dispatch import operand_on_cpu
 
-        # eager call on CPU-committed data: Mosaic cannot run there
-        return None if operand_on_cpu(k_cache) else "compiled"
+        return None if operand_on_cpu(cache_operand) else "compiled"
     return None

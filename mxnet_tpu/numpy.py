@@ -256,11 +256,13 @@ def may_share_memory(a, b):  # numpy API parity; XLA arrays never do
     return False
 
 
-def _tuple_op(fn, n, **fixed):
-    """Multi-output linalg op over NDArrays (shared n_out plumbing)."""
+def _tuple_op(fn, n, **defaults):
+    """Multi-output linalg op over NDArrays (shared n_out plumbing);
+    caller kwargs override the defaults."""
     def f(*arrays, **kw):
+        merged = {**defaults, **kw}
         return _invoke_seq(
-            lambda *raw: tuple(fn(*raw, **fixed, **kw)), list(arrays), n)
+            lambda *raw: tuple(fn(*raw, **merged)), list(arrays), n)
     return staticmethod(f)
 
 

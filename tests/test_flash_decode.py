@@ -121,3 +121,51 @@ def test_llama_decode_step_parity(monkeypatch):
     np.testing.assert_allclose(np.asarray(logits_kernel),
                                np.asarray(logits_ref),
                                rtol=2e-4, atol=2e-4)
+
+
+# -- int8-quantized KV cache -------------------------------------------------
+
+def test_quantize_kv_roundtrip():
+    from mxnet_tpu.kernels.flash_decode import (dequantize_kv,
+                                                quantize_kv)
+    _, kc, vc, _ = _data(seed=3)
+    k8, ks, v8, vs = quantize_kv(kc, vc)
+    assert k8.dtype == jnp.int8 and ks.shape == kc.shape[:3] + (1,)
+    back = dequantize_kv(k8, ks, jnp.float32)
+    # per-token abs-max int8: max error <= scale/2 ~ amax/254
+    err = np.abs(np.asarray(back) - np.asarray(kc))
+    amax = np.abs(np.asarray(kc)).max(axis=-1, keepdims=True)
+    assert (err <= amax / 254 + 1e-6).all()
+
+
+def test_quantized_decode_matches_fp32_reference():
+    from mxnet_tpu.kernels.flash_decode import (_flash_decode_pallas_q8,
+                                                quantize_kv,
+                                                reference_decode_attention)
+    q, kc, vc, vl = _data(seed=4)
+    k8, ks, v8, vs = quantize_kv(kc, vc)
+    out8 = _flash_decode_pallas_q8(q, k8, ks, v8, vs, vl,
+                                   1.0 / np.sqrt(q.shape[-1]),
+                                   interpret=True)
+    ref = reference_decode_attention(q, kc, vc, vl)
+    # int8 cache: ~1% relative output error is the expected regime
+    np.testing.assert_allclose(np.asarray(out8), np.asarray(ref),
+                               rtol=0.05, atol=0.03)
+
+
+def test_quantized_decode_jnp_fallback_matches_kernel():
+    from mxnet_tpu.kernels.flash_decode import (flash_decode_quantized,
+                                                quantize_kv)
+    q, kc, vc, vl = _data(seed=5)
+    k8, ks, v8, vs = quantize_kv(kc, vc)
+    # fallback path (use_flash=False): dequantized exact softmax
+    a = flash_decode_quantized(q, k8, ks, v8, vs, vl, use_flash=False)
+    # interpreter kernel path
+    import os
+    os.environ["MXNET_TPU_FLASH_INTERPRET"] = "1"
+    try:
+        b = flash_decode_quantized(q, k8, ks, v8, vs, vl)
+    finally:
+        del os.environ["MXNET_TPU_FLASH_INTERPRET"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)

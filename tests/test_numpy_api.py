@@ -176,3 +176,12 @@ def test_np_fft_roundtrip():
     onp.testing.assert_allclose(back.real, x, atol=1e-5)
     onp.testing.assert_allclose(
         np.fft.fftfreq(8).asnumpy(), onp.fft.fftfreq(8).astype(onp.float32))
+
+
+def test_svd_explicit_kwarg_overrides_default():
+    rs = onp.random.RandomState(7)
+    a = rs.randn(3, 5).astype(onp.float32)
+    u, s, vt = np.linalg.svd(np.array(a), full_matrices=False)
+    assert u.shape == (3, 3) and vt.shape == (3, 5)
+    uf, sf, vtf = np.linalg.svd(np.array(a), full_matrices=True)
+    assert uf.shape == (3, 3) and vtf.shape == (5, 5)

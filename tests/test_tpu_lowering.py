@@ -120,3 +120,14 @@ def test_fused_ce_lowers_fwd_and_grad():
     _lowers(lambda a, b: _ce_pallas(a, b, False), x, lbl)
     _lowers(lambda a, b: jax.grad(
         lambda p: _ce_pallas(p, b, False).sum())(a), x, lbl)
+
+
+def test_flash_decode_quantized_lowers():
+    from mxnet_tpu.kernels.flash_decode import _flash_decode_pallas_q8
+    B, K, S, d, rep = 2, 2, 1024, 128, 4
+    q = jax.ShapeDtypeStruct((B, K * rep, d), jnp.bfloat16)
+    k8 = jax.ShapeDtypeStruct((B, K, S, d), jnp.int8)
+    ks = jax.ShapeDtypeStruct((B, K, S, 1), jnp.float32)
+    vl = jax.ShapeDtypeStruct((B,), jnp.int32)
+    _lowers(lambda q_, k_, ks_, v_, vs_, vl_: _flash_decode_pallas_q8(
+        q_, k_, ks_, v_, vs_, vl_, 0.088, False), q, k8, ks, k8, ks, vl)
