@@ -185,6 +185,22 @@ class NDArray:
     def asnumpy(self) -> _np.ndarray:
         return _np.asarray(self._data)
 
+    # -- DLPack interop (reference: ndarray.to_dlpack_for_read /
+    # from_dlpack in python/mxnet/dlpack.py) --------------------------------
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__(stream=stream)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        """A DLPack capsule sharing this array's device buffer (the
+        reference's read-only variant; XLA arrays are immutable, so
+        the write variant is identical)."""
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("asscalar on non-scalar")
@@ -535,6 +551,33 @@ class NDArray:
 def _make(raw, ctx):
     ctx = ctx or current_context()
     return NDArray(raw, ctx=ctx, _place=True)
+
+
+def from_dlpack(ext, ctx=None) -> NDArray:
+    """NDArray from any DLPack-exporting object — a legacy capsule, or
+    an object with __dlpack__ (torch tensor, numpy array, jax array,
+    or another NDArray). Zero-copy when the producer's buffer is
+    already on a compatible device (reference: python/mxnet/dlpack.py
+    from_dlpack)."""
+    import jax
+
+    if type(ext).__name__ == "PyCapsule":
+        # modern jax only consumes the __dlpack__ protocol; adapt the
+        # reference's capsule form (capsules carry no device info —
+        # the legacy contract was host memory)
+        class _CapsuleHolder:
+            def __init__(self, cap):
+                self._cap = cap
+
+            def __dlpack__(self, stream=None, **kw):
+                return self._cap
+
+            def __dlpack_device__(self):
+                return (1, 0)  # kDLCPU
+
+        ext = _CapsuleHolder(ext)
+    raw = jax.dlpack.from_dlpack(ext)
+    return NDArray(raw, ctx=ctx)
 
 
 def array(source, ctx=None, dtype=None) -> NDArray:

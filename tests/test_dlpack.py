@@ -1,0 +1,45 @@
+"""DLPack interop (reference: python/mxnet/dlpack.py — to_dlpack_for_
+read/write, from_dlpack): round trips with numpy, torch (CPU), and the
+__dlpack__ protocol."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_roundtrip_via_protocol():
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    y = nd.from_dlpack(x)  # NDArray exposes __dlpack__ itself
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_capsule_roundtrip():
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    cap = x.to_dlpack_for_read()
+    y = nd.from_dlpack(cap)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_numpy_from_dlpack_of_ndarray():
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    back = np.from_dlpack(x)
+    np.testing.assert_allclose(back, x.asnumpy())
+
+
+def test_torch_interop():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(10, dtype=torch.float32).reshape(2, 5)
+    x = nd.from_dlpack(t)
+    assert isinstance(x, mx.nd.NDArray)
+    np.testing.assert_allclose(x.asnumpy(), t.numpy())
+    # and back into torch
+    t2 = torch.from_dlpack(x)
+    np.testing.assert_allclose(t2.numpy(), t.numpy())
+
+
+def test_from_dlpack_then_compute():
+    x = mx.nd.array(np.ones((4,), np.float32))
+    y = nd.from_dlpack(x)
+    z = (y * 3).sum()
+    assert float(z.asscalar()) == 12.0
