@@ -25,6 +25,26 @@ from ..ndarray import NDArray
 __all__ = ["beam_search_translate", "BeamSearchScorer"]
 
 
+def beam_expand_topk(scores, logp, finished, eos_id):
+    """One beam-search expansion, shared by the MT translator below
+    and llama_infer.generate_beam: scores (B, W), logp (B, W, V),
+    finished (B, W) -> (new_scores, parent, token, new_finished), all
+    (B, W). Finished beams may only extend with eos at zero cost, so
+    their scores freeze."""
+    B, W, V = logp.shape
+    if eos_id is not None:
+        frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+        logp = jnp.where(finished[..., None], frozen[None, None], logp)
+    total = scores[..., None] + logp                 # (B, W, V)
+    new_scores, flat = lax.top_k(total.reshape(B, W * V), W)
+    parent = flat // V
+    tok = (flat % V).astype(jnp.int32)
+    new_finished = jnp.take_along_axis(finished, parent, axis=1)
+    if eos_id is not None:
+        new_finished = new_finished | (tok == eos_id)
+    return new_scores, parent, tok, new_finished
+
+
 class BeamSearchScorer:
     """Length-penalized log-prob (reference: alpha/K scorer,
     GNMT eq. 14): score = logp / ((5 + len)^alpha / 6^alpha)."""
@@ -98,20 +118,13 @@ def beam_search_translate(net, src, bos_id: int, eos_id: int,
             V = logits.shape[-1]
             lp = jax.nn.log_softmax(
                 logits[jnp.arange(B * K), t - 1].astype(jnp.float32))
-            # finished beams: only "extend with eos" at zero cost
-            frozen = jnp.full((B * K, V), -jnp.inf)
-            frozen = frozen.at[:, eos_id].set(0.0)
-            lp = jnp.where(done[:, None], frozen, lp)
-            cand = scores[:, None] + lp          # (B*K, V)
-            cand = cand.reshape(B, K * V)
-            top_s, top_i = lax.top_k(cand, K)    # (B, K)
-            beam_idx = top_i // V                # which source beam
-            tok_idx = (top_i % V).astype(jnp.int32)
+            top_s, beam_idx, tok_idx, done2 = beam_expand_topk(
+                scores.reshape(B, K), lp.reshape(B, K, V),
+                done.reshape(B, K), eos_id)
             flat_beam = (jnp.arange(B)[:, None] * K +
                          beam_idx).reshape(-1)
             tokens = tokens[flat_beam].at[:, t].set(tok_idx.reshape(-1))
-            done = done[flat_beam] | \
-                (tok_idx.reshape(-1) == eos_id)
+            done = done2.reshape(-1)
             scores = top_s.reshape(-1)
             return (tokens, scores, done), None
 

@@ -25,7 +25,7 @@ from jax import lax
 
 from ..ndarray import NDArray
 
-__all__ = ["generate", "build_decoder"]
+__all__ = ["generate", "generate_beam", "build_decoder"]
 
 
 def _params_tree(net):
@@ -291,4 +291,76 @@ def generate(net, prompt_ids, max_new_tokens: int, temperature=0.0,
     scan = jax.jit(partial(lax.scan, scan_body))
     (_, _, _), toks = scan((cache, logits, valid), keys)
     out = jnp.concatenate([ids, toks.T], axis=1)
+    return _np.asarray(out)
+
+
+def generate_beam(net, prompt_ids, max_new_tokens: int, beam_size=4,
+                  eos_id: Optional[int] = None, length_penalty=1.0,
+                  max_len: Optional[int] = None,
+                  kv_cache_dtype: str = "model"):
+    """Beam-search decoding over the cached decoder (reference
+    analogue: GluonNLP's BeamSearchSampler; the MT twin lives in
+    models/beam_search.py). Static shapes throughout: (B*W) rows ride
+    the same jitted step as sampling; beam bookkeeping is vectorized
+    top-k over (B, W*V). Finished beams are frozen by forcing eos at
+    log-prob 0. Returns (B, T + max_new_tokens) numpy — the best beam
+    per batch row under score / len**length_penalty."""
+    ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
+        else jnp.asarray(prompt_ids)
+    ids = ids.astype(jnp.int32)
+    B, T = ids.shape
+    W = beam_size
+    cfg = net.model.cfg
+    max_len = max_len or min(cfg.max_seq_len, T + max_new_tokens)
+    assert T + max_new_tokens <= max_len, "max_len too small"
+    params, prefill, step = build_decoder(net, max_len,
+                                          kv_cache_dtype=kv_cache_dtype)
+    valid = jnp.full((B,), T, jnp.int32)
+    cache, logits = jax.jit(prefill)(params, ids, valid)
+
+    # expand every batch row to W beams (contiguous blocks of W)
+    rep = lambda x: jnp.repeat(x, W, axis=0)
+    cache = jax.tree_util.tree_map(rep, cache)
+    logits = rep(logits)                         # (B*W, V)
+    V = logits.shape[-1]
+    pos = rep(valid)                             # (B*W,)
+    # only beam 0 is live initially, so the first top-k is not W
+    # copies of the same candidate
+    scores = jnp.full((B, W), -jnp.inf).at[:, 0].set(0.0)
+    finished = jnp.zeros((B, W), bool)
+    lengths = jnp.zeros((B, W), jnp.int32)
+    toks = jnp.zeros((B, W, max_new_tokens), jnp.int32)
+
+    from .beam_search import beam_expand_topk
+
+    jstep = jax.jit(step)
+    for t in range(max_new_tokens):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1) \
+            .reshape(B, W, V)
+        was_finished = finished
+        scores, src, tok, finished = beam_expand_topk(
+            scores, logp, finished, eos_id)
+        gather = (jnp.arange(B)[:, None] * W + src).reshape(-1)
+        toks = jnp.take_along_axis(toks, src[..., None], axis=1) \
+            .at[:, :, t].set(tok)
+        lengths = jnp.take_along_axis(lengths, src, axis=1)
+        lengths = jnp.where(
+            jnp.take_along_axis(was_finished, src, axis=1), lengths,
+            lengths + 1)
+        if eos_id is not None and bool(jnp.all(finished)):
+            # remaining positions: eos padding (consistent with the
+            # frozen-beam continuation the loop would have produced)
+            toks = toks.at[:, :, t + 1:].set(eos_id)
+            break
+        if t < max_new_tokens - 1:  # last selection needs no logits
+            cache = jax.tree_util.tree_map(lambda x: x[gather], cache)
+            pos = pos[gather]
+            cache, logits = jstep(params, cache, pos, tok.reshape(-1))
+            pos = pos + 1
+
+    norm = jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+    best = jnp.argmax(scores / norm, axis=1)      # (B,)
+    best_toks = jnp.take_along_axis(
+        toks, best[:, None, None], axis=1)[:, 0]  # (B, max_new)
+    out = jnp.concatenate([ids, best_toks], axis=1)
     return _np.asarray(out)
