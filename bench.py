@@ -7,17 +7,26 @@ can never produce zero data again:
 
 - BENCH_BUDGET_S (default 540) is a self-imposed wall-clock budget; a
   watchdog thread prints the best-so-far JSON line and exits 0.
+- A TpuHunter daemon thread re-probes the accelerator tunnel every
+  ~45 s for the WHOLE budget. If the chip comes healthy at any point
+  — even after the CPU fallback phases have started — a fresh
+  subprocess (`BENCH_TPU_DIRECT=1`) immediately runs the on-chip fast
+  path (matmul MFU, allreduce GB/s, ResNet, BERT) and its JSON lines
+  overwrite the CPU numbers. The emitted JSON always carries
+  `tpu_probe_history` proving probing continued to end-of-budget.
 - The JAX persistent compilation cache is enabled, so a re-run skips
   the expensive ResNet-50 compile entirely.
 - Phase 1 is a cheap bf16 matmul MFU probe (compiles in seconds) whose
-  JSON line is emitted immediately; phase 2 upgrades it to the real
-  ResNet-50 headline only if budget remains. The LAST line printed is
-  always the best measurement available.
+  JSON line is emitted immediately; later phases upgrade it to the real
+  ResNet-50 headline and fold in the other SURVEY-§6 metrics
+  (bert_samples_per_sec, allreduce_gbps) as side fields. The LAST line
+  printed is always the best measurement available.
 """
 import json
 import os
 import signal
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -26,6 +35,8 @@ import numpy as np
 
 REFERENCE_IMG_PER_SEC = 1360.0   # ptrendx/mxnet ResNet-50 V100 AMP
 REFERENCE_MATMUL_TFLOPS = 112.0  # V100 measured dense fp16 (tensor cores)
+REFERENCE_BERT_SPS = 107.0       # ptrendx MXNet BERT-base V100 AMP
+REFERENCE_ALLREDUCE_GBPS = 130.0  # NCCL allreduce 8xV100 NVLink (bus BW)
 V5E_PEAK_TFLOPS = 197.0          # bf16 peak per v5e chip
 
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))
@@ -121,56 +132,156 @@ print("BACKEND:" + b, flush=True)
 """
 
 
-def _acquire_backend(max_wait):
-    """Decide TPU vs CPU WITHOUT letting the main process dial a broken
-    tunnel: backend init through a dead relay blocks >15 min inside one
-    C call (no Python signal can interrupt it), so a disposable
-    subprocess proves init + a tiny matmul work within the deadline
-    before the main process commits to the default platform. On probe
-    failure/timeout, pin CPU: a recorded CPU number beats no number."""
+def _probe_once(timeout):
+    """One disposable-subprocess health check of the default platform.
+
+    Backend init through a dead tunnel relay blocks >15 min inside one
+    C call (no Python signal can interrupt it), so the probe lives in a
+    child we can kill. The child runs under `nice -n 10` (no
+    preexec_fn: running Python between fork and exec in a JAX-threaded
+    parent risks deadlock) so probes don't contend with the CPU
+    benchmark phases on a 1-core box. Returns 'tpu' | 'cpu' |
+    'probe_timeout' | 'probe_failed'."""
     import subprocess
 
+    # fast pre-check: when the axon relay is down its ports REFUSE
+    # instantly — that's a definitive "tunnel dead" far cheaper than a
+    # jax-import probe, and it stays accurate even when CPU bench
+    # phases starve a full probe subprocess past its timeout (probes
+    # under contention can't even finish `import jax`). Only trusted
+    # in the axon environment; anywhere else fall through to the real
+    # probe.
+    if os.path.exists("/root/.axon_site/sitecustomize.py"):
+        import socket
+
+        try:
+            s = socket.socket()
+            s.settimeout(2.0)
+            s.connect(("127.0.0.1",
+                       int(os.environ.get("BENCH_RELAY_PORT", "8082"))))
+            s.close()
+        except ConnectionRefusedError:
+            return "relay_refused"
+        except OSError:
+            pass  # inconclusive (timeout under load): run the probe
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the REAL default platform
+    try:
+        out = subprocess.run(
+            ["nice", "-n", "10", sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout,
+            env=env).stdout
+    except subprocess.TimeoutExpired:
+        return "probe_timeout"
+    except Exception:
+        return "probe_failed"
+    for line in out.splitlines():
+        if line.startswith("BACKEND:"):
+            return "tpu" if line.split(":", 1)[1] != "cpu" else "cpu"
+    return "probe_failed"
+
+
+class TpuHunter(threading.Thread):
+    """Persistent accelerator hunt: probe every `interval` seconds for
+    the WHOLE budget (round-3 verdict: a give-up-once probe wasted a
+    chip that recovered at minute 4 of a 9-minute budget). `history`
+    is shared with the emitted JSON as `tpu_probe_history` so every
+    BENCH artifact proves probing continued to end-of-budget. The
+    observed tunnel flap pattern (healthy ~25 min, dies, sometimes
+    recovers — PERF.md) makes this the highest-EV loop in the repo."""
+
+    def __init__(self, interval=None):
+        super().__init__(daemon=True)
+        if interval is None:
+            interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S",
+                                            "45"))
+        self.interval = interval
+        self.history = []
+        self.found = threading.Event()
+        self._first = threading.Event()
+        self._stopped = threading.Event()
+        self._paused = threading.Event()
+
+    def run(self):
+        consecutive_cpu = 0
+        while not self._stopped.is_set() and _remaining() > 20.0:
+            if self._paused.is_set():
+                self._stopped.wait(2.0)
+                continue
+            t = time.monotonic() - _guard.t0
+            probe_s = float(os.environ.get("BENCH_PROBE_S", "40"))
+            res = _probe_once(
+                timeout=min(probe_s, max(5.0, _remaining() - 5.0)))
+            self.history.append({"t_s": round(t, 1), "result": res})
+            print(f"# tpu probe @{t:.0f}s: {res}", file=sys.stderr)
+            self._first.set()
+            if res == "tpu":
+                self.found.set()
+            # a 'cpu' result means the default platform resolved to CPU
+            # (no accelerator plugin in this env) — keep a slow trickle
+            # in case the platform appears, but don't burn the core
+            consecutive_cpu = consecutive_cpu + 1 if res == "cpu" else 0
+            wait = self.interval * (4 if consecutive_cpu >= 2 else 1)
+            self._stopped.wait(max(2.0, wait - (time.monotonic()
+                                                - _guard.t0 - t)))
+        self._first.set()
+
+    def wait_first(self, timeout):
+        return self._first.wait(timeout)
+
+    def stop_hunting(self):
+        self._stopped.set()
+
+    def pause(self):
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+
+def acquire_backend_once(max_wait=120.0):
+    """Backend acquisition for the standalone benchmark scripts
+    (benchmarks/bert_bench.py, allreduce_bench.py): re-probe until
+    `max_wait`, then commit to the platform a probe proved — or pin
+    CPU so a recorded CPU number beats no number. bench.py itself uses
+    the persistent TpuHunter instead (probing its WHOLE budget)."""
     import jax
 
     deadline = time.monotonic() + max_wait
-    attempt = 0
-    while time.monotonic() < deadline:
-        attempt += 1
-        left = max(5.0, deadline - time.monotonic())
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True,
-                timeout=min(90.0, left)).stdout
-        except subprocess.TimeoutExpired:
-            print(f"# backend probe {attempt} timed out", file=sys.stderr)
-            continue
-        probed = [l.split(":", 1)[1] for l in out.splitlines()
-                  if l.startswith("BACKEND:")]
-        if probed and probed[0] != "cpu":
-            # tunnel proven healthy — but the probe subprocess itself
-            # just held the exclusive grant, so the main init can still
-            # hit UNAVAILABLE until its lease lapses: retry with
-            # backoff inside the remaining deadline, then fall through
-            # to the CPU pin rather than crashing
-            while True:
-                try:
-                    return jax.default_backend()
-                except Exception as e:
-                    if time.monotonic() >= deadline:
-                        print(f"# main init failed after probe: {e}",
-                              file=sys.stderr)
-                        break
-                    time.sleep(5.0)
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
             break
-        if probed:  # healthy init but CPU-only platform: no point retrying
-            break
-        print(f"# backend probe {attempt} failed", file=sys.stderr)
+        res = _probe_once(timeout=min(60.0, max(5.0, left)))
+        print(f"# backend probe: {res}", file=sys.stderr)
+        if res == "tpu":
+            backend = _commit_tpu()
+            if backend is not None:
+                return backend
+        if res == "cpu":
+            break  # healthy init, CPU-only platform: no point retrying
         time.sleep(min(10.0, max(0.0, deadline - time.monotonic())))
-    print(f"# no healthy accelerator within {max_wait:.0f}s; "
-          "falling back to CPU", file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")
-    return jax.default_backend()
+    return "cpu"
+
+
+def _commit_tpu(max_tries=4):
+    """Main-process backend init after a healthy probe. The probe child
+    may still hold the exclusive device grant, so retry briefly with a
+    visible heartbeat; on failure return None (caller pins CPU — the
+    late-TPU subprocess path stays available in a fresh process)."""
+    import jax
+
+    for attempt in range(1, max_tries + 1):
+        try:
+            return jax.default_backend()
+        except Exception as e:
+            print(f"# main TPU init attempt {attempt}/{max_tries} "
+                  f"failed: {str(e)[:150]}", file=sys.stderr)
+            if attempt < max_tries and _remaining() > 30.0:
+                time.sleep(5.0)
+    return None
 
 
 def _matmul_probe(on_tpu, backend):
@@ -469,19 +580,216 @@ def _resnet_phase(on_tpu, backend, probe_tflops, net=None):
     _emit()
 
 
-def main():
-    _guard.install()
-    # lease contention can take minutes to clear, but never let the
-    # retry loop eat the whole budget
-    backend = _acquire_backend(max_wait=min(240.0, BUDGET_S / 3))
-    on_tpu = backend not in ("cpu",)
+def _bert_phase(on_tpu, backend):
+    """BERT pretraining samples/sec (SURVEY §6 metric 2), folded into
+    the headline JSON as side fields (`bert_samples_per_sec`). On TPU:
+    BERT-base, batch 32 @ seq 128, ragged valid_length so the Pallas
+    flash-attention kernel engages. On CPU: bert-tiny pipeline check."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.models.bert import bert_base, bert_tiny
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
     if on_tpu:
-        # TPU only: CPU AOT cache entries have bitten us with
-        # machine-feature-mismatch loads (2.5 KB stderr warning per
-        # load — enough to flood the driver's output-tail capture)
-        # and CPU compiles are cheap anyway
-        _enable_compile_cache()
-    _best.update({"backend": backend, "phase": "backend_acquired"})
+        vocab = 30522
+        builder0 = bert_base
+        batch = int(os.environ.get("BENCH_BATCH", 32))
+        seq = int(os.environ.get("BENCH_SEQ", 128))
+        steps = int(os.environ.get("BENCH_STEPS", 12))
+    else:
+        vocab = 512
+        builder0 = lambda: bert_tiny(vocab_size=512)  # noqa: E731
+        batch = int(os.environ.get("BENCH_BATCH", 4))
+        seq = int(os.environ.get("BENCH_SEQ", 64))
+        steps = int(os.environ.get("BENCH_STEPS", 3))
+
+    mx.random.seed(0)
+
+    def build():
+        net = builder0()
+        net.initialize(init=mx.init.Normal(0.02))
+        if on_tpu:
+            amp.init("bfloat16")
+            amp.convert_block(net)
+        return net
+
+    net = _build_net_on_cpu(build, (2, 16), "int32", on_tpu)
+
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(mlm, nsp, labels, mask, nsp_labels):
+        per = ce(mlm.reshape(-1, vocab), labels.reshape(-1))
+        m = mask.reshape(-1).astype("float32")
+        l1 = (per * m).sum() / mx.nd.maximum(m.sum(), mx.nd.array([1.0]))
+        return l1 + ce(nsp, nsp_labels).mean()
+
+    opt = mx.optimizer.AdamW(learning_rate=1e-4, wd=0.01,
+                             multi_precision=True)
+    step = FusedTrainStep(net, loss_fn, opt, n_model_inputs=3)
+
+    rs = np.random.RandomState(0)
+    ids = mx.nd.array(rs.randint(4, vocab, (batch, seq)), dtype="int32")
+    tok = mx.nd.zeros((batch, seq), dtype="int32")
+    # ragged lengths: engages the flash kernel's key-padding path
+    vlen = mx.nd.array(rs.randint(seq // 2, seq + 1, batch),
+                       dtype="int32")
+    labels = mx.nd.array(rs.randint(4, vocab, (batch, seq)),
+                         dtype="int32")
+    mask = mx.nd.array((rs.rand(batch, seq) < 0.15).astype(np.float32))
+    nsp = mx.nd.array(rs.randint(0, 2, batch), dtype="int32")
+
+    t_c = time.perf_counter()
+    float(step(ids, tok, vlen, labels, mask, nsp).asscalar())
+    compile_s = time.perf_counter() - t_c
+    t_w = time.perf_counter()
+    float(step(ids, tok, vlen, labels, mask, nsp).asscalar())
+    step_s = time.perf_counter() - t_w
+    if step_s > 0:  # fit the loop into the remaining budget
+        steps = max(2, min(steps, int(max(0.0, _remaining() - 10.0)
+                                      / (1.1 * step_s))))
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(steps):
+        l = step(ids, tok, vlen, labels, mask, nsp)
+        acc = l if acc is None else acc + l
+    float(acc.asscalar())  # chain-dependent host fetch = honest sync
+    dt = time.perf_counter() - t0
+    sps = batch * steps / dt
+    _best.update({
+        "bert_samples_per_sec": round(sps, 2),
+        # only BERT-base is comparable to the V100 baseline; the CPU
+        # path runs bert_tiny as a pipeline check, not a perf claim
+        "bert_vs_baseline": (round(sps / REFERENCE_BERT_SPS, 3)
+                             if on_tpu else 0.0),
+        "bert_model": "bert_base" if on_tpu else "bert_tiny",
+        "bert_batch": batch, "bert_seq": seq,
+        "bert_compile_s": round(compile_s, 1),
+    })
+    _emit()
+    return sps
+
+
+def _allreduce_phase(backend):
+    """KVStore allreduce GB/s (SURVEY §6 metric 3), folded into the
+    headline JSON as side fields. Single chip measures the fused
+    psum-identity path; a real multi-chip mesh would measure ICI."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from mxnet_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh([n], ["dp"])
+    on_tpu = backend not in ("cpu",)
+    mb = int(os.environ.get("BENCH_MB", 64 if on_tpu else 16))
+    size = mb * 1024 * 1024 // 4  # fp32 elements
+    reps = int(os.environ.get("BENCH_REPS", 10))
+
+    x = jax.device_put(jnp.ones((n, size // n), jnp.float32),
+                       NamedSharding(mesh, P("dp", None)))
+
+    f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                          in_specs=P("dp", None),
+                          out_specs=P("dp", None)))
+
+    @jax.jit
+    def checksum(v):
+        return jnp.sum(v[:, :8])
+
+    float(checksum(f(x)))  # compile + sync
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(reps):
+        y = f(y)
+    float(checksum(y))  # chain-dependent fetch
+    dt = time.perf_counter() - t0
+    # ring allreduce moves 2*(n-1)/n of the buffer per rep
+    bytes_moved = (2 * (n - 1) / n if n > 1 else 1.0) * size * 4 * reps
+    gbps = bytes_moved / dt / 1e9
+    _best.update({
+        "allreduce_gbps": round(gbps, 2),
+        "allreduce_vs_baseline": round(gbps / REFERENCE_ALLREDUCE_GBPS,
+                                       3),
+        "allreduce_devices": n, "allreduce_mb": mb,
+    })
+    _emit()
+    return gbps
+
+
+def _finalize_probe_history(hunter):
+    if hunter is not None:
+        _best["tpu_probe_history"] = hunter.history
+
+
+def _late_tpu_fastpath(hunter, cmd=None):
+    """A probe found a healthy chip after the main process pinned CPU.
+    Backend choice is per-process and already committed, so the on-chip
+    run happens in a FRESH subprocess (`BENCH_TPU_DIRECT=1`): its JSON
+    lines stream back and overwrite the CPU numbers as they land.
+    Returns True if at least one TPU-backed line was recorded."""
+    import subprocess
+
+    hunter.pause()  # probes would contend for the device grant
+    budget = max(45.0, _remaining() - 20.0)
+    print(f"# late TPU fast path: subprocess gets {budget:.0f}s",
+          file=sys.stderr)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["BENCH_TPU_DIRECT"] = "1"
+    env["BENCH_BUDGET_S"] = str(int(budget))
+    if cmd is None:  # overridable for tests
+        cmd = [sys.executable, os.path.abspath(__file__)]
+    # keep the CPU numbers visible even after TPU lines overwrite them
+    cpu_snap = {k: _best.get(k) for k in
+                ("metric", "value", "unit", "backend", "phase")
+                if k in _best}
+    got_tpu = False
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+                         text=True, env=env)
+    try:
+        for line in p.stdout:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if d.get("backend") in (None, "cpu"):
+                continue  # child fell back — ignore
+            if not got_tpu:
+                _best["cpu_fallback_results"] = cpu_snap
+            got_tpu = True
+            d["source"] = "late_tpu_subprocess"
+            _best.update(d)
+            _finalize_probe_history(hunter)
+            _emit()
+    finally:
+        try:
+            p.wait(timeout=15)
+        except Exception:
+            p.kill()
+    if got_tpu:
+        hunter.stop_hunting()
+    else:
+        print("# late TPU fast path recorded nothing; resuming hunt",
+              file=sys.stderr)
+        hunter.found.clear()
+        hunter.resume()
+    return got_tpu
+
+
+def _run_phases(on_tpu, backend, hunter=None):
+    """All benchmark phases, cheapest first, each budget-gated. On the
+    CPU path, a between-phases check hands off to the late-TPU
+    subprocess the moment the hunter lands a healthy probe (further
+    CPU numbers are pointless once real ones exist)."""
+
+    def tpu_arrived():
+        return (hunter is not None and not on_tpu
+                and hunter.found.is_set())
 
     probe_tflops = 0.0
     try:
@@ -489,6 +797,20 @@ def main():
     except Exception as e:
         print(f"# matmul probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+
+    if tpu_arrived() and _late_tpu_fastpath(hunter):
+        return
+
+    # allreduce GB/s: cheapest §6 metric (one tiny psum compile)
+    if _remaining() > 40.0:
+        try:
+            _allreduce_phase(backend)
+        except Exception as e:
+            print(f"# allreduce phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    if tpu_arrived() and _late_tpu_fastpath(hunter):
+        return
 
     # forward-only ResNet-50 score: a real model number with a much
     # cheaper compile than the fused train step
@@ -502,6 +824,9 @@ def main():
             traceback.print_exc()
             print(f"# resnet infer phase failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+
+    if tpu_arrived() and _late_tpu_fastpath(hunter):
+        return
 
     # only attempt the big compile with enough budget left for it to
     # plausibly finish (cached recompile needs far less)
@@ -517,6 +842,87 @@ def main():
     else:
         _best["note"] = "skipped resnet50: insufficient budget remaining"
         _emit()
+
+    if tpu_arrived() and _late_tpu_fastpath(hunter):
+        return
+
+    # BERT samples/sec (§6 metric 2)
+    if _remaining() > 75.0:
+        try:
+            _bert_phase(on_tpu, backend)
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(f"# bert phase failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+def _tpu_direct_main():
+    """Subprocess mode (`BENCH_TPU_DIRECT=1`): a probe already proved
+    the chip healthy, so commit to the default platform directly and
+    run the on-chip phases in priority order. Parent streams our JSON
+    lines. Init may still hit the probe's lingering device lease —
+    retry with a heartbeat."""
+    import jax
+
+    _guard.install()
+    backend = _commit_tpu(max_tries=12)
+    if backend is None or backend == "cpu":
+        print("# tpu-direct: no accelerator in subprocess; exiting",
+              file=sys.stderr)
+        return
+    _enable_compile_cache()
+    _best.update({"backend": backend, "phase": "backend_acquired"})
+    _run_phases(True, backend, hunter=None)
+
+
+def main():
+    if os.environ.get("BENCH_TPU_DIRECT") == "1":
+        return _tpu_direct_main()
+
+    _guard.install()
+    hunter = TpuHunter()
+    _best["tpu_probe_history"] = hunter.history  # live ref: watchdog
+    hunter.start()                               # snapshots see it too
+    hunter.wait_first(timeout=min(120.0, BUDGET_S / 4))
+
+    backend = None
+    if hunter.found.is_set():
+        backend = _commit_tpu()
+    on_tpu = backend not in (None, "cpu")
+    if on_tpu:
+        hunter.stop_hunting()  # chip in hand; probes only contend
+        # TPU only: CPU AOT cache entries have bitten us with
+        # machine-feature-mismatch loads (2.5 KB stderr warning per
+        # load — enough to flood the driver's output-tail capture)
+        # and CPU compiles are cheap anyway
+        _enable_compile_cache()
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        backend = "cpu"
+        if hunter.found.is_set():
+            # probe healthy but main init lost the lease race: clear
+            # and let the hunter re-prove it for the subprocess path
+            hunter.found.clear()
+    _best.update({"backend": backend, "phase": "backend_acquired"})
+
+    _run_phases(on_tpu, backend, hunter=hunter)
+
+    # CPU phases done early + no chip yet: HOLD, keep probing to the
+    # end of the budget — a chip that recovers at minute 7 still gets
+    # its matmul line (round-3 verdict item 1)
+    if not on_tpu and not hunter.found.is_set():
+        while _remaining() > 75.0:
+            if hunter.found.wait(timeout=10.0):
+                break
+        if hunter.found.is_set() and _remaining() > 45.0:
+            _late_tpu_fastpath(hunter)
+
+    _finalize_probe_history(hunter)
+    _emit()
 
 
 if __name__ == "__main__":

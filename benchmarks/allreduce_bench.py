@@ -1,71 +1,37 @@
 """KVStore allreduce bandwidth (SURVEY §6: GB/s).
 
-Measures the 'tpu_sync' gradient-sync path: psum over the dp mesh axis
-inside one jitted step (single chip: measures the fused add/identity
-path; multi-chip: ICI collective bandwidth). One JSON line.
+Standalone wrapper over bench.py's `_allreduce_phase`: psum over the
+dp mesh axis inside one jitted step (single chip: the fused
+add/identity path; multi-chip: ICI collective bandwidth). One JSON
+line, rc always 0. bench.py also folds this metric into its headline
+JSON as `allreduce_gbps`.
 """
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-import numpy as np
-
-from bench import BudgetGuard, _acquire_backend, _enable_compile_cache
-
-REFERENCE_GBPS = 130.0  # NCCL allreduce on 8xV100 NVLink (bus BW)
+from bench import (REFERENCE_ALLREDUCE_GBPS, _allreduce_phase, _best,
+                   _enable_compile_cache, _guard, acquire_backend_once)
 
 
 def main():
-    guard = BudgetGuard("kvstore_allreduce_gbps", "GB/s").install()
-    backend = _acquire_backend(max_wait=min(240.0, guard.budget_s / 3))
+    _guard.best.update({"metric": "kvstore_allreduce_gbps",
+                        "unit": "GB/s"})
+    _guard.install()
+    backend = acquire_backend_once(max_wait=min(120.0, _guard.budget_s / 3))
     if backend not in ("cpu",):  # see bench.py: TPU-only cache
         _enable_compile_cache()
-
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from mxnet_tpu.parallel import make_mesh
-
-    guard.best.update({"backend": backend, "phase": "backend_acquired"})
-    n = len(jax.devices())
-    mesh = make_mesh([n], ["dp"])
-    mb = int(os.environ.get("BENCH_MB", 64))
-    size = mb * 1024 * 1024 // 4  # fp32 elements
-    reps = int(os.environ.get("BENCH_REPS", 10))
-
-    x = jnp.ones((n, size // n), jnp.float32)
-    sh = NamedSharding(mesh, P("dp", None))
-    x = jax.device_put(x, sh)
-
-    from jax.experimental.shard_map import shard_map
-
-    def psum_fn(v):
-        return jax.lax.psum(v, "dp")
-
-    f = jax.jit(shard_map(psum_fn, mesh=mesh, in_specs=P("dp", None),
-                          out_specs=P("dp", None)))
-    f(x).block_until_ready()
-    t0 = time.perf_counter()
-    y = x
-    for _ in range(reps):
-        y = f(y)
-    y.block_until_ready()
-    dt = time.perf_counter() - t0
-    # ring allreduce moves 2*(n-1)/n of the buffer per rep
-    bytes_moved = 2 * (n - 1) / max(n, 1) * size * 4 * reps \
-        if n > 1 else size * 4 * reps
-    gbps = bytes_moved / dt / 1e9
-    guard.best.update({
+    _best.update({"backend": backend, "phase": "backend_acquired"})
+    gbps = _allreduce_phase(backend)
+    _best.update({
         "value": round(gbps, 2),
-        "vs_baseline": round(gbps / REFERENCE_GBPS, 3),
-        "devices": n, "mb": mb, "reps": reps,
+        "vs_baseline": round(gbps / REFERENCE_ALLREDUCE_GBPS, 3),
         "phase": "allreduce",
     })
-    guard.emit()
+    _guard.emit()
 
 
 if __name__ == "__main__":

@@ -1,109 +1,41 @@
 """BERT-base pretraining throughput (SURVEY §6: samples/sec).
 
-Runs the fused train step (fwd+bwd+AdamW in one XLA executable) on
-synthetic MLM+NSP batches, bf16. Budget-guarded like bench.py: the
-BudgetGuard prints best-so-far and exits 0 if BENCH_BUDGET_S expires.
-(The bench feeds full-length batches — no valid_length — so BERT's
-attention takes the exact fused jnp path; with ragged batches the
-Pallas flash kernel's key-padding `lengths` support engages instead.)
+Standalone wrapper over bench.py's `_bert_phase` (fused fwd+bwd+AdamW
+step, bf16 on TPU, ragged valid_length so the Pallas flash-attention
+kernel engages). Budget-guarded like bench.py: the BudgetGuard prints
+best-so-far and exits 0 if BENCH_BUDGET_S expires. bench.py also folds
+this metric into its own headline JSON as `bert_samples_per_sec`; this
+script exists for a focused, full-budget BERT run.
 """
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-import numpy as np
-
-from bench import (BudgetGuard, _acquire_backend, _build_net_on_cpu,
-                   _enable_compile_cache)
-
-REFERENCE_SAMPLES_PER_SEC = 107.0  # ptrendx MXNet BERT-base V100 AMP
+from bench import (REFERENCE_BERT_SPS, _bert_phase, _best,
+                   _enable_compile_cache, _guard, acquire_backend_once)
 
 
 def main():
-    guard = BudgetGuard("bert_base_pretrain_samples_per_sec_per_chip",
-                        "samples/sec").install()
-    backend = _acquire_backend(max_wait=min(240.0, guard.budget_s / 3))
-    if backend not in ("cpu",):  # see bench.py: TPU-only cache
-        _enable_compile_cache()
-
-    import jax
-    import mxnet_tpu as mx
-    from mxnet_tpu import amp, gluon
-    from mxnet_tpu.models.bert import BERTForPretraining
-    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
-
+    _guard.best.update({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "unit": "samples/sec",
+    })
+    _guard.install()
+    backend = acquire_backend_once(max_wait=min(120.0, _guard.budget_s / 3))
     on_tpu = backend not in ("cpu",)
-    guard.best.update({"backend": backend, "phase": "backend_acquired"})
-    batch = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 4))
-    seq = int(os.environ.get("BENCH_SEQ", 128 if on_tpu else 32))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
-    vocab = 30522
-
-    mx.random.seed(0)
-
-    def build():
-        net = BERTForPretraining(vocab_size=vocab)
-        net.initialize(init=mx.init.Normal(0.02))
-        if on_tpu:
-            amp.init("bfloat16")
-            amp.convert_block(net)
-        return net
-
-    # init + deferred materialization on the local CPU backend (no
-    # per-op tunnel RPCs), then one device_put per parameter
-    net = _build_net_on_cpu(build, (2, 16), "int32", on_tpu)
-
-    mlm_ce = gluon.loss.SoftmaxCrossEntropyLoss()
-    nsp_ce = gluon.loss.SoftmaxCrossEntropyLoss()
-
-    def loss_fn(mlm, nsp, labels, mask, nsp_labels):
-        per = mlm_ce(mlm.reshape(-1, vocab), labels.reshape(-1))
-        m = mask.reshape(-1).astype("float32")
-        l1 = (per * m).sum() / mx.nd.maximum(m.sum(),
-                                             mx.nd.array([1.0]))
-        return l1 + nsp_ce(nsp, nsp_labels).mean()
-
-    opt = mx.optimizer.AdamW(learning_rate=1e-4, wd=0.01,
-                             multi_precision=True)
-    step = FusedTrainStep(net, loss_fn, opt)
-
-    rs = np.random.RandomState(0)
-    ids = mx.nd.array(rs.randint(4, vocab, (batch, seq)), dtype="int32")
-    labels = mx.nd.array(rs.randint(4, vocab, (batch, seq)),
-                         dtype="int32")
-    mask = mx.nd.array((rs.rand(batch, seq) < 0.15)
-                       .astype(np.float32))
-    nsp = mx.nd.array(rs.randint(0, 2, batch), dtype="int32")
-
-    t_c = time.perf_counter()
-    float(step(ids, labels, mask, nsp).asscalar())
-    compile_s = time.perf_counter() - t_c
-    t_w = time.perf_counter()
-    float(step(ids, labels, mask, nsp).asscalar())
-    step_s = time.perf_counter() - t_w
-    if step_s > 0:  # fit the loop into the remaining budget
-        steps = max(3, min(steps,
-                           int(max(0.0, guard.remaining() - 5.0)
-                               / step_s)))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        l = step(ids, labels, mask, nsp)
-    float(l.asscalar())
-    dt = time.perf_counter() - t0
-    sps = batch * steps / dt
-    guard.best.update({
+    if on_tpu:  # see bench.py: TPU-only cache
+        _enable_compile_cache()
+    _best.update({"backend": backend, "phase": "backend_acquired"})
+    sps = _bert_phase(on_tpu, backend)
+    _best.update({
         "value": round(sps, 2),
-        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
-        "batch": batch, "seq": seq, "steps": steps,
-        "compile_s": round(compile_s, 1),
-        "step_ms": round(1000.0 * batch / sps, 2),
+        "vs_baseline": round(sps / REFERENCE_BERT_SPS, 3),
         "phase": "bert_pretrain",
     })
-    guard.emit()
+    _guard.emit()
 
 
 if __name__ == "__main__":

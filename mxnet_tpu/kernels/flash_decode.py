@@ -271,9 +271,13 @@ def flash_decode_quantized(q, k8, ks, v8, vs, valid_len, scale=None,
                                            mode == "interpret")
         except Exception as e:
             _fallback.note(e)
+    # cast to q.dtype so both dispatch paths agree (the Pallas kernel's
+    # out_shape is q.dtype; the fp32-dequantized reference would
+    # otherwise leak fp32 into the bf16 decode step)
     return reference_decode_attention(
         q, dequantize_kv(k8, ks, jnp.float32),
-        dequantize_kv(v8, vs, jnp.float32), valid_len, scale)
+        dequantize_kv(v8, vs, jnp.float32), valid_len,
+        scale).astype(q.dtype)
 
 
 def _pallas_mode_q8(k8):

@@ -102,9 +102,13 @@ def multibox_target(anchors, labels, overlap_threshold=0.5):
         onehot = (jax.nn.one_hot(best_anchor, A, dtype=jnp.float32)
                   * valid[..., None])                   # (B, M, A)
         forced = jnp.sum(onehot, axis=1) > 0            # (B, A)
-        # which gt forced this anchor (last valid gt wins on collision)
-        forced_gt = jnp.argmax(
-            onehot * (1.0 + jnp.arange(M)[None, :, None]), axis=1) \
+        # which gt forced this anchor; when two valid gts claim the
+        # same best anchor, the one with the better overlap wins
+        # (upstream multibox_target resolves collisions by IoU, not
+        # gt index) — onehot entries are 0/1, so 1+iou ∈ [1, 2] keeps
+        # every claimant above the zero background
+        iou_mt = jnp.transpose(iou, (0, 2, 1))          # (B, M, A)
+        forced_gt = jnp.argmax(onehot * (1.0 + iou_mt), axis=1) \
             .astype(jnp.int32)
 
         pos = assigned | forced

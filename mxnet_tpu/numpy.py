@@ -67,8 +67,36 @@ class _NpRandom:
 
     @staticmethod
     def multinomial(n=None, pvals=None, size=None, data=None, **kw):
-        src = data if data is not None else pvals
-        return _mx_random.multinomial(src, shape=size, **kw)
+        """numpy semantics: `multinomial(n, pvals, size)` returns
+        per-category draw COUNTS from `n` trials, shape `size + (k,)`
+        (int32 — the framework default integer width; counts are ≤ n).
+        The legacy mx.nd index-sampling form (category ids drawn from
+        probability rows) stays available under the `data=` keyword
+        only (reference: python/mxnet/ndarray/random.py multinomial vs
+        numpy.random.multinomial)."""
+        if data is not None:  # legacy mx.nd.random.multinomial form
+            return _mx_random.multinomial(data, shape=size, **kw)
+        if n is None or pvals is None:
+            raise ValueError("np.random.multinomial(n, pvals, size=...)"
+                             " requires n and pvals")
+        p = (pvals._data if isinstance(pvals, NDArray)
+             else jnp.asarray(pvals, dtype=jnp.float32))
+        k = p.shape[-1]
+        rows = (() if size is None else
+                ((size,) if isinstance(size, int) else tuple(size)))
+        nrows = 1
+        for s in rows:
+            nrows *= int(s)
+        # draw n category ids per output row with the framework RNG
+        # (mx.random.seed determinism), then scatter-add into counts —
+        # O(n + k) memory per row, not the O(n*k) a one-hot would cost
+        tiled = jnp.broadcast_to(p, (nrows, k))
+        idx = _mx_random.multinomial(NDArray(tiled), shape=int(n))
+        ids = idx._data.reshape(nrows, int(n))
+        row = jnp.arange(nrows, dtype=ids.dtype)[:, None]
+        counts = jnp.zeros((nrows, k), jnp.int32).at[
+            jnp.broadcast_to(row, ids.shape), ids].add(1)
+        return NDArray(counts.reshape(rows + (k,)))
 
 
 random = _NpRandom()

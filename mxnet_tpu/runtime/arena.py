@@ -21,6 +21,12 @@ import numpy as np
 
 from . import build as _build
 
+import os as _os
+
+#: debug mode: poison released buffers so use-after-release reads show
+#: a 0xDD sentinel instead of plausible stale data (see release())
+_POISON = _os.environ.get("MXNET_TPU_ARENA_POISON", "0") == "1"
+
 _LIB = None
 _LIB_TRIED = False
 _LOCK = threading.Lock()
@@ -124,10 +130,27 @@ class Arena:
 
     def release(self, arr: np.ndarray):
         """Return a buffer from alloc_ndarray to the pool (dropping the
-        array without calling this also returns it, at gc time)."""
+        array without calling this also returns it, at gc time).
+
+        ALIASING HAZARD: release() does not (cannot) invalidate the
+        caller's numpy view — the next alloc of the same size class
+        hands the same memory (native path: the same raw pointer) to a
+        new owner, so a late write through a stale view silently
+        corrupts that owner. Treat release() like C `free`: the view
+        and every slice of it are dead afterwards. Set
+        ``MXNET_TPU_ARENA_POISON=1`` to fill buffers with 0xDD on
+        release — a stale READ then shows the sentinel instead of
+        plausible data, and the new owner sees poison until it writes
+        (debug aid; reference analogue: MXNET_GPU_MEM_POOL debug
+        fill)."""
         rec = self._live.pop(id(arr), None)
         if rec is None:
             return
+        if _POISON:
+            try:  # best effort: a read-only view shouldn't break release
+                arr.view(np.uint8)[:] = 0xDD
+            except (ValueError, TypeError):
+                pass
         self._return(rec[0], rec[1])
 
     def _return(self, handle, nbytes):

@@ -101,3 +101,14 @@ def test_cap_limits_pool():
     for b in bufs:
         a.release(b)
     assert a.stats()["pooled"] <= 1 << 12
+
+
+def test_poison_on_release(arena, monkeypatch):
+    # MXNET_TPU_ARENA_POISON debug mode: a stale view reads 0xDD after
+    # release instead of plausible stale data
+    from mxnet_tpu.runtime import arena as arena_mod
+    monkeypatch.setattr(arena_mod, "_POISON", True)
+    b = arena.alloc_ndarray(256)
+    b[:] = 42
+    arena.release(b)
+    assert (b == 0xDD).all()  # the view itself shows the sentinel

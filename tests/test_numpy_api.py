@@ -185,3 +185,21 @@ def test_svd_explicit_kwarg_overrides_default():
     assert u.shape == (3, 3) and vt.shape == (3, 5)
     uf, sf, vtf = np.linalg.svd(np.array(a), full_matrices=True)
     assert uf.shape == (3, 3) and vtf.shape == (5, 5)
+
+
+def test_np_random_multinomial_counts_semantics():
+    # numpy semantics: per-category draw COUNTS from n trials
+    mx.random.seed(0)
+    out = np.random.multinomial(100, [0.3, 0.7])
+    assert out.shape == (2,)
+    assert int(out.asnumpy().sum()) == 100
+    out = np.random.multinomial(50, [0.25, 0.25, 0.5], size=(3,))
+    assert out.shape == (3, 3)
+    assert (out.asnumpy().sum(axis=-1) == 50).all()
+    # statistical sanity on a skewed distribution
+    out = np.random.multinomial(1000, [0.9, 0.1]).asnumpy()
+    assert out[0] > 700 and out[1] < 300
+    # the legacy mx.nd index-sampling form survives under data= only
+    idx = np.random.multinomial(data=mx.nd.array([0.5, 0.5]), size=16)
+    a = idx.asnumpy()
+    assert a.shape == (16,) and set(a.tolist()) <= {0, 1}

@@ -129,3 +129,22 @@ def test_ssd_overfits_one_batch():
         tr.step(1)
         losses.append(float(l.asscalar()))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_multibox_target_forced_match_collision_prefers_iou():
+    # two valid gts claim the SAME best anchor (anchor 0); upstream
+    # multibox_target resolves the collision by best overlap, so the
+    # exact-match gt (class 0, IoU 1.0) must win over the later-indexed
+    # partial-overlap gt (class 1, IoU 0.5)
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.4, 0.4],
+                                  [0.9, 0.9, 1.0, 1.0]]],
+                                dtype=np.float32))
+    labels = nd.array(np.array([[[0, 0.0, 0.0, 0.4, 0.4],
+                                 [1, 0.0, 0.0, 0.2, 0.4]]],
+                               dtype=np.float32))
+    bt, bm, ct = nd.contrib.multibox_target(anchors, labels)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0  # class 0 + 1 (old index tie-break gave 2.0)
+    # and the regression offsets are the exact match's zeros
+    np.testing.assert_allclose(bt.asnumpy()[0].reshape(2, 4)[0], 0.0,
+                               atol=1e-5)
