@@ -17,6 +17,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from . import tuning
 from .dispatch import KernelFallback
 
 __all__ = ["flash_attention_raw", "reference_attention"]
@@ -86,9 +87,14 @@ def _mask_lengths(s, ki, block_k, len_b):
     return jnp.where(kpos < len_b, s, -jnp.inf)
 
 
-def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
+def _pallas_forward(q, k, v, causal, scale, block_q=None, block_k=None,
                     interpret=False, return_lse=False, lengths=None):
     has_len = lengths is not None
+    plat = "cpu" if interpret else "tpu"
+    if block_q is None:
+        block_q = tuning.get("flash_attention", "block_q", plat)
+    if block_k is None:
+        block_k = tuning.get("flash_attention", "block_k", plat)
     """Online-softmax flash forward in Pallas (TPU; interpret=True runs
     the same kernel under the Pallas interpreter for CPU testing).
 
@@ -191,9 +197,14 @@ def _pallas_forward(q, k, v, causal, scale, block_q=256, block_k=256,
 
 
 def _pallas_backward(q, k, v, lse, delta, dout, causal, scale,
-                     block_q=256, block_k=256, interpret=False,
+                     block_q=None, block_k=None, interpret=False,
                      lengths=None):
     has_len = lengths is not None
+    plat = "cpu" if interpret else "tpu"
+    if block_q is None:
+        block_q = tuning.get("flash_attention", "block_q", plat)
+    if block_k is None:
+        block_k = tuning.get("flash_attention", "block_k", plat)
     """O(T)-memory flash backward: dQ/dK/dV via block recomputation
     against the saved log-sum-exp — no (T, T) score matrix is ever
     materialized. delta is rowsum(dO * O), shape (B, H, T).

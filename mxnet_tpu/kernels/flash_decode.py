@@ -289,8 +289,14 @@ def _pallas_mode_q8(k8):
 # one kv head's K+V must fit VMEM (~16 MiB/core) next to the working
 # blocks; beyond this the (B, K)-grid kernel would fail at Mosaic
 # compile time INSIDE the caller's jit — where the try/except above
-# cannot catch it — so gate on static shapes instead
-_VMEM_CACHE_BUDGET_BYTES = 10 << 20
+# cannot catch it — so gate on static shapes instead. The byte budget
+# is tunable (kernels/tuning.py: flash_decode.vmem_cache_budget_bytes)
+
+
+def _vmem_cache_budget():
+    from . import tuning
+
+    return tuning.get("flash_decode", "vmem_cache_budget_bytes")
 
 
 def _pallas_mode(k_cache):
@@ -305,7 +311,7 @@ def _gate(cache_operand, cache_bytes):
     eager call on CPU-committed data must never attempt Mosaic."""
     if cache_operand.shape[2] % 128 != 0:
         return None
-    if cache_bytes > _VMEM_CACHE_BUDGET_BYTES:
+    if cache_bytes > _vmem_cache_budget():
         return None
     if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
         return "interpret"

@@ -70,7 +70,10 @@ def reference_softmax_ce(x2, lbl):
 
 
 def _pick_rows(n, v):
-    return pick_rows(n, v, want=256)
+    from . import tuning
+
+    return pick_rows(n, v, want=tuning.get("fused_ce",
+                                           "row_block_want"))
 
 
 def _pad_cols_neg(x2, mult=128):

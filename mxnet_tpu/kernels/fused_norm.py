@@ -43,9 +43,17 @@ def _pallas_mode():
 
 
 # block sizing/padding shared across kernel families (dispatch.py):
-# 4 MiB fp32 VMEM budget, power-of-two rows, 8-sublane minimum
+# tuned row target + VMEM byte budget (kernels/tuning.py; autotuned by
+# benchmarks/autotune_kernels.py), power-of-two rows, 8-sublane minimum
+from . import tuning as _tuning  # noqa: E402
 from .dispatch import pad_rows as _pad_rows  # noqa: E402
-from .dispatch import pick_rows as _pick_rows  # noqa: E402
+from .dispatch import pick_rows as _pick_rows_raw  # noqa: E402
+
+
+def _pick_rows(n, d):
+    return _pick_rows_raw(
+        n, d, want=_tuning.get("fused_norm", "row_block_want"),
+        budget_bytes=_tuning.get("fused_norm", "vmem_budget_bytes"))
 
 
 # ---------------------------------------------------------------- RMSNorm

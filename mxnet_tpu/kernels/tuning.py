@@ -1,0 +1,98 @@
+"""Tuned kernel constants (reference analogue: the fork's per-arch
+kernel tuning — cuDNN autotune / MSHADOW tuning env knobs).
+
+Every perf-sensitive Pallas constant (flash-attention block sizes,
+norm/CE row-block targets, the flash-decode VMEM gate) resolves through
+`get(family, key)` so a measured sweep can re-tune them WITHOUT code
+edits: `benchmarks/autotune_kernels.py` sweeps the space on whatever
+backend is available and (with --write) commits the winners to
+`tuned.json` next to this file, keyed by platform. Lookup order:
+
+    tuned.json[platform][family][key]   (platform = jax.default_backend())
+    tuned.json["any"][family][key]
+    DEFAULTS[family][key]
+
+The committed defaults below are the round-3 hand-chosen values —
+UNMEASURED on-chip until an autotune run lands (PERF.md tracks which).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+__all__ = ["get", "DEFAULTS", "tuned_path", "reload", "set_runtime",
+           "clear_runtime"]
+
+#: hand-chosen starting points (see each kernel module for the
+#: constraint story: Mosaic (8, 128) tiling, ~16 MiB VMEM/core)
+DEFAULTS = {
+    "flash_attention": {"block_q": 256, "block_k": 256},
+    "fused_norm": {"row_block_want": 512,
+                   "vmem_budget_bytes": 4 << 20},
+    "fused_ce": {"row_block_want": 256},
+    "flash_decode": {"vmem_cache_budget_bytes": 10 << 20},
+}
+
+_cache: Optional[dict] = None
+
+#: in-process overrides, highest priority — the autotune harness sets
+#: these while sweeping candidate values (no file writes mid-sweep)
+_runtime: dict = {}
+
+
+def set_runtime(family: str, key: str, value) -> None:
+    _runtime[(family, key)] = value
+
+
+def clear_runtime() -> None:
+    _runtime.clear()
+
+
+def tuned_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tuned.json")
+
+
+def _table() -> dict:
+    global _cache
+    if _cache is None:
+        try:
+            with open(tuned_path()) as f:
+                _cache = json.load(f)
+        except (OSError, ValueError):
+            _cache = {}
+    return _cache
+
+
+def reload() -> None:
+    """Drop the cached tuned.json (tests; post-autotune refresh)."""
+    global _cache
+    _cache = None
+
+
+def _platform() -> str:
+    # default_backend() would force backend init (dials the tunnel on
+    # axon); kernels only consult tuning at trace time, when a backend
+    # already exists — but stay safe and fall back to "any"
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "any"
+
+
+def get(family: str, key: str, platform: Optional[str] = None):
+    """Tuned value for `family.key` on `platform` (default: current
+    jax backend), falling back to the "any" section, then DEFAULTS."""
+    if (family, key) in _runtime:
+        return _runtime[(family, key)]
+    tab = _table()
+    plat = platform if platform is not None else _platform()
+    for section in (plat, "any"):
+        try:
+            return tab[section][family][key]
+        except (KeyError, TypeError):
+            pass
+    return DEFAULTS[family][key]

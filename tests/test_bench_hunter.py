@@ -13,8 +13,9 @@ import bench
 
 @pytest.fixture(autouse=True)
 def _fresh_guard(monkeypatch):
-    # each test gets its own guard/best so history and merges don't leak
-    g = bench.BudgetGuard("m", "u", budget_s=30.0)
+    # each test gets its own guard/best so history and merges don't
+    # leak; budget must clear _late_tpu_fastpath's 60 s minimum
+    g = bench.BudgetGuard("m", "u", budget_s=300.0)
     monkeypatch.setattr(bench, "_guard", g)
     monkeypatch.setattr(bench, "_best", g.best)
     yield g
