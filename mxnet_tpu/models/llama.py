@@ -9,17 +9,13 @@ expressed as shardings, not comms).
 """
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
 
-from .. import nd
 from ..gluon import nn
 from ..gluon.block import HybridBlock
-from ..gluon.parameter import Parameter
 from ..ndarray import NDArray, invoke
 from ..parallel.mesh import P
-from . import register_model
+from . import llama_math, register_model
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
            "llama_3_8b"]
@@ -52,34 +48,13 @@ def _dense(units, in_units, dtype, sharding):
     return d
 
 
-def _rope(q, base):
-    """Apply rotary embeddings to (B, T, H, d)."""
-    B, T, H, d = q.shape
-    half = d // 2
-    pos = jnp.arange(T, dtype=jnp.float32)
-    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = pos[:, None] * inv[None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
-    cos = jnp.cos(ang)[None, :, None, :]
-    q1, q2 = q[..., :half], q[..., half:]
-    qf = q.astype(jnp.float32)
-    q1, q2 = qf[..., :half], qf[..., half:]
-    return jnp.concatenate([q1 * cos - q2 * sin,
-                            q2 * cos + q1 * sin], axis=-1).astype(q.dtype)
-
-
-def causal_attention(q, k, v, scale=None, use_flash=True):
-    """Fused causal attention on (B, T, H, d)/(B, T, K, d) with GQA.
-    Dispatches to the Pallas flash kernel on TPU."""
-    from ..kernels.flash_attention import flash_attention_raw
-
-    def f(q_, k_, v_):
-        return flash_attention_raw(q_, k_, v_, causal=True, scale=scale,
-                                   use_flash=use_flash)
-    return invoke(f, [q, k, v])
-
-
 class LlamaAttention(HybridBlock):
+    """Parameter container for the attention projections (TP-annotated
+    Dense blocks). The forward math lives in llama_math.decoder_layer —
+    LlamaLayer routes one invoke through it — so there is exactly ONE
+    definition of the attention computation (no drift between training
+    and the cached-decode path)."""
+
     def __init__(self, cfg: LlamaConfig, **kw):
         super().__init__(**kw)
         self.cfg = cfg
@@ -90,23 +65,11 @@ class LlamaAttention(HybridBlock):
         self.v_proj = _dense(K * d, D, cfg.dtype, P("tp", None))
         self.o_proj = _dense(D, H * d, cfg.dtype, P(None, "tp"))
 
-    def forward(self, x):
-        cfg = self.cfg
-        B, T, D = x.shape
-        q = self.q_proj(x).reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = self.k_proj(x).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = self.v_proj(x).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        base = cfg.rope_base
-
-        def rope_op(t):
-            return invoke(lambda a: _rope(a, base), [t])
-        q = rope_op(q)
-        k = rope_op(k)
-        out = causal_attention(q, k, v)
-        return self.o_proj(out.reshape(B, T, -1))
-
 
 class LlamaMLP(HybridBlock):
+    """Parameter container for the SwiGLU projections (see
+    LlamaAttention's docstring — the math is llama_math.swiglu)."""
+
     def __init__(self, cfg: LlamaConfig, **kw):
         super().__init__(**kw)
         D, I = cfg.hidden_size, cfg.intermediate_size
@@ -114,13 +77,11 @@ class LlamaMLP(HybridBlock):
         self.up_proj = _dense(I, D, cfg.dtype, P("tp", None))
         self.down_proj = _dense(D, I, cfg.dtype, P(None, "tp"))
 
-    def forward(self, x):
-        return self.down_proj(nd.silu(self.gate_proj(x)) * self.up_proj(x))
-
 
 class LlamaLayer(HybridBlock):
     def __init__(self, cfg: LlamaConfig, **kw):
         super().__init__(**kw)
+        self.cfg = cfg
         self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
                                           epsilon=cfg.rms_eps)
         self.self_attn = LlamaAttention(cfg)
@@ -129,8 +90,30 @@ class LlamaLayer(HybridBlock):
         self.mlp = LlamaMLP(cfg)
 
     def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
-        return x + self.mlp(self.post_attention_layernorm(x))
+        # the entire layer is ONE invoke over llama_math.decoder_layer
+        # — the same function the cached-decode prefill runs — so the
+        # training and inference architectures cannot drift apart
+        cfg = self.cfg
+        attn, mlp = self.self_attn, self.mlp
+        weights = [self.input_layernorm.gamma.data(),
+                   attn.q_proj.weight.data(),
+                   attn.k_proj.weight.data(),
+                   attn.v_proj.weight.data(),
+                   attn.o_proj.weight.data(),
+                   self.post_attention_layernorm.gamma.data(),
+                   mlp.gate_proj.weight.data(),
+                   mlp.up_proj.weight.data(),
+                   mlp.down_proj.weight.data()]
+
+        def f(xr, ln1, wq, wk, wv, wo, ln2, gate, up, down):
+            lp = {"ln1": ln1, "wq": wq, "wk": wk, "wv": wv, "wo": wo,
+                  "ln2": ln2, "gate": gate, "up": up, "down": down}
+            return llama_math.decoder_layer(
+                lp, xr, jnp.arange(xr.shape[1]), cfg.rms_eps,
+                cfg.rope_base, cfg.num_heads, cfg.num_kv_heads,
+                cfg.head_dim)
+
+        return invoke(f, [x] + weights)
 
 
 class LlamaModel(HybridBlock):

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ndarray import NDArray
+from . import llama_math
 
 __all__ = ["generate", "generate_beam", "build_decoder"]
 
@@ -52,28 +53,9 @@ def _params_tree(net):
             "layers": layers}
 
 
-def _rms(x, g, eps):
-    xf = x.astype(jnp.float32)
-    r = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (r * g.astype(jnp.float32)).astype(x.dtype)
-
-
-def _rope_at(x, positions, base):
-    """RoPE for (B, T, H, d) at absolute `positions` (B, T) or (T,)."""
-    d = x.shape[-1]
-    half = d // 2
-    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = jnp.asarray(positions, jnp.float32)
-    if pos.ndim == 1:
-        pos = pos[None, :]
-    ang = pos[..., None] * inv  # (B, T, half)
-    sin = jnp.sin(ang)[:, :, None, :]
-    cos = jnp.cos(ang)[:, :, None, :]
-    xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., :half], xf[..., half:]
-    return jnp.concatenate([x1 * cos - x2 * sin,
-                            x2 * cos + x1 * sin],
-                           axis=-1).astype(x.dtype)
+# the layer math itself (RMSNorm, RoPE, SwiGLU, residual wiring) is
+# single-sourced in llama_math.py — this module owns ONLY the cache
+# plumbing and the sampling/beam loops
 
 
 def _attend(q, k_cache, v_cache, valid_len, cfg):
@@ -126,18 +108,7 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
     cfg = net.model.cfg
     params = _params_tree(net)
     q8 = kv_cache_dtype == "int8"
-
-    def layer_fwd(lp, x, positions):
-        B, T, D = x.shape
-        h = _rms(x, lp["ln1"], cfg.rms_eps)
-        q = (h @ lp["wq"].T).reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = (h @ lp["wk"].T).reshape(B, T, cfg.num_kv_heads,
-                                     cfg.head_dim)
-        v = (h @ lp["wv"].T).reshape(B, T, cfg.num_kv_heads,
-                                     cfg.head_dim)
-        q = _rope_at(q, positions, cfg.rope_base)
-        k = _rope_at(k, positions, cfg.rope_base)
-        return q, k, v
+    H, K, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     def prefill(params, ids, valid_len):
         B, T = ids.shape
@@ -145,39 +116,20 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
         positions = jnp.arange(T)
         cache = []
         for lp in params["layers"]:
-            q, k, v = layer_fwd(lp, x, positions)
+            # THE training layer (llama_math.decoder_layer — same flash
+            # -attention dispatch), with ragged prompt lengths; k/v come
+            # back post-RoPE for the cache
+            x, k, v = llama_math.decoder_layer(
+                lp, x, positions, cfg.rms_eps, cfg.rope_base, H, K, d,
+                lengths=valid_len, return_kv=True)
             # cache-native (B, K, S, d): one transpose per PREFILL, so
             # the per-token decode loop never copies the cache
-            k_c = jnp.zeros((B, cfg.num_kv_heads, max_len,
-                             cfg.head_dim), x.dtype)
+            k_c = jnp.zeros((B, K, max_len, d), x.dtype)
             v_c = jnp.zeros_like(k_c)
             k_c = lax.dynamic_update_slice(
                 k_c, k.transpose(0, 2, 1, 3), (0, 0, 0, 0))
             v_c = lax.dynamic_update_slice(
                 v_c, v.transpose(0, 2, 1, 3), (0, 0, 0, 0))
-            # causal within the prompt: token t sees <= t and < valid
-            S = max_len
-            pos_q = positions[None, :]
-            pos_k = jnp.arange(S)[None, :]
-            causal = pos_k[:, None, :] <= pos_q[:, :, None]  # (1,T,S)
-            vmask = pos_k[:, None, :] < valid_len[:, None, None]
-            rep = cfg.num_heads // cfg.num_kv_heads
-            scale = 1.0 / math.sqrt(cfg.head_dim)
-            qr = q.reshape(B, T, cfg.num_kv_heads, rep,
-                           cfg.head_dim).astype(jnp.float32)
-            s = jnp.einsum("btkrd,bksd->bkrts", qr,
-                           k_c.astype(jnp.float32)) * scale
-            m = (causal & vmask)[:, None, None, :, :]
-            s = jnp.where(m, s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1)
-            att = jnp.einsum("bkrts,bksd->bkrtd", p,
-                             v_c.astype(jnp.float32))
-            att = att.transpose(0, 3, 1, 2, 4).reshape(
-                B, T, cfg.num_heads, cfg.head_dim).astype(x.dtype)
-            x = x + att.reshape(B, T, -1) @ lp["wo"].T
-            h2 = _rms(x, lp["ln2"], cfg.rms_eps)
-            x = x + (jax.nn.silu(h2 @ lp["gate"].T) *
-                     (h2 @ lp["up"].T)) @ lp["down"].T
             if q8:
                 from ..kernels.flash_decode import quantize_kv
                 k8_, ks_, v8_, vs_ = quantize_kv(k_c, v_c)
@@ -185,7 +137,7 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
                               "vs": vs_})
             else:
                 cache.append({"k": k_c, "v": v_c})
-        x = _rms(x, params["norm"], cfg.rms_eps)
+        x = llama_math.rms(x, params["norm"], cfg.rms_eps)
         # logits at each batch row's last valid position
         idx = jnp.maximum(valid_len - 1, 0)
         last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
@@ -206,7 +158,9 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
 
         new_cache = []
         for lp, c in zip(params["layers"], cache):
-            q, k, v = layer_fwd(lp, x, pos[:, None])
+            q, k, v = llama_math.layer_qkv(lp, x, pos[:, None],
+                                           cfg.rms_eps, cfg.rope_base,
+                                           H, K, d)
             kt = k.transpose(0, 2, 1, 3)           # (B, K, 1, d)
             vt = v.transpose(0, 2, 1, 3)
             if q8:
@@ -224,13 +178,10 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
                 nc = {"k": write_row(c["k"], kt, pos),
                       "v": write_row(c["v"], vt, pos)}
                 att = _attend(q, nc["k"], nc["v"], pos + 1, cfg)
-            x = x + att.reshape(B, 1, -1) @ lp["wo"].T
-            h2 = _rms(x, lp["ln2"], cfg.rms_eps)
-            x = x + (jax.nn.silu(h2 @ lp["gate"].T) *
-                     (h2 @ lp["up"].T)) @ lp["down"].T
+            x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
             new_cache.append(nc)
-        x = _rms(x, params["norm"], cfg.rms_eps)
-        return new_cache, (x @ params["head"].T)[:, 0]
+        return new_cache, llama_math.final_logits(params, x,
+                                                  cfg.rms_eps)[:, 0]
 
     return params, prefill, step
 

@@ -17,3 +17,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process spawns, example smoke runs, heavy model "
+        "tests — the fast tier is `pytest -m 'not slow'` (<8 min); "
+        "the FULL suite remains the snapshot gate")

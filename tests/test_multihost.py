@@ -122,6 +122,7 @@ TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_two_process_training_matches_single_process(tmp_path):
     """DP training across 2 processes lands bit-for-bit on the
     single-process weights — multihost upgraded from 'wiring verified'
@@ -151,6 +152,7 @@ def test_two_process_training_matches_single_process(tmp_path):
         assert f"TRAIN_PARITY_OK {pid}" in out, out
 
 
+@pytest.mark.slow
 def test_two_process_distributed_init(tmp_path):
     port = _free_port()
     coord = f"127.0.0.1:{port}"

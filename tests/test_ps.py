@@ -151,6 +151,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_dist_sync_kvstore_two_processes(tmp_path):
     srv = PSServer(mode="sync", num_workers=2).start()
     script = tmp_path / "ps_worker.py"

@@ -37,6 +37,7 @@ def test_quantized_dense_matches_fp32():
     assert np.max(np.abs(out - ref)) < 0.05 * np.abs(ref).max()
 
 
+@pytest.mark.slow
 def test_quantize_net_lenet_accuracy_within_1pct():
     X, y = _toy_images()
     mx.random.seed(0)
@@ -169,6 +170,7 @@ def test_calibrate_restores_hybridization():
     assert net._active, "calibrate() must restore hybridize state"
 
 
+@pytest.mark.slow
 def test_quantize_mobilenet_v2_accuracy_within_1pct():
     # the reference's own quantization demo net: depthwise/grouped convs
     # + pooling/flatten pass-through end-to-end (reference:
