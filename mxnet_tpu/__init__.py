@@ -42,6 +42,7 @@ from . import profiler
 from . import tracing
 from . import parallel
 from . import io
+from . import operator
 from . import quantization
 from . import image
 from . import recordio

@@ -1,0 +1,192 @@
+"""`mx.operator` — the Python custom-operator registration path
+(reference: python/mxnet/operator.py CustomOp/CustomOpProp/register;
+src/operator/custom/custom.cc). Lets MXNet codebases port their custom
+ops: subclass `CustomOp` (forward/backward with `assign`), describe it
+with a `CustomOpProp` (list_arguments/list_outputs/infer_shape/
+infer_type/create_operator), `@register("name")` it, then call it from
+every front end:
+
+    y  = mx.nd.Custom(x, op_type="my_sigmoid")      # eager (+autograd)
+    sy = mx.sym.Custom(sx, op_type="my_sigmoid")    # symbolic / Module
+    # inside a HybridBlock.forward: works hybridized too
+
+TPU-first translation: the imperative forward/backward pair becomes ONE
+pure function carrying a `jax.custom_vjp` — the user's `backward` IS
+the vjp — dispatched through the `invoke` chokepoint, so autograd
+recording, `hybridize()` tracing, `jax.eval_shape` symbol shape
+inference, and Module execution all work unchanged. `out_data` /
+`in_grad` are preallocated NDArray holders the user fills with
+`assign` (req='write'/'add'/'null'), exactly the upstream calling
+convention; in-place rebinding of the holder's `_data` is sound because
+XLA arrays are functional.
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .base import resolve_dtype
+from .ndarray import NDArray, invoke
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom"]
+
+
+class CustomOp:
+    """Base class of a custom operator's compute (reference:
+    mxnet.operator.CustomOp). Implement `forward` (and `backward` when
+    the op is differentiable); write results with `assign`."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst: NDArray, req: str, src):
+        """dst[:] = src honoring req ('write'/'inplace' overwrite,
+        'add' accumulates, 'null' drops)."""
+        raw = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        if req in ("write", "inplace"):
+            dst._data = raw.astype(dst._data.dtype) \
+                if raw.dtype != dst._data.dtype else raw
+        elif req == "add":
+            dst._data = dst._data + raw
+        elif req != "null":
+            raise ValueError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (reference:
+    mxnet.operator.CustomOpProp). Defaults mirror upstream: one input
+    'data', one output 'output', shapes/types pass through."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        if self.need_top_grad_:
+            return out_grad + in_data + out_data
+        return in_data + out_data
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(reg_name: str):
+    """@mx.operator.register("name") over a CustomOpProp subclass."""
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return wrap
+
+
+def get(reg_name: str) -> Type[CustomOpProp]:
+    return _REGISTRY[reg_name]
+
+
+def _instantiate(prop: CustomOpProp, raw):
+    shapes = [tuple(r.shape) for r in raw]
+    dtypes = [str(r.dtype) for r in raw]
+    in_shapes, out_shapes, _ = prop.infer_shape(list(shapes))
+    in_types, out_types, _ = prop.infer_type(list(dtypes))
+    op = prop.create_operator(None, in_shapes, in_types)
+    return op, out_shapes, out_types
+
+
+def _build_custom_fn(prop: CustomOpProp, is_train: bool, n_out: int):
+    """The pure jax function (with custom_vjp) for one Custom call.
+    Holders are fresh per invocation, so the function is pure from
+    XLA's point of view even though the user code mutates wrappers."""
+
+    def run_forward(raw):
+        op, out_shapes, out_types = _instantiate(prop, raw)
+        in_nd = [NDArray(r) for r in raw]
+        out_nd = [NDArray(jnp.zeros(s, resolve_dtype(t)))
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_nd, out_data=out_nd, aux=[])
+        outs = tuple(o._data for o in out_nd)
+        return outs if n_out > 1 else outs[0]
+
+    @jax.custom_vjp
+    def custom_fn(*raw):
+        return run_forward(raw)
+
+    def fwd(*raw):
+        outs = run_forward(raw)
+        return outs, raw
+
+    def bwd(raw, g):
+        # upstream contract: backward runs on the SAME CustomOp
+        # instance whose forward just ran, so user code may stash
+        # state on self (masks, argmaxes). jax traces fwd and bwd
+        # separately — a fwd-trace value stashed on self would be a
+        # leaked tracer here — so rematerialize instead: re-run the
+        # user's forward on a fresh instance inside the bwd trace,
+        # which rebuilds the self-stash AND the out_data. XLA's CSE
+        # folds the recompute into the saved forward when possible
+        # (and it is the standard remat FLOPs-for-memory trade when
+        # not).
+        op, out_shapes, out_types = _instantiate(prop, raw)
+        in_nd = [NDArray(r) for r in raw]
+        out_nd = [NDArray(jnp.zeros(s, resolve_dtype(t)))
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_nd, out_data=out_nd, aux=[])
+        g_t = g if n_out > 1 else (g,)
+        og_nd = [NDArray(jnp.asarray(x)) for x in g_t]
+        in_grad = [NDArray(jnp.zeros_like(r)) for r in raw]
+        op.backward(req=["write"] * len(raw), out_grad=og_nd,
+                    in_data=in_nd, out_data=out_nd, in_grad=in_grad,
+                    aux=[])
+        return tuple(ig._data for ig in in_grad)
+
+    custom_fn.defvjp(fwd, bwd)
+    return custom_fn
+
+
+def Custom(*data, op_type: str = None, **kwargs):
+    """`mx.nd.Custom(*inputs, op_type="name", **prop_kwargs)` — run a
+    registered custom op. The symbolic twin `mx.sym.Custom` comes free
+    from the sym namespace's nd mirroring; hybridize works because the
+    whole op is one invoke."""
+    if op_type is None or op_type not in _REGISTRY:
+        raise ValueError(
+            f"op_type {op_type!r} is not a registered custom op "
+            f"(known: {sorted(_REGISTRY)})")
+    prop = _REGISTRY[op_type](**kwargs)
+    if prop.list_auxiliary_states():
+        raise NotImplementedError(
+            "auxiliary states on custom ops are not supported; hold "
+            "state in Gluon Parameters instead")
+    n_out = len(prop.list_outputs())
+    n_args = len(prop.list_arguments())
+    if len(data) != n_args:
+        raise ValueError(f"{op_type} expects {n_args} inputs "
+                         f"({prop.list_arguments()}), got {len(data)}")
+    fn = _build_custom_fn(prop, autograd.is_training(), n_out)
+    return invoke(fn, list(data), n_out=n_out)

@@ -1,0 +1,191 @@
+"""mx.operator.CustomOp registration path (reference:
+python/mxnet/operator.py + the docs' custom-sigmoid example): the same
+registered op must run eager (with autograd through the user's
+backward), hybridized, via mx.sym, and inside mx.mod.Module."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    """The canonical upstream example: sigmoid with a hand-written
+    backward that deliberately differs from autodiff by a marker
+    factor, so tests can prove the USER's backward ran."""
+
+    def __init__(self, grad_scale=1.0):
+        super().__init__(need_top_grad=True)
+        self.grad_scale = float(grad_scale)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sigmoid(self.grad_scale)
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def __init__(self, grad_scale):
+        self.grad_scale = grad_scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0],
+                    out_grad[0] * y * (1.0 - y) * self.grad_scale)
+
+
+@mx.operator.register("test_split_pair")
+class SplitPairProp(mx.operator.CustomOpProp):
+    """Multi-output op: (x) -> (2x, -x)."""
+
+    def list_outputs(self):
+        return ["double", "neg"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SplitPair()
+
+
+class SplitPair(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 2.0)
+        self.assign(out_data[1], req[1], -in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0] * 2.0 - out_grad[1])
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.array([-1.0, 0.0, 2.0], np.float32))
+    y = nd.Custom(x, op_type="test_sigmoid")
+    np.testing.assert_allclose(y.asnumpy(), _sig(x.asnumpy()),
+                               rtol=1e-6)
+
+
+def test_custom_autograd_uses_user_backward():
+    # grad_scale=3 marks the user's backward: autodiff of the forward
+    # alone would give sig'(x); getting 3*sig'(x) proves CustomOp
+    # .backward supplied the vjp
+    x = nd.array(np.array([[0.5, -0.25]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid", grad_scale=3.0)
+        loss = y.sum()
+    loss.backward()
+    s = _sig(x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(), 3.0 * s * (1 - s),
+                               rtol=1e-5)
+
+
+def test_custom_multi_output_and_grads():
+    x = nd.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.Custom(x, op_type="test_split_pair")
+        loss = (a * 1.0).sum() + (b * 10.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.asnumpy(), 2.0 * x.asnumpy())
+    np.testing.assert_allclose(b.asnumpy(), -x.asnumpy())
+    # d/dx (2x) * 1 + d/dx(-x) * 10 = 2 - 10 = -8
+    np.testing.assert_allclose(x.grad.asnumpy(), -8.0 * np.ones(4))
+
+
+def test_custom_in_hybridized_block():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(4, in_units=3)
+
+        def forward(self, x):
+            return nd.Custom(self.dense(x), op_type="test_sigmoid")
+
+    net = Net()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-6)
+    # gradient flows through the custom op into the Dense weight
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g = net.dense.weight.grad()
+    assert float(nd.abs(g).sum().asscalar()) > 0.0
+    tr.step(1)
+
+
+def test_custom_symbol_and_module():
+    sx = mx.sym.Variable("data")
+    sy = mx.sym.Custom(sx, op_type="test_sigmoid")
+    # symbolic eval
+    x = nd.array(np.array([0.0, 1.0], np.float32))
+    (out,) = sy.eval(data=x)
+    np.testing.assert_allclose(out.asnumpy(), _sig(x.asnumpy()),
+                               rtol=1e-6)
+    # shape inference through jax.eval_shape
+    _, out_shapes, _ = sy.infer_shape(data=(5, 7))
+    assert out_shapes == [(5, 7)]
+    # Module fit path: sigmoid then FC trains on a toy problem
+    w = mx.sym.Variable("fc_weight", shape=(2, 3))
+    b = mx.sym.Variable("fc_bias", shape=(2,))
+    net = mx.sym.FullyConnected(sy, w, b, num_hidden=2, name="fc")
+    mod = mx.mod.Module(net, data_names=["data"], label_names=None)
+    mod.bind(data_shapes=[("data", (4, 3))])
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        data=[nd.array(np.random.RandomState(1).rand(4, 3)
+                       .astype(np.float32))], label=None)
+    mod.forward(batch)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 2)
+
+
+def test_custom_unknown_op_type_raises():
+    with pytest.raises(ValueError):
+        nd.Custom(nd.zeros((2,)), op_type="never_registered")
+
+
+@mx.operator.register("test_stash_relu")
+class StashReluProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return StashRelu()
+
+
+class StashRelu(mx.operator.CustomOp):
+    """The canonical upstream self-stash pattern: forward saves a mask
+    on self, backward reads it (upstream runs both on one instance;
+    here backward rematerializes forward on its instance first)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.mask = in_data[0] > 0.0
+        self.assign(out_data[0], req[0],
+                    in_data[0] * self.mask.astype("float32"))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0] * self.mask.astype("float32"))
+
+
+def test_custom_self_stash_state_reaches_backward():
+    x = nd.array(np.array([-2.0, -0.5, 0.5, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_stash_relu")
+        loss = (y * nd.array(np.array([1., 2., 3., 4.],
+                                      np.float32))).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [0.0, 0.0, 0.5, 3.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0, 0.0, 3.0, 4.0])
