@@ -1,0 +1,98 @@
+"""Input-pipeline throughput: images/sec through gluon.data.DataLoader
+(decode-free synthetic CIFAR-like records, full augmentation stack,
+C++ host-engine prefetch workers). Reference analogue: the fork's
+ImageRecordIter tuning runs — the input pipeline must outrun the
+accelerator or everything else is moot.
+
+Host-side work measures honestly on CPU (no tunnel involved), so this
+bench produces a MEASURED number every round. One JSON line, rc 0,
+BudgetGuard like every other benchmark here.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+from bench import BudgetGuard
+
+# V100-era reference: the fork's pipeline target is to keep ~1360
+# img/s of ResNet-50 fed; the input pipeline must at least match that
+REFERENCE_IMG_PER_SEC = 1360.0
+
+
+def main():
+    guard = BudgetGuard("dataloader_images_per_sec", "images/sec") \
+        .install()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side bench
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    n = int(os.environ.get("BENCH_DL_N", "2048"))
+    batch = int(os.environ.get("BENCH_DL_BATCH", "64"))
+    workers = int(os.environ.get("BENCH_DL_WORKERS", "2"))
+
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 256, (n, 32, 32, 3)).astype(np.uint8)
+    labels = rs.randint(0, 10, (n,)).astype(np.int32)
+
+    tf = T.Compose([
+        T.RandomFlipLeftRight(),
+        T.RandomColorJitter(0.4, 0.4, 0.4, 0.2),
+        T.RandomLighting(0.1),
+        T.ToTensor(layout="NHWC"),
+        T.Normalize([0.485, 0.456, 0.406], [0.229, 0.224, 0.225],
+                    layout="NHWC"),
+    ])
+    ds = ArrayDataset(imgs, labels).transform_first(tf)
+
+    def one_epoch(num_workers):
+        dl = DataLoader(ds, batch_size=batch, shuffle=True,
+                        num_workers=num_workers)
+        t0 = time.perf_counter()
+        seen = 0
+        for x, y in dl:
+            seen += x.shape[0]
+        return seen / (time.perf_counter() - t0)
+
+    one_epoch(0)  # warm the jit-free path / allocators
+    ips_serial = one_epoch(0)
+    guard.best.update({
+        "value": round(ips_serial, 1),
+        "vs_baseline": round(ips_serial / REFERENCE_IMG_PER_SEC, 3),
+        "phase": "serial", "batch": batch, "n": n,
+        "images_per_sec_serial": round(ips_serial, 1),
+    })
+    guard.emit()
+
+    if guard.remaining() > 20.0:
+        ips_workers = one_epoch(workers)
+        guard.best.update({
+            "value": round(max(ips_serial, ips_workers), 1),
+            "vs_baseline": round(max(ips_serial, ips_workers)
+                                 / REFERENCE_IMG_PER_SEC, 3),
+            "phase": "prefetch", "workers": workers,
+            "images_per_sec_prefetch": round(ips_workers, 1),
+        })
+        guard.emit()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit a JSON line; rc stays 0
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({"metric": "dataloader_images_per_sec",
+                          "value": 0.0, "unit": "images/sec",
+                          "vs_baseline": 0.0,
+                          "error": f"{type(e).__name__}: {e}"[:300]}))

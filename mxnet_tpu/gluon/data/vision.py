@@ -245,15 +245,24 @@ class transforms:
             return array(_np.moveaxis(a, -1, 0) if self._chw else a)
 
     class Normalize:
-        def __init__(self, mean=0.0, std=1.0):
+        """Per-channel normalization. layout="CHW" (the reference's
+        default, matching CHW ToTensor output) reshapes vector
+        mean/std to (C, 1, 1); layout="NHWC"/"HWC" broadcasts them
+        over the trailing channel axis — explicit, not guessed, so a
+        (3, H, 3) image can never be normalized along the wrong
+        axis."""
+
+        def __init__(self, mean=0.0, std=1.0, layout="CHW"):
             self._mean = _np.asarray(mean, _np.float32)
             self._std = _np.asarray(std, _np.float32)
+            self._chw = layout.upper().lstrip("N") == "CHW"
 
         def __call__(self, x):
             a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
-            m = self._mean.reshape(-1, 1, 1) if self._mean.ndim else \
-                self._mean
-            s = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+            m, s = self._mean, self._std
+            if self._chw:
+                m = m.reshape(-1, 1, 1) if m.ndim else m
+                s = s.reshape(-1, 1, 1) if s.ndim else s
             return array((a - m) / s)
 
     class Cast:
@@ -328,3 +337,57 @@ class transforms:
             if _np.random.rand() < 0.5:
                 a = a[::-1]
             return array(a.copy())
+
+    # color-space transforms (reference: gluon/data/vision/transforms
+    # RandomBrightness/.../RandomLighting) — thin wrappers over the
+    # mx.image augmenter math, HWC float/uint8 in, fp32 out
+    class RandomBrightness:
+        def __init__(self, brightness):
+            from ...image import BrightnessJitterAug
+            self._aug = BrightnessJitterAug(brightness)
+
+        def __call__(self, x):
+            return self._aug(x)
+
+    class RandomContrast:
+        def __init__(self, contrast):
+            from ...image import ContrastJitterAug
+            self._aug = ContrastJitterAug(contrast)
+
+        def __call__(self, x):
+            return self._aug(x)
+
+    class RandomSaturation:
+        def __init__(self, saturation):
+            from ...image import SaturationJitterAug
+            self._aug = SaturationJitterAug(saturation)
+
+        def __call__(self, x):
+            return self._aug(x)
+
+    class RandomHue:
+        def __init__(self, hue):
+            from ...image import HueJitterAug
+            self._aug = HueJitterAug(hue)
+
+        def __call__(self, x):
+            return self._aug(x)
+
+    class RandomColorJitter:
+        def __init__(self, brightness=0, contrast=0, saturation=0,
+                     hue=0):
+            from ...image import ColorJitterAug, HueJitterAug
+            self._aug = ColorJitterAug(brightness, contrast, saturation)
+            self._hue = HueJitterAug(hue) if hue else None
+
+        def __call__(self, x):
+            x = self._aug(x)
+            return self._hue(x) if self._hue is not None else x
+
+    class RandomLighting:
+        def __init__(self, alpha, eigval=None, eigvec=None):
+            from ...image import LightingAug
+            self._aug = LightingAug(alpha, eigval, eigvec)
+
+        def __call__(self, x):
+            return self._aug(x)

@@ -22,7 +22,10 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short",
            "fixed_crop", "center_crop", "random_crop",
            "color_normalize", "HorizontalFlipAug", "CastAug",
            "ResizeAug", "CenterCropAug", "RandomCropAug",
-           "ColorNormalizeAug", "CreateAugmenter", "ImageIter"]
+           "ColorNormalizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "RandomOrderAug",
+           "CreateAugmenter", "ImageIter"]
 
 
 def imdecode(buf, to_rgb=True, flag=1, **kw) -> NDArray:
@@ -160,9 +163,146 @@ class ColorNormalizeAug(Augmenter):
         return color_normalize(src, self.mean, self.std)
 
 
+# -- color-space augmenters (reference: image.py Brightness/Contrast/
+# Saturation/Hue/ColorJitter/Lighting/RandomOrder Aug classes; the
+# image-classification examples drive them via aug_level). Randomness
+# comes from numpy's global RNG (seed with np.random.seed for
+# determinism, same as the crop/flip augmenters above); the pixel math
+# runs in fp32 on jnp so XLA can fuse it with downstream casts. -------
+
+#: ITU-R BT.601 luma coefficients, shaped to broadcast over HWC.
+#: Kept as numpy: a jnp array here would force JAX backend init (and
+#: on axon, a tunnel dial) at `import mxnet_tpu` time; jnp ops convert
+#: it lazily inside __call__.
+_GRAY_COEF = _np.asarray([[[0.299, 0.587, 0.114]]], _np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    """Scale pixels by 1 + U(-brightness, brightness)."""
+
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.brightness,
+                                         self.brightness)
+        return NDArray(_raw(src).astype(jnp.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the image's mean luma: alpha*src + (1-alpha)*mean."""
+
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        a = _raw(src).astype(jnp.float32)
+        gray = jnp.sum(a * _GRAY_COEF) * (3.0 * (1.0 - alpha) / a.size)
+        return NDArray(a * alpha + gray)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend each pixel with its own luma (gray images are fixed
+    points: for equal channels the output equals the input)."""
+
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.saturation,
+                                         self.saturation)
+        a = _raw(src).astype(jnp.float32)
+        gray = jnp.sum(a * _GRAY_COEF, axis=2, keepdims=True) \
+            * (1.0 - alpha)
+        return NDArray(a * alpha + gray)
+
+
+#: RGB<->YIQ for the hue rotation (reference: image.py HueJitterAug)
+_TYIQ = _np.array([[0.299, 0.587, 0.114],
+                   [0.596, -0.274, -0.321],
+                   [0.211, -0.523, 0.311]], _np.float32)
+_ITYIQ = _np.array([[1.0, 0.956, 0.621],
+                    [1.0, -0.272, -0.647],
+                    [1.0, -1.107, 1.705]], _np.float32)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate chroma in YIQ by U(-hue, hue)*pi; luma (and therefore
+    gray images) are invariant."""
+
+    def __init__(self, hue):
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _np.random.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], _np.float32)
+        t = (_ITYIQ @ bt @ _TYIQ).T
+        a = _raw(src).astype(jnp.float32)
+        return NDArray(a @ jnp.asarray(t))
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in a random order each call."""
+
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = _np.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[int(i)](src)
+        return src
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Brightness/contrast/saturation jitters in random order."""
+    ts = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+#: ImageNet PCA eigenvalues/vectors (reference defaults)
+_IMAGENET_EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+_IMAGENET_EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                              [-0.5808, -0.0045, -0.8140],
+                              [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA noise: add eigvec @ (N(0, alphastd) * eigval)
+    per image (reference: image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval=None, eigvec=None):
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(
+            _IMAGENET_EIGVAL if eigval is None else eigval, _np.float32)
+        self.eigvec = _np.asarray(
+            _IMAGENET_EIGVEC if eigvec is None else eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0.0, self.alphastd, size=(3,)) \
+            .astype(_np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return NDArray(_raw(src).astype(jnp.float32)
+                       + jnp.asarray(rgb))
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False,
-                    rand_mirror=False, mean=None, std=None, **kw):
-    """Build the standard augmenter list (reference signature subset)."""
+                    rand_mirror=False, mean=None, std=None,
+                    brightness=0, contrast=0, saturation=0, hue=0,
+                    pca_noise=0, **kw):
+    """Build the standard augmenter list (reference signature subset,
+    now incl. the color-space knobs the aug_level presets use)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize))
@@ -172,6 +312,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise))
     if mean is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist

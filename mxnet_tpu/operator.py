@@ -163,7 +163,14 @@ def _build_custom_fn(prop: CustomOpProp, is_train: bool, n_out: int):
         op.backward(req=["write"] * len(raw), out_grad=og_nd,
                     in_data=in_nd, out_data=out_nd, in_grad=in_grad,
                     aux=[])
-        return tuple(ig._data for ig in in_grad)
+        # custom_vjp requires float0 cotangents for integer primals
+        # (e.g. the index input of a gather-style op)
+        import numpy as _onp
+
+        return tuple(
+            ig._data if jnp.issubdtype(r.dtype, jnp.inexact)
+            else _onp.zeros(r.shape, jax.dtypes.float0)
+            for ig, r in zip(in_grad, raw))
 
     custom_fn.defvjp(fwd, bwd)
     return custom_fn

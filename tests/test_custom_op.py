@@ -189,3 +189,46 @@ def test_custom_self_stash_state_reaches_backward():
     loss.backward()
     np.testing.assert_allclose(y.asnumpy(), [0.0, 0.0, 0.5, 3.0])
     np.testing.assert_allclose(x.grad.asnumpy(), [0.0, 0.0, 3.0, 4.0])
+
+
+@mx.operator.register("test_gather_rows")
+class GatherRowsProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data", "indices"]
+
+    def infer_shape(self, in_shape):
+        out = [in_shape[1][0], in_shape[0][1]]
+        return in_shape, [out], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return GatherRows()
+
+
+class GatherRows(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0][in_data[1]])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        import jax.numpy as jnp
+        g = jnp.zeros(in_data[0].shape, out_grad[0]._data.dtype) \
+            .at[in_data[1]._data].add(out_grad[0]._data)
+        self.assign(in_grad[0], req[0], g)
+        # in_grad[1] (integer indices) left as zeros: the framework
+        # must convert it to a float0 cotangent
+
+
+def test_custom_integer_input_backward():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([2, 0, 2], np.int32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, idx, op_type="test_gather_rows")
+        loss = y.sum()
+    loss.backward()
+    expect = np.zeros((4, 3), np.float32)
+    expect[2] = 2.0
+    expect[0] = 1.0
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)

@@ -60,3 +60,137 @@ def test_recordio_toplevel_alias(tmp_path):
     hdr2, body = rio.unpack(r.read())
     r.close()
     assert body == b"payload" and hdr2.id == 7
+
+
+# -- color-space augmenters (round-4: upstream image.py Aug parity) ----
+
+def _rand_img(seed=0, h=8, w=8):
+    return np.random.RandomState(seed).rand(h, w, 3).astype(np.float32) * 255
+
+
+def test_color_augs_identity_at_zero():
+    from mxnet_tpu import image as img
+    a = _rand_img()
+    for aug in (img.BrightnessJitterAug(0.0), img.ContrastJitterAug(0.0),
+                img.SaturationJitterAug(0.0), img.LightingAug(0.0)):
+        out = aug(mx.nd.array(a)).asnumpy()
+        np.testing.assert_allclose(out, a, rtol=1e-4, atol=1e-3)
+    # hue's published RGB<->YIQ matrices are rounded, so zero rotation
+    # is identity only to ~0.7% (same property as upstream)
+    out = img.HueJitterAug(0.0)(mx.nd.array(a)).asnumpy()
+    np.testing.assert_allclose(out, a, rtol=2e-2, atol=1.0)
+
+
+def test_color_augs_deterministic_under_seed():
+    from mxnet_tpu import image as img
+    a = mx.nd.array(_rand_img(1))
+    aug = img.ColorJitterAug(0.4, 0.4, 0.4)
+    np.random.seed(123)
+    o1 = aug(a).asnumpy()
+    np.random.seed(123)
+    o2 = aug(a).asnumpy()
+    np.testing.assert_array_equal(o1, o2)
+    np.random.seed(124)
+    o3 = aug(a).asnumpy()
+    assert np.abs(o1 - o3).max() > 1e-3  # different seed, different jitter
+
+
+def test_brightness_scales_range():
+    from mxnet_tpu import image as img
+    a = _rand_img(2)
+    np.random.seed(0)
+    out = img.BrightnessJitterAug(0.5)(mx.nd.array(a)).asnumpy()
+    # pure scaling: ratio constant across pixels, within [0.5, 1.5]
+    ratio = out / np.maximum(a, 1e-6)
+    assert 0.5 - 1e-4 <= ratio.min() and ratio.max() <= 1.5 + 1e-4
+    np.testing.assert_allclose(ratio, ratio.flat[0], rtol=1e-4)
+
+
+def test_saturation_and_hue_fix_gray_images():
+    from mxnet_tpu import image as img
+    gray = np.full((6, 6, 3), 77.0, np.float32)
+    np.random.seed(5)
+    o1 = img.SaturationJitterAug(0.9)(mx.nd.array(gray)).asnumpy()
+    o2 = img.HueJitterAug(0.9)(mx.nd.array(gray)).asnumpy()
+    np.testing.assert_allclose(o1, gray, rtol=1e-4)
+    np.testing.assert_allclose(o2, gray, rtol=2e-2, atol=0.5)
+
+
+def test_hue_preserves_luma():
+    from mxnet_tpu import image as img
+    a = _rand_img(3)
+    np.random.seed(9)
+    out = img.HueJitterAug(0.5)(mx.nd.array(a)).asnumpy()
+    coef = np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose(out @ coef, a @ coef, rtol=5e-2,
+                               atol=2.0)
+    assert np.abs(out - a).max() > 1e-2  # but chroma moved
+
+
+def test_lighting_adds_per_image_constant():
+    from mxnet_tpu import image as img
+    a = _rand_img(4)
+    np.random.seed(11)
+    out = img.LightingAug(0.5)(mx.nd.array(a)).asnumpy()
+    delta = out - a
+    # PCA noise is a single rgb offset for the whole image
+    np.testing.assert_allclose(
+        delta, np.broadcast_to(delta[0, 0], delta.shape), rtol=1e-3,
+        atol=1e-3)
+    assert np.abs(delta).max() > 1e-3
+
+
+def test_create_augmenter_includes_color_augs():
+    from mxnet_tpu import image as img
+    augs = img.CreateAugmenter((3, 8, 8), brightness=0.4, contrast=0.4,
+                               saturation=0.4, hue=0.3, pca_noise=0.1)
+    kinds = [type(x).__name__ for x in augs]
+    assert "RandomOrderAug" in kinds and "HueJitterAug" in kinds \
+        and "LightingAug" in kinds
+    out = mx.nd.array(_rand_img(6))
+    np.random.seed(1)
+    for aug in augs:
+        out = aug(out)
+    assert out.shape == (8, 8, 3)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_gluon_color_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    a = mx.nd.array(_rand_img(7))
+    np.random.seed(3)
+    tf = T.Compose([T.RandomColorJitter(0.3, 0.3, 0.3, 0.2),
+                    T.RandomLighting(0.2)])
+    out = tf(a)
+    assert out.shape == a.shape
+    assert np.isfinite(out.asnumpy()).all()
+    # identity configuration passes values through
+    ident = T.Compose([T.RandomBrightness(0.0), T.RandomContrast(0.0),
+                       T.RandomSaturation(0.0)])
+    np.testing.assert_allclose(ident(a).asnumpy(), a.asnumpy(),
+                               rtol=1e-4, atol=1e-3)
+    # hue identity is approximate (rounded YIQ matrices; see above)
+    np.testing.assert_allclose(T.RandomHue(0.0)(a).asnumpy(),
+                               a.asnumpy(), rtol=2e-2, atol=1.0)
+
+
+def test_normalize_layouts_explicit():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    chw = np.arange(2 * 3 * 3, dtype=np.float32).reshape(3, 2, 3) / 10
+    mean, std = [0.1, 0.2, 0.3], [0.5, 0.5, 0.25]
+    # CHW default: per-channel over axis 0 — including a (3, H, 3)
+    # image, where a channels-last guess would pick the wrong axis
+    out = T.Normalize(mean, std)(mx.nd.array(chw)).asnumpy()
+    expect = (chw - np.reshape(mean, (3, 1, 1))) / np.reshape(
+        std, (3, 1, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-6)
+    # scalar mean + vector std still reshapes std in CHW
+    out = T.Normalize(0.0, std)(mx.nd.array(chw)).asnumpy()
+    np.testing.assert_allclose(out, chw / np.reshape(std, (3, 1, 1)),
+                               rtol=1e-6, atol=1e-6)
+    # NHWC: trailing-axis broadcast
+    hwc = np.transpose(chw, (1, 2, 0))
+    out = T.Normalize(mean, std, layout="NHWC")(
+        mx.nd.array(hwc)).asnumpy()
+    np.testing.assert_allclose(out, (hwc - mean) / std, rtol=1e-6,
+                               atol=1e-6)
