@@ -18,16 +18,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 import numpy as np
 
-from bench import BudgetGuard
+# the fork's pipeline target is to keep ResNet-50 fed at the headline
+# rate — same baseline constant as the training benchmark
+from bench import REFERENCE_IMG_PER_SEC, BudgetGuard
 
-# V100-era reference: the fork's pipeline target is to keep ~1360
-# img/s of ResNet-50 fed; the input pipeline must at least match that
-REFERENCE_IMG_PER_SEC = 1360.0
+#: shared with the exception handler: best-so-far survives a crash
+_guard = None
 
 
 def main():
-    guard = BudgetGuard("dataloader_images_per_sec", "images/sec") \
-        .install()
+    global _guard
+    _guard = guard = BudgetGuard("dataloader_images_per_sec",
+                                 "images/sec").install()
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # host-side bench
@@ -88,11 +90,16 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # always emit a JSON line; rc stays 0
+    except Exception as e:  # always emit a JSON line; rc stays 0.
         import traceback
 
         traceback.print_exc()
-        print(json.dumps({"metric": "dataloader_images_per_sec",
-                          "value": 0.0, "unit": "images/sec",
-                          "vs_baseline": 0.0,
-                          "error": f"{type(e).__name__}: {e}"[:300]}))
+        if _guard is not None:  # keep best-so-far (e.g. the serial
+            _guard.best["error"] = \
+                f"{type(e).__name__}: {e}"[:300]  # phase's number)
+            _guard.emit()
+        else:
+            print(json.dumps({"metric": "dataloader_images_per_sec",
+                              "value": 0.0, "unit": "images/sec",
+                              "vs_baseline": 0.0,
+                              "error": f"{type(e).__name__}: {e}"[:300]}))

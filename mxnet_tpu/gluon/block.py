@@ -227,18 +227,84 @@ class HybridBlock(Block):
             self(*args)
         return self
 
-    def export(self, path, epoch=0):
-        """Dump the compiled graph (StableHLO text) + params — the
-        tracing/EXPORT subsystem (reference: HybridBlock.export to
-        symbol.json/params)."""
+    def export(self, path, epoch=0, platforms=None):
+        """Dump the compiled graph + params — the tracing/EXPORT
+        subsystem (reference: HybridBlock.export to symbol.json/params,
+        ONNX export role). Writes:
+
+        - `{path}-symbol.txt`: human-readable StableHLO (inspection)
+        - `{path}-{epoch:04d}.params`: flat parameter file
+        - `{path}-module.bin` + `{path}-module.json`: a SERIALIZED
+          serving artifact (jax.export) + manifest — reloadable with
+          `SymbolBlock.imports` in a fresh process WITHOUT the Python
+          model class. The serving trace is the predict-mode entry
+          when one exists (RNG baked: dropout is off in predict mode);
+          `platforms` (e.g. ["cpu", "tpu"]) makes the artifact
+          portable across backends at export-time cost.
+        """
         if not self._jit_cache:
             raise RuntimeError("call the hybridized block once before "
                                "export()")
+        import json as _json
+        import os as _os
+
         from .. import tracing as _tracing
-        entry = next(iter(self._jit_cache.values()))
+
+        first = next(iter(self._jit_cache.values()))
         with open(f"{path}-symbol.txt", "w") as f:
-            f.write(_tracing.lower_text(entry))
-        self.save_parameters(f"{path}-{epoch:04d}.params")
+            f.write(_tracing.lower_text(first))
+        params_file = f"{path}-{epoch:04d}.params"
+        self.save_parameters(params_file)
+
+        # serving artifact: prefer a predict-mode trace (cache key[0]
+        # is the training flag)
+        serve_entry = None
+        for key, e in self._jit_cache.items():
+            if key[0] is False:
+                serve_entry = e
+                break
+        if serve_entry is None:
+            import warnings
+
+            warnings.warn(
+                "export(): no predict-mode trace in the jit cache — "
+                "the serving artifact will bake the TRAINING trace "
+                "(active dropout with a fixed mask, batch-stat "
+                "norm). Run one forward under "
+                "autograd.predict_mode() before export().",
+                RuntimeWarning, stacklevel=2)
+        serve_entry = serve_entry or first
+        avals = getattr(serve_entry, "_example_avals", None)
+        if avals is not None:
+            from jax import export as _jax_export
+
+            tr_sds, aux_sds, _rng_sds, *in_sds = avals
+            fixed_key = _random.next_key()  # baked into the artifact
+            tr_names = list(serve_entry.tr_names)
+            aux_names = list(serve_entry.aux_names)
+
+            def serve(tr_list, aux_list, *inputs):
+                tr = dict(zip(tr_names, tr_list))
+                aux = dict(zip(aux_names, aux_list))
+                flat, _ = serve_entry.raw_fn(tr, aux, fixed_key,
+                                             *inputs)
+                return flat
+
+            exp = _jax_export.export(
+                jax.jit(serve),
+                platforms=list(platforms) if platforms else None)(
+                    [tr_sds[n] for n in tr_names],
+                    [aux_sds[n] for n in aux_names], *in_sds)
+            with open(f"{path}-module.bin", "wb") as f:
+                f.write(exp.serialize())
+            with open(f"{path}-module.json", "w") as f:
+                _json.dump({
+                    "format": "mxnet_tpu-module-v1",
+                    "tr_names": tr_names,
+                    "aux_names": aux_names,
+                    "n_inputs": len(in_sds),
+                    "params_file": _os.path.basename(params_file),
+                }, f, indent=1)
         return f"{path}-symbol.txt"
 
     # -- compiled call path --------------------------------------------------
@@ -449,14 +515,59 @@ class Identity(HybridBlock):
         return x
 
 
-class SymbolBlock(HybridBlock):
-    """Reference: gluon.SymbolBlock (wrap an exported symbol). Here graphs
-    are jaxpr-backed; re-importing an exported module is done by
-    reconstructing the Python Block and loading parameters, so this class
-    only provides the constructor signature for compatibility."""
+class SymbolBlock(Block):
+    """Reference: gluon.SymbolBlock.imports(symbol.json, ['data'],
+    params) — serve an exported model WITHOUT its Python class. Here
+    the artifact is a serialized jax.export module
+    (`{prefix}-module.bin` + `.json` manifest from
+    `HybridBlock.export`): `imports` deserializes the compiled
+    computation, loads the flat .params file, and the resulting block
+    runs inference with no reference to the original model code."""
+
+    def __init__(self, exported, manifest, params):
+        super().__init__()
+        self._exp = exported
+        self._manifest = manifest
+        self._tr = [jnp.asarray(params[n])
+                    for n in manifest["tr_names"]]
+        self._aux = [jnp.asarray(params[n])
+                     for n in manifest["aux_names"]]
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "exported graphs are StableHLO text; rebuild the Block and "
-            "load_parameters(param_file) instead")
+    def imports(symbol_file, input_names=None, param_file=None,
+                ctx=None):
+        """Load `{prefix}-module.bin` (accepts the `-symbol.txt` path
+        too and resolves the sibling artifact). `input_names` is kept
+        for reference-signature compatibility; inputs are positional.
+        """
+        import json as _json
+        import os as _os
+
+        from jax import export as _jax_export
+
+        base = str(symbol_file)
+        if base.endswith("-symbol.txt"):
+            base = base[:-len("-symbol.txt")] + "-module.bin"
+        with open(base, "rb") as f:
+            blob = f.read()
+        with open(base[:-len(".bin")] + ".json") as f:
+            manifest = _json.load(f)
+        if manifest.get("format") != "mxnet_tpu-module-v1":
+            raise ValueError(f"not an exported module: {base}")
+        if param_file is None:
+            param_file = _os.path.join(_os.path.dirname(base) or ".",
+                                       manifest["params_file"])
+        with _np.load(param_file, allow_pickle=False) as z:
+            params = {k: z[k] for k in z.files}
+        return SymbolBlock(_jax_export.deserialize(bytearray(blob)),
+                           manifest, params)
+
+    def forward(self, *inputs):
+        n = self._manifest["n_inputs"]
+        if len(inputs) != n:
+            raise ValueError(f"expected {n} inputs, got {len(inputs)}")
+        raw = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+               for x in inputs]
+        flat = self._exp.call(self._tr, self._aux, *raw)
+        outs = [NDArray(o) for o in flat]
+        return outs[0] if len(outs) == 1 else outs
