@@ -279,7 +279,11 @@ class HybridBlock(Block):
             from jax import export as _jax_export
 
             tr_sds, aux_sds, _rng_sds, *in_sds = avals
-            fixed_key = _random.next_key()  # baked into the artifact
+            # constant key, NOT _random.next_key(): consuming the
+            # global stream here would shift every later random draw,
+            # making training runs irreproducible just because they
+            # exported (the key is unused in a predict-mode trace)
+            fixed_key = jax.random.PRNGKey(0)
             tr_names = list(serve_entry.tr_names)
             aux_names = list(serve_entry.aux_names)
 
@@ -290,6 +294,8 @@ class HybridBlock(Block):
                                              *inputs)
                 return flat
 
+            if isinstance(platforms, str):
+                platforms = [platforms]
             exp = _jax_export.export(
                 jax.jit(serve),
                 platforms=list(platforms) if platforms else None)(
@@ -303,6 +309,7 @@ class HybridBlock(Block):
                     "tr_names": tr_names,
                     "aux_names": aux_names,
                     "n_inputs": len(in_sds),
+                    "out_tree": _encode_treedef(serve_entry.out_treedef),
                     "params_file": _os.path.basename(params_file),
                 }, f, indent=1)
         return f"{path}-symbol.txt"
@@ -515,6 +522,41 @@ class Identity(HybridBlock):
         return x
 
 
+def _encode_treedef(treedef):
+    """JSON-encodable skeleton of an output pytree (tuple/list/dict
+    containers, integer leaf indices). Exotic container types fall
+    back to None → the importer returns the flat leaf list."""
+    try:
+        skel = jax.tree_util.tree_unflatten(
+            treedef, list(range(treedef.num_leaves)))
+
+        def enc(x):
+            if isinstance(x, tuple):
+                return {"t": [enc(v) for v in x]}
+            if isinstance(x, list):
+                return {"l": [enc(v) for v in x]}
+            if isinstance(x, dict):
+                return {"d": {k: enc(v) for k, v in x.items()}}
+            if isinstance(x, int):
+                return x
+            raise TypeError(type(x))
+
+        return enc(skel)
+    except Exception:
+        return None
+
+
+def _decode_treedef(node, leaves):
+    if isinstance(node, int):
+        return leaves[node]
+    if "t" in node:
+        return tuple(_decode_treedef(v, leaves) for v in node["t"])
+    if "l" in node:
+        return [_decode_treedef(v, leaves) for v in node["l"]]
+    return {k: _decode_treedef(v, leaves)
+            for k, v in node["d"].items()}
+
+
 class SymbolBlock(Block):
     """Reference: gluon.SymbolBlock.imports(symbol.json, ['data'],
     params) — serve an exported model WITHOUT its Python class. Here
@@ -570,4 +612,7 @@ class SymbolBlock(Block):
                for x in inputs]
         flat = self._exp.call(self._tr, self._aux, *raw)
         outs = [NDArray(o) for o in flat]
+        tree = self._manifest.get("out_tree")
+        if tree is not None:  # restore the model's output structure
+            return _decode_treedef(tree, outs)
         return outs[0] if len(outs) == 1 else outs

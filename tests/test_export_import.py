@@ -86,3 +86,72 @@ def test_import_validates_input_arity(exported_bert):
     block = SymbolBlock.imports(prefix + "-module.bin")
     with pytest.raises(ValueError):
         block(ids, ids)
+
+
+def test_import_restores_output_structure(tmp_path):
+    """A dict-returning model must come back as a dict, not a flat
+    list (the manifest records the output pytree)."""
+    from mxnet_tpu import gluon
+
+    class DictNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.a = gluon.nn.Dense(3, in_units=4)
+            self.b = gluon.nn.Dense(2, in_units=4)
+
+        def forward(self, x):
+            return {"big": self.a(x), "small": (self.b(x), x * 2)}
+
+    net = DictNet()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 4)
+                    .astype(np.float32))
+    net.hybridize()
+    with autograd.predict_mode():
+        ref = net(x)
+    prefix = str(tmp_path / "dictnet")
+    net.export(prefix)
+    block = SymbolBlock.imports(prefix + "-module.bin")
+    out = block(x)
+    assert isinstance(out, dict) and isinstance(out["small"], tuple)
+    np.testing.assert_array_equal(out["big"].asnumpy(),
+                                  ref["big"].asnumpy())
+    np.testing.assert_array_equal(out["small"][0].asnumpy(),
+                                  ref["small"][0].asnumpy())
+    np.testing.assert_array_equal(out["small"][1].asnumpy(),
+                                  ref["small"][1].asnumpy())
+
+
+def test_export_platform_string_accepted(tmp_path):
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    x = mx.nd.array(np.zeros((1, 3), np.float32))
+    net.hybridize()
+    with autograd.predict_mode():
+        net(x)
+        net(x)
+    net.export(str(tmp_path / "d"), platforms="cpu")  # not ['c','p','u']
+    block = SymbolBlock.imports(str(tmp_path / "d-module.bin"))
+    np.testing.assert_array_equal(block(x).asnumpy(),
+                                  net(x).asnumpy())
+
+
+def test_export_does_not_consume_global_rng(tmp_path):
+    """Exporting mid-run must not shift the global random stream."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    x = mx.nd.array(np.zeros((1, 3), np.float32))
+    net.hybridize()
+    with autograd.predict_mode():
+        net(x)
+        net(x)
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(42)
+    net.export(str(tmp_path / "r"))
+    b = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
