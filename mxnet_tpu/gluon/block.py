@@ -532,11 +532,15 @@ def _encode_treedef(treedef):
 
         def enc(x):
             if isinstance(x, tuple):
-                return {"t": [enc(v) for v in x]}
+                if hasattr(x, "_fields"):  # namedtuple: a plain-tuple
+                    raise TypeError(type(x))  # round trip would lose
+                return {"t": [enc(v) for v in x]}  # .field access
             if isinstance(x, list):
                 return {"l": [enc(v) for v in x]}
             if isinstance(x, dict):
-                return {"d": {k: enc(v) for k, v in x.items()}}
+                if any(not isinstance(k, str) for k in x):
+                    raise TypeError("non-str dict key")  # json would
+                return {"d": {k: enc(v) for k, v in x.items()}}  # cast
             if isinstance(x, int):
                 return x
             raise TypeError(type(x))

@@ -43,6 +43,7 @@ def test_convert_block_bf16_keeps_norm_params_fp32():
     assert out.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_bf16_training_decreases_loss():
     net = _net()
     amp.init("bfloat16")

@@ -52,6 +52,7 @@ def test_scorer_length_penalty():
     assert sc0(-10.0, 10.0) == sc0(-10.0, 2.0)
 
 
+@pytest.mark.slow
 def test_beam_search_src_valid_len_masks_padding(net_src):
     net, src = net_src
     # row padded beyond valid_len must decode the same as the unpadded

@@ -35,6 +35,7 @@ def _train_steps(net, trainer, X, Y, k):
     return losses
 
 
+@pytest.mark.slow
 def test_trainer_resume_bitexact(tmp_path):
     X, Y = _data()
     net = _make_net()

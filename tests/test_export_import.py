@@ -155,3 +155,40 @@ def test_export_does_not_consume_global_rng(tmp_path):
     net.export(str(tmp_path / "r"))
     b = mx.nd.random.uniform(shape=(4,)).asnumpy()
     np.testing.assert_array_equal(a, b)
+
+
+def test_export_namedtuple_output_falls_back_to_flat(tmp_path):
+    """Containers JSON can't represent faithfully (namedtuples, int
+    dict keys) must take the documented flat-list fallback, not come
+    back silently as a different container type."""
+    import collections
+
+    from mxnet_tpu import gluon
+
+    Out = collections.namedtuple("Out", ["a", "b"])
+
+    class NTNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = gluon.nn.Dense(2, in_units=3)
+
+        def forward(self, x):
+            y = self.d(x)
+            return Out(a=y, b=y * 2)
+
+    net = NTNet()
+    net.initialize()
+    x = mx.nd.array(np.zeros((1, 3), np.float32))
+    net.hybridize()
+    with autograd.predict_mode():
+        net(x)
+        ref = net(x)
+    prefix = str(tmp_path / "nt")
+    net.export(prefix)
+    with open(prefix + "-module.json") as f:
+        assert json.load(f)["out_tree"] is None  # honest fallback
+    block = SymbolBlock.imports(prefix + "-module.bin")
+    out = block(x)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), ref.a.asnumpy())
+    np.testing.assert_array_equal(out[1].asnumpy(), ref.b.asnumpy())

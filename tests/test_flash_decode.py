@@ -93,6 +93,7 @@ def test_vmem_gate_rejects_oversized_cache(monkeypatch):
     assert fd._pallas_mode(_Fake()) is None
 
 
+@pytest.mark.slow
 def test_llama_decode_step_parity(monkeypatch):
     """The llama_infer decode step must produce identical logits with
     the kernel forced on vs the jnp path."""

@@ -233,6 +233,7 @@ def test_lr_scheduler_in_trainer():
     assert tr.learning_rate < 1.0
 
 
+@pytest.mark.slow
 def test_model_zoo_vision_namespace():
     from mxnet_tpu.gluon import model_zoo
     import mxnet_tpu as mx

@@ -127,6 +127,7 @@ def test_resize_iter(rec_file):
     assert len(list(it)) == 5  # wraps around the 3-batch epoch
 
 
+@pytest.mark.slow
 def test_lenet_trains_from_ndarrayiter():
     """Classic mx.io training loop drives a Gluon model end-to-end."""
     mx.random.seed(0)

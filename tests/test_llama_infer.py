@@ -70,6 +70,7 @@ def test_generate_sampling_valid_tokens(net):
     assert (out >= 0).all() and (out < 256).all()
 
 
+@pytest.mark.slow
 def test_generate_top_p_nucleus(net):
     rs = np.random.RandomState(4)
     prompt = rs.randint(0, 256, (2, 4)).astype(np.int32)
@@ -220,6 +221,7 @@ def test_beam_size_one_equals_greedy(net):
     np.testing.assert_array_equal(greedy, beam1)
 
 
+@pytest.mark.slow
 def test_beam_score_at_least_greedy(net):
     """For N=2 new tokens the property IS guaranteed: the greedy
     prefix ranks first at step 1 (so it survives any W >= 1), and the
@@ -255,6 +257,7 @@ def test_beam_score_at_least_greedy(net):
     assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
 
 
+@pytest.mark.slow
 def test_beam_eos_freezes(net):
     from mxnet_tpu.models.llama_infer import generate_beam
     rs = np.random.RandomState(11)

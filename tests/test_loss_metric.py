@@ -54,6 +54,7 @@ def test_triplet():
     assert np.allclose(out.asscalar(), 0.0)  # neg far -> no loss
 
 
+@pytest.mark.slow
 def test_ctc_loss_decreases():
     mx.random.seed(0)
     T, N, C, L = 8, 2, 5, 3

@@ -8,6 +8,7 @@ from mxnet_tpu import autograd, nd
 from mxnet_tpu.parallel.data_parallel import FusedTrainStep
 
 
+@pytest.mark.slow
 def test_lenet_mnist_shapes():
     net = mx.models.get_model("lenet")
     net.initialize()
@@ -15,6 +16,7 @@ def test_lenet_mnist_shapes():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet18_thumbnail():
     net = mx.models.get_model("resnet18_v1", classes=10, thumbnail=True,
                               layout="NHWC")
@@ -32,6 +34,7 @@ def test_resnet50_v2_forward():
     assert out.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_mobilenet_v2():
     net = mx.models.get_model("mobilenetv2_0.5", classes=10)
     net.initialize()
@@ -39,6 +42,7 @@ def test_mobilenet_v2():
     assert out.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_bert_tiny_forward_and_train():
     net = mx.models.get_model("bert_tiny")
     net.initialize()
@@ -101,6 +105,7 @@ def test_llama_tiny_train():
     assert np.allclose(o1[:, :-1], o2[:, :-1], atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fm_sparse_train():
     from mxnet_tpu.sparse import CSRNDArray
     rs = np.random.RandomState(0)
@@ -126,6 +131,7 @@ def test_fm_sparse_train():
     assert losses[-1] < losses[0] * 0.5
 
 
+@pytest.mark.slow
 def test_rnn_layers():
     from mxnet_tpu.gluon import rnn
     for cls, nstate in [(rnn.LSTM, 2), (rnn.GRU, 1), (rnn.RNN, 1)]:
@@ -181,6 +187,7 @@ def test_vgg11_bn_tiny():
     assert out.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_alexnet_forward():
     net = mx.models.get_model("alexnet", classes=10)
     net.initialize()
@@ -188,6 +195,7 @@ def test_alexnet_forward():
     assert out.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_squeezenet_forward():
     net = mx.models.get_model("squeezenet1.1", classes=10)
     net.initialize()
@@ -222,6 +230,7 @@ def test_mlp_forward():
     assert out.shape == (4, 10)
 
 
+@pytest.mark.slow
 def test_skipgram_trains():
     from mxnet_tpu.models.word_embedding import SkipGramNet, \
         sample_negatives

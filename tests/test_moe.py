@@ -48,6 +48,7 @@ def _manual_moe(moe, x):
     return out.reshape(B, T, H)
 
 
+@pytest.mark.slow
 def test_moe_matches_per_token_routing():
     """Huge capacity → no drops → einsum dispatch == per-token loop."""
     set_mesh(None)
@@ -61,6 +62,7 @@ def test_moe_matches_per_token_routing():
     assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
 
 
+@pytest.mark.slow
 def test_moe_sharded_matches_eager(ep_mesh):
     mx.random.seed(12)
     moe = MoEMLP(hidden=16, intermediate=32, num_experts=8, top_k=2,
@@ -98,6 +100,7 @@ def test_moe_aux_loss_balanced_vs_skewed():
     assert float(aux.asscalar()) >= 0.99
 
 
+@pytest.mark.slow
 def test_moe_trains_on_ep_mesh(ep_mesh):
     mx.random.seed(15)
     net = mx.gluon.nn.HybridSequential()

@@ -167,6 +167,7 @@ def test_trainer_tpu_sync_kvstore():
     assert np.isfinite(l.asscalar())
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

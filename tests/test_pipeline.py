@@ -89,6 +89,7 @@ def _mse_loss(out, y):
 
 
 @pytest.mark.parametrize("num_microbatches", [4, 8])
+@pytest.mark.slow
 def test_1f1b_matches_sequential_grads(pp_mesh, num_microbatches):
     from mxnet_tpu.parallel.pipeline import one_f_one_b
     params = _make_params(4, 6, 12, seed=8)
@@ -108,6 +109,7 @@ def test_1f1b_matches_sequential_grads(pp_mesh, num_microbatches):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_matches_autodiff(pp_mesh):
     # cross-check the schedule against plain jax.grad of the sequential
     # mean-microbatch loss
@@ -133,6 +135,7 @@ def test_1f1b_matches_autodiff(pp_mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_under_jit(pp_mesh):
     from mxnet_tpu.parallel.pipeline import one_f_one_b
     params = _make_params(4, 4, 8, seed=12)

@@ -67,6 +67,7 @@ def test_quantize_net_lenet_accuracy_within_1pct():
     assert acc_q >= acc_fp32 - 0.01, (acc_fp32, acc_q)
 
 
+@pytest.mark.slow
 def test_quantized_net_hybridizes():
     X, _ = _toy_images(n=16)
     mx.random.seed(1)

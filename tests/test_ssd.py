@@ -86,6 +86,7 @@ def test_multibox_detection_decodes_anchors():
     assert cls2[0] == 1.0                    # anchor 2 -> class 1
 
 
+@pytest.mark.slow
 def test_ssd_forward_shapes():
     net = mx.models.get_model("ssd_300", classes=4, base_channels=8)
     net.initialize()
@@ -106,6 +107,7 @@ def test_ssd_forward_shapes():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ssd_overfits_one_batch():
     mx.random.seed(0)
     net = mx.models.get_model("ssd_300", classes=2, base_channels=8)

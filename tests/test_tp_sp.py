@@ -114,6 +114,7 @@ def test_ring_attention_exact(sp_mesh, causal):
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad(sp_mesh):
     """Ring attention is differentiable; grads match full attention."""
     rs = np.random.RandomState(1)
