@@ -1,0 +1,96 @@
+"""Export → serve workflow (reference: export to symbol.json/params +
+SymbolBlock.imports): train a small net, export it, then reload the
+serialized artifact in a FRESH subprocess that never imports the model
+class and verify the logits match bitwise.
+
+Usage: python examples/export_serve.py [--cpu] [--steps 20]
+"""
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+_SERVE = """
+import sys, os
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx                     # runtime only — no model code
+from mxnet_tpu.gluon.block import SymbolBlock
+block = SymbolBlock.imports({prefix!r} + "-module.bin")
+x = mx.nd.array(np.load({xfile!r}))
+np.testing.assert_array_equal(block(x).asnumpy(), np.load({reffile!r}))
+print("served: logits bitwise-equal to the exporting process")
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+
+    rs = np.random.RandomState(0)
+    X = mx.nd.array(rs.rand(64, 16).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 10, 64), dtype="int32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    for i in range(args.steps):
+        with autograd.record():
+            loss = loss_fn(net(X), y).mean()
+        loss.backward()
+        trainer.step(X.shape[0])
+    print(f"trained {args.steps} steps, loss {float(loss.asscalar()):.4f}")
+
+    net.hybridize()
+    with autograd.predict_mode():
+        net(X)          # materialize + populate the predict-mode trace
+        ref = net(X)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        # the serve subprocess runs on CPU: make the artifact carry a
+        # CPU lowering even when this process exported from a TPU
+        import jax
+
+        plats = sorted({"cpu", jax.default_backend()})
+        net.export(prefix, platforms=plats)
+        print("exported:", sorted(os.listdir(d)), "platforms:", plats)
+        np.save(os.path.join(d, "x.npy"), X.asnumpy())
+        np.save(os.path.join(d, "ref.npy"), ref.asnumpy())
+        script = os.path.join(d, "serve.py")
+        with open(script, "w") as f:
+            f.write(_SERVE.format(
+                repo=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                prefix=prefix, xfile=os.path.join(d, "x.npy"),
+                reffile=os.path.join(d, "ref.npy")))
+        out = subprocess.run([sys.executable, "-u", script],
+                             capture_output=True, text=True,
+                             timeout=300)
+        if out.returncode != 0:
+            raise SystemExit("serve subprocess failed:\n"
+                             + out.stdout + out.stderr)
+        print(out.stdout.strip())
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ CASES = {
     "train_ssd.py": ["--cpu", "--steps", "6", "--batch-size", "4"],
     "dcgan.py": ["--cpu", "--steps", "4", "--batch-size", "4"],
     "lstm_bucketing.py": ["--cpu", "--steps", "9"],
+    "export_serve.py": ["--cpu", "--steps", "5"],
 }
 
 
