@@ -54,12 +54,15 @@ def main():
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-2})
+    loss = None
     for i in range(args.steps):
         with autograd.record():
             loss = loss_fn(net(X), y).mean()
         loss.backward()
         trainer.step(X.shape[0])
-    print(f"trained {args.steps} steps, loss {float(loss.asscalar()):.4f}")
+    if loss is not None:
+        print(f"trained {args.steps} steps, "
+              f"loss {float(loss.asscalar()):.4f}")
 
     net.hybridize()
     with autograd.predict_mode():

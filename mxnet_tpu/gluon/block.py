@@ -562,22 +562,78 @@ def _decode_treedef(node, leaves):
 
 
 class SymbolBlock(Block):
-    """Reference: gluon.SymbolBlock.imports(symbol.json, ['data'],
-    params) — serve an exported model WITHOUT its Python class. Here
-    the artifact is a serialized jax.export module
-    (`{prefix}-module.bin` + `.json` manifest from
-    `HybridBlock.export`): `imports` deserializes the compiled
-    computation, loads the flat .params file, and the resulting block
-    runs inference with no reference to the original model code."""
+    """Reference: gluon.SymbolBlock — both upstream forms:
 
-    def __init__(self, exported, manifest, params):
+    1. `SymbolBlock(outputs, inputs, params=...)` wraps an `mx.sym`
+       graph as a Gluon block: free variables become Parameters (so
+       autograd/Trainer work), inputs bind positionally.
+    2. `SymbolBlock.imports(...)` reloads a `HybridBlock.export`
+       artifact — a serialized jax.export module
+       (`{prefix}-module.bin` + `.json` manifest) plus the flat
+       .params file — and serves inference WITHOUT the original model
+       class (upstream: imports(symbol.json, ['data'], params))."""
+
+    def __init__(self, outputs=None, inputs=None, params=None, *,
+                 _artifact=None):
         super().__init__()
-        self._exp = exported
-        self._manifest = manifest
-        self._tr = [jnp.asarray(params[n])
-                    for n in manifest["tr_names"]]
-        self._aux = [jnp.asarray(params[n])
-                     for n in manifest["aux_names"]]
+        if _artifact is not None:
+            exported, manifest, raw = _artifact
+            self._exp = exported
+            self._manifest = manifest
+            self._tr = [jnp.asarray(raw[n])
+                        for n in manifest["tr_names"]]
+            self._aux = [jnp.asarray(raw[n])
+                         for n in manifest["aux_names"]]
+            self._symbolic = None
+            return
+        if outputs is None or inputs is None:
+            raise ValueError(
+                "SymbolBlock(outputs, inputs, params=...) wraps a "
+                "symbol; SymbolBlock.imports(...) reloads an exported "
+                "artifact")
+        from .. import symbol as _symbol
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = _symbol.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        in_names = [s.name if hasattr(s, "name") else str(s)
+                    for s in inputs]
+        self._symbolic = (outputs, in_names)
+        params = dict(params.items()) if hasattr(params, "items") \
+            else dict(params or {})
+        # arguments become trainable Parameters; auxiliary-state names
+        # (moving_mean/...) become grad_req='null' ones — upstream
+        # SymbolBlock's split exactly
+        free = [(n, "write") for n in outputs.list_arguments()
+                if n not in in_names]
+        free += [(n, "null") for n in outputs.list_auxiliary_states()
+                 if n not in in_names]
+        from .. import initializer as _initializer
+
+        for name, grad_req in free:
+            p = Parameter(name, grad_req=grad_req,
+                          allow_deferred_init=True)
+            if name in params:
+                v = params[name]
+                if isinstance(v, Parameter):
+                    v = v.data()  # SymbolBlock(..., net.collect_params())
+                raw = v._data if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+                p.shape = tuple(raw.shape)
+                # copy: aliasing the caller's array would let a
+                # Trainer step on this block mutate it (and fused
+                # steps donate buffers) — same rule as set_data
+                p._data = NDArray(jnp.array(raw, copy=True))
+                if p._grad_req != "null":  # same wiring as _init_impl:
+                    p._data.attach_grad(p._grad_req)  # autograd sees it
+            else:
+                # stage a deferred init so the documented recipe —
+                # collect_params()[name].set_data(...) before forward —
+                # actually works (set_data finishes the deferred init
+                # once the value's shape is known)
+                p._deferred = (_initializer.Zero(), None)
+            self._reg_params[name] = p
 
     @staticmethod
     def imports(symbol_file, input_names=None, param_file=None,
@@ -605,10 +661,25 @@ class SymbolBlock(Block):
                                        manifest["params_file"])
         with _np.load(param_file, allow_pickle=False) as z:
             params = {k: z[k] for k in z.files}
-        return SymbolBlock(_jax_export.deserialize(bytearray(blob)),
-                           manifest, params)
+        return SymbolBlock(_artifact=(
+            _jax_export.deserialize(bytearray(blob)), manifest, params))
 
     def forward(self, *inputs):
+        if getattr(self, "_symbolic", None) is not None:
+            outputs, in_names = self._symbolic
+            if len(inputs) != len(in_names):
+                raise ValueError(f"expected {len(in_names)} inputs "
+                                 f"({in_names}), got {len(inputs)}")
+            env = dict(zip(in_names, inputs))
+            for name, p in self._reg_params.items():
+                env[name] = p.data()
+            # _eval directly: Symbol.eval(ctx=None, **bindings) would
+            # swallow a variable literally named "ctx"
+            out = outputs._eval(env, {})
+            flat = out if isinstance(out, tuple) else (out,)
+            outs = [o if isinstance(o, NDArray)
+                    else NDArray(jnp.asarray(o)) for o in flat]
+            return outs[0] if len(outs) == 1 else outs
         n = self._manifest["n_inputs"]
         if len(inputs) != n:
             raise ValueError(f"expected {n} inputs, got {len(inputs)}")

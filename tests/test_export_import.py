@@ -192,3 +192,95 @@ def test_export_namedtuple_output_falls_back_to_flat(tmp_path):
     assert isinstance(out, list) and len(out) == 2
     np.testing.assert_array_equal(out[0].asnumpy(), ref.a.asnumpy())
     np.testing.assert_array_equal(out[1].asnumpy(), ref.b.asnumpy())
+
+
+def test_symbolblock_wraps_symbol_graph():
+    """Upstream form 1: SymbolBlock(outputs, inputs, params) turns an
+    mx.sym graph into a Gluon block whose free variables are trainable
+    Parameters."""
+    from mxnet_tpu import gluon, nd
+
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    out = mx.sym.broadcast_add(mx.sym.dot(data, w), b)
+
+    rs = np.random.RandomState(0)
+    wv = nd.array(rs.rand(3, 2).astype(np.float32))
+    bv = nd.array(rs.rand(2).astype(np.float32))
+    block = SymbolBlock(out, data, params={"w": wv, "b": bv})
+
+    x = nd.array(rs.rand(4, 3).astype(np.float32))
+    ref = x.asnumpy() @ wv.asnumpy() + bv.asnumpy()
+    np.testing.assert_allclose(block(x).asnumpy(), ref, rtol=1e-5)
+
+    # the wrapped parameters train through autograd + Trainer
+    p = block.collect_params()
+    assert set(p.keys()) == {"w", "b"}
+    tr = gluon.Trainer(p, "sgd", {"learning_rate": 0.1})
+    with autograd.record():
+        loss = (block(x) ** 2).sum()
+    loss.backward()
+    g = p["w"].grad()
+    assert g is not None and float(nd.abs(g).sum().asscalar()) > 0
+    w_before = p["w"].data().asnumpy().copy()
+    tr.step(1)
+    assert np.abs(p["w"].data().asnumpy() - w_before).max() > 0
+
+    # multi-output group form
+    block2 = SymbolBlock([out, data * 2.0], data,
+                         params={"w": wv, "b": bv})
+    o1, o2 = block2(x)
+    np.testing.assert_allclose(o1.asnumpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(o2.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_symbolblock_requires_args():
+    with pytest.raises(ValueError):
+        SymbolBlock()
+
+
+def test_symbolblock_parameterdict_aux_and_deferred():
+    """Review regressions: params= accepts a ParameterDict/Parameters,
+    aux-state names register as grad_req='null' parameters, unprovided
+    free vars accept set_data before forward, and a variable named
+    'ctx' is not swallowed by the eval signature."""
+    from mxnet_tpu import gluon, nd
+
+    # ParameterDict source (the canonical upstream call shape)
+    src = gluon.nn.Dense(2, in_units=3, use_bias=False)
+    src.initialize()
+    src(nd.zeros((1, 3)))
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("weight")
+    out = mx.sym.dot(data, mx.sym.transpose(w))
+    params = {"weight": src.collect_params()["weight"]}
+    block = SymbolBlock(out, data, params=params)
+    x = nd.array(np.random.RandomState(0).rand(2, 3)
+                 .astype(np.float32))
+    np.testing.assert_allclose(block(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5)
+
+    # aux-suffix free variable binds as a grad_req='null' parameter
+    mean = mx.sym.Variable("bn_moving_mean")
+    out2 = mx.sym.broadcast_add(data, mean)
+    b2 = SymbolBlock(out2, data,
+                     params={"bn_moving_mean":
+                             nd.array(np.ones(3, np.float32))})
+    assert b2.collect_params()["bn_moving_mean"].grad_req == "null"
+    np.testing.assert_allclose(b2(x).asnumpy(), x.asnumpy() + 1.0,
+                               rtol=1e-6)
+
+    # unprovided free var: set_data before forward (documented recipe)
+    b3 = SymbolBlock(out, data)
+    b3.collect_params()["weight"].set_data(
+        src.collect_params()["weight"].data())
+    np.testing.assert_allclose(b3(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5)
+
+    # a variable literally named "ctx" still binds
+    cv = mx.sym.Variable("ctx")
+    b4 = SymbolBlock(cv * 2.0, cv)
+    np.testing.assert_allclose(b4(x).asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
