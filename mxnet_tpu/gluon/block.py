@@ -609,6 +609,12 @@ class SymbolBlock(Block):
                 if n not in in_names]
         free += [(n, "null") for n in outputs.list_auxiliary_states()
                  if n not in in_names]
+        unknown = set(params) - {n for n, _ in free}
+        if unknown:  # a typo'd name would otherwise surface later as
+            raise ValueError(  # an unrelated deferred-init error
+                f"params entries {sorted(unknown)} match no free "
+                f"variable of the symbol (free: "
+                f"{sorted(n for n, _ in free)})")
         from .. import initializer as _initializer
 
         for name, grad_req in free:
@@ -621,6 +627,8 @@ class SymbolBlock(Block):
                 raw = v._data if isinstance(v, NDArray) \
                     else jnp.asarray(v)
                 p.shape = tuple(raw.shape)
+                p.dtype = raw.dtype  # keep set_data from upcasting a
+                #                      non-fp32 param to the default
                 # copy: aliasing the caller's array would let a
                 # Trainer step on this block mutate it (and fused
                 # steps donate buffers) — same rule as set_data
@@ -676,9 +684,16 @@ class SymbolBlock(Block):
             # _eval directly: Symbol.eval(ctx=None, **bindings) would
             # swallow a variable literally named "ctx"
             out = outputs._eval(env, {})
-            flat = out if isinstance(out, tuple) else (out,)
+
+            def _leaves(o):  # a multi-output op inside a Group yields
+                if isinstance(o, tuple):  # nested tuples: flatten like
+                    for v in o:  # upstream (each output separately,
+                        yield from _leaves(v)  # never stacked)
+                else:
+                    yield o
+
             outs = [o if isinstance(o, NDArray)
-                    else NDArray(jnp.asarray(o)) for o in flat]
+                    else NDArray(jnp.asarray(o)) for o in _leaves(out)]
             return outs[0] if len(outs) == 1 else outs
         n = self._manifest["n_inputs"]
         if len(inputs) != n:

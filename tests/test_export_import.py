@@ -284,3 +284,31 @@ def test_symbolblock_parameterdict_aux_and_deferred():
     b4 = SymbolBlock(cv * 2.0, cv)
     np.testing.assert_allclose(b4(x).asnumpy(), 2 * x.asnumpy(),
                                rtol=1e-6)
+
+
+def test_symbolblock_multi_output_op_and_param_validation():
+    from mxnet_tpu import nd
+
+    data = mx.sym.Variable("data")
+    x = nd.array(np.random.RandomState(0).rand(2, 4)
+                 .astype(np.float32))
+    # a multi-output op inside a Group flattens to separate outputs
+    block = SymbolBlock(
+        [mx.sym.split(data, num_outputs=2, axis=1), data * 2.0], data)
+    outs = block(x)
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[0].asnumpy(), x.asnumpy()[:, :2])
+    np.testing.assert_allclose(outs[1].asnumpy(), x.asnumpy()[:, 2:])
+    np.testing.assert_allclose(outs[2].asnumpy(), 2 * x.asnumpy())
+    # a typo'd params key fails loudly at construction
+    w = mx.sym.Variable("weight")
+    with pytest.raises(ValueError, match="wieght"):
+        SymbolBlock(mx.sym.dot(data, w), data,
+                    params={"wieght": nd.zeros((4, 2))})
+    # provided dtype sticks (no silent fp32 upcast on set_data)
+    h = nd.array(np.ones((4, 2), np.float16))
+    b = SymbolBlock(mx.sym.dot(data, w), data, params={"weight": h})
+    p = b.collect_params()["weight"]
+    assert str(p.data()._data.dtype) == "float16"
+    p.set_data(nd.array(np.full((4, 2), 2.0, np.float16)))
+    assert str(p.data()._data.dtype) == "float16"

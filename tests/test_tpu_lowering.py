@@ -131,3 +131,45 @@ def test_flash_decode_quantized_lowers():
     vl = jax.ShapeDtypeStruct((B,), jnp.int32)
     _lowers(lambda q_, k_, ks_, v_, vs_, vl_: _flash_decode_pallas_q8(
         q_, k_, ks_, v_, vs_, vl_, 0.088, False), q, k8, ks, k8, ks, vl)
+
+
+def test_bert_forward_with_flash_lengths_lowers():
+    """The on-chip bench's BERT phase feeds ragged valid_length so the
+    flash kernel's key-padding path engages — prove THAT exact forward
+    lowers for TPU before a healthy-tunnel window is spent on it
+    (bench.py _bert_phase)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.bert import BERTForPretraining
+
+    mx.random.seed(0)
+    net = BERTForPretraining(vocab_size=512, units=128,
+                             hidden_size=256, num_layers=1,
+                             num_heads=4, max_length=128)
+    net.initialize(init=mx.init.Normal(0.02))
+    ids = mx.nd.array(np.zeros((2, 128), np.int32))
+    tok = mx.nd.zeros((2, 128), dtype="int32")
+    vlen = mx.nd.array(np.array([100, 128], np.int32))
+    ent = net.trace_entry([ids, tok, vlen], training=False)
+    tr = {n: net.collect_params()[n].data()._data for n in ent.tr_names}
+    aux = {n: net.collect_params()[n].data()._data
+           for n in ent.aux_names}
+    key = jax.random.PRNGKey(0)
+
+    def fwd(ids_, tok_, vlen_):
+        flat, _ = ent.raw_fn(tr, aux, key, ids_, tok_, vlen_)
+        return flat[0]
+
+    # the dispatch gates consult jax.default_backend() (cpu in tests);
+    # patch them the way the TPU runtime would resolve, same as the
+    # llama lowering test above
+    import unittest.mock as mock
+
+    from mxnet_tpu.kernels import flash_attention, fused_norm
+    with mock.patch.object(flash_attention, "_pallas_mode",
+                           lambda T: "compiled"), \
+            mock.patch.object(fused_norm, "_pallas_mode",
+                              lambda: "compiled"):
+        n = _lowers(fwd, jax.ShapeDtypeStruct((2, 128), jnp.int32),
+                    jax.ShapeDtypeStruct((2, 128), jnp.int32),
+                    jax.ShapeDtypeStruct((2,), jnp.int32))
+    assert n >= 2  # flash attention AND the fused norms engaged
