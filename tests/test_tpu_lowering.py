@@ -173,3 +173,53 @@ def test_bert_forward_with_flash_lengths_lowers():
                     jax.ShapeDtypeStruct((2, 128), jnp.int32),
                     jax.ShapeDtypeStruct((2,), jnp.int32))
     assert n >= 2  # flash attention AND the fused norms engaged
+
+
+@pytest.mark.slow
+def test_resnet_fused_train_step_lowers():
+    """The headline bench workload — fused fwd+bwd+momentum-SGD on a
+    bf16 NHWC ResNet — exports for the TPU platform (round 3 verified
+    this interactively; this commits the proof so a lowering
+    regression turns the suite red, not the driver's one on-chip
+    bench window). ResNet-18 at 32px keeps the export fast; the op
+    mix (convs, BN, pooling, dense, momentum update, donated buffers)
+    is the same as the bench's ResNet-50."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.models.resnet import resnet18_v1
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    mx.random.seed(0)
+    saved_amp = dict(amp._STATE)  # amp.init is process-wide: restore
+    net = resnet18_v1(classes=10, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    amp.init("bfloat16")
+    amp.convert_block(net)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    step = FusedTrainStep(net, loss_fn, opt, mesh=None)
+    x = mx.nd.array(np.zeros((2, 32, 32, 3), np.float32),
+                    dtype="bfloat16")
+    y = mx.nd.array(np.zeros((2,), np.int32))
+    float(step(x, y).asscalar())  # build + one CPU step
+
+    sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+    hyper = {"lr": jax.ShapeDtypeStruct((), jnp.float32),
+             "wd": jax.ShapeDtypeStruct((), jnp.float32),
+             "t": jax.ShapeDtypeStruct((), jnp.int32),
+             "rescale": jax.ShapeDtypeStruct((), jnp.float32)}
+    import mxnet_tpu.random as _random
+    key_sd = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        _random.next_key())
+    try:
+        exp = jax.export.export(step._compiled, platforms=["tpu"])(
+            sds(step._tr), sds(step._aux), sds(step._states), hyper,
+            key_sd,
+            jax.ShapeDtypeStruct((2, 32, 32, 3), jnp.bfloat16),
+            jax.ShapeDtypeStruct((2,), jnp.int32))
+        assert exp.mlir_module()  # lowered for TPU without error
+    finally:
+        amp._STATE.update(saved_amp)
