@@ -191,30 +191,30 @@ def test_resnet_fused_train_step_lowers():
 
     mx.random.seed(0)
     saved_amp = dict(amp._STATE)  # amp.init is process-wide: restore
-    net = resnet18_v1(classes=10, layout="NHWC")
-    net.initialize(init=mx.init.Xavier())
-    amp.init("bfloat16")
-    amp.convert_block(net)
-    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
-    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
-                           multi_precision=True)
-    step = FusedTrainStep(net, loss_fn, opt, mesh=None)
-    x = mx.nd.array(np.zeros((2, 32, 32, 3), np.float32),
-                    dtype="bfloat16")
-    y = mx.nd.array(np.zeros((2,), np.int32))
-    float(step(x, y).asscalar())  # build + one CPU step
+    try:                          # even when an earlier stage raises
+        net = resnet18_v1(classes=10, layout="NHWC")
+        net.initialize(init=mx.init.Xavier())
+        amp.init("bfloat16")
+        amp.convert_block(net)
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                               multi_precision=True)
+        step = FusedTrainStep(net, loss_fn, opt, mesh=None)
+        x = mx.nd.array(np.zeros((2, 32, 32, 3), np.float32),
+                        dtype="bfloat16")
+        y = mx.nd.array(np.zeros((2,), np.int32))
+        float(step(x, y).asscalar())  # build + one CPU step
 
-    sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
-    hyper = {"lr": jax.ShapeDtypeStruct((), jnp.float32),
-             "wd": jax.ShapeDtypeStruct((), jnp.float32),
-             "t": jax.ShapeDtypeStruct((), jnp.int32),
-             "rescale": jax.ShapeDtypeStruct((), jnp.float32)}
-    import mxnet_tpu.random as _random
-    key_sd = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-        _random.next_key())
-    try:
+        sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+        hyper = {"lr": jax.ShapeDtypeStruct((), jnp.float32),
+                 "wd": jax.ShapeDtypeStruct((), jnp.float32),
+                 "t": jax.ShapeDtypeStruct((), jnp.int32),
+                 "rescale": jax.ShapeDtypeStruct((), jnp.float32)}
+        import mxnet_tpu.random as _random
+        key_sd = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            _random.next_key())
         exp = jax.export.export(step._compiled, platforms=["tpu"])(
             sds(step._tr), sds(step._aux), sds(step._states), hyper,
             key_sd,
