@@ -72,17 +72,30 @@ class Node:
     parents: NDArray inputs that are part of the graph (order matches the
     cotangent tuple returned by vjp_fn). outputs: the NDArrays produced
     (positional; cotangents assembled in the same structure).
+
+    bwd_fn, if set, is the *differentiable replay* of the backward:
+    `bwd_fn(primals, cots) -> grads` over flat tuples of raw jax arrays
+    (primals aligned with `parents`, cots with `outputs`, grads with
+    `parents`). Unlike `vjp_fn` — an opaque XLA closure — bwd_fn re-runs
+    `jax.vjp` from the stored primals, so dispatching it through the
+    `invoke` chokepoint tapes the backward pass itself; that is what
+    `grad(create_graph=True)` rides for higher-order gradients
+    (reference: the C++ tape's record_op during backward,
+    src/imperative/imperative.cc::Backward(create_graph=true)).
     """
 
-    __slots__ = ("vjp_fn", "parents", "outputs", "out_avals", "n_out", "_topo")
+    __slots__ = ("vjp_fn", "parents", "outputs", "out_avals", "n_out",
+                 "bwd_fn", "primals", "_topo")
 
-    def __init__(self, vjp_fn, parents, n_out):
+    def __init__(self, vjp_fn, parents, n_out, bwd_fn=None, primals=None):
         self.vjp_fn = vjp_fn
         self.parents = parents  # list[NDArray]
         self.outputs: List[Any] = []  # filled by dispatcher (weak refs not
         # needed: tape is freed after backward)
         self.out_avals: List[Any] = []
         self.n_out = n_out
+        self.bwd_fn = bwd_fn
+        self.primals = primals  # tuple of raw jax arrays, aligned w/ parents
 
 
 def _toposort(root: Node) -> List[Node]:
@@ -108,12 +121,11 @@ def _zeros_like_aval(aval):
     return jnp.zeros(aval.shape, aval.dtype)
 
 
-def backward(heads, head_grads=None, retain_graph: bool = False):
-    """Run reverse-mode over the tape from `heads`.
-
-    Writes gradients into each leaf's .grad buffer according to grad_req.
-    """
-    from .ndarray import NDArray  # late import (cycle)
+def _normalize_heads(heads, head_grads):
+    """Shared head/head_grads validation for backward + grad: lists of
+    equal length (upstream asserts this; silent zip truncation would
+    drop a head's contribution)."""
+    from .ndarray import NDArray
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -121,51 +133,83 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
         head_grads = [None] * len(heads)
     elif isinstance(head_grads, NDArray):
         head_grads = [head_grads]
+    if len(head_grads) != len(heads):
+        raise ValueError(
+            f"head_grads has {len(head_grads)} entries for {len(heads)} "
+            "heads; pass one per head (or None)")
+    for h in heads:
+        if h._node is None and h._grad is None:
+            raise ValueError("cannot differentiate a head that is not on "
+                             "the tape; did you forget autograd.record()?")
+    return heads, head_grads
 
-    # Seed cotangents keyed by producing (node, position).
+
+def _global_order(heads) -> List[Node]:
+    """Topological order across all heads, outputs-first (_toposort
+    appends post-order: children of the DAG = parents of an op)."""
+    order: List[Node] = []
+    seen = set()
+    for h in heads:
+        if h._node is None:
+            continue
+        for n in _toposort(h._node):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    return list(reversed(order))
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False):
+    """Run reverse-mode over the tape from `heads`.
+
+    Writes the finalized cotangent of every array that has a .grad buffer
+    (leaves from attach_grad, plus any array grad() gave a temporary
+    buffer — including intermediates) according to its grad_req.
+    """
+    from .ndarray import NDArray  # late import (cycle)
+
+    heads, head_grads = _normalize_heads(heads, head_grads)
+
     cotangents: dict = {}
+    arrs: dict = {}  # id -> NDArray, for the final leaf-write pass
 
     def _add_cot(arr, cot):
         key = id(arr)
+        arrs[key] = arr
         if key in cotangents:
             cotangents[key] = cotangents[key] + cot
         else:
             cotangents[key] = cot
 
-    roots: List[Node] = []
+    def _write_grad(arr, g):
+        if arr._grad is None or arr._grad_req == "null":
+            return
+        if arr._grad_req == "add":
+            arr._grad._data = arr._grad._data + g
+        else:
+            arr._grad._data = g.astype(arr._grad._data.dtype) \
+                if g.dtype != arr._grad._data.dtype else g
+
     for h, hg in zip(heads, head_grads):
-        if h._node is None and h._grad is None:
-            raise ValueError("cannot differentiate a head that is not on the "
-                             "tape; did you forget autograd.record()?")
         g = hg._data if isinstance(hg, NDArray) else (
             jnp.ones(h.shape, h._data.dtype) if hg is None else jnp.asarray(hg))
         _add_cot(h, g)
-        if h._node is not None:
-            roots.append(h._node)
 
-    # Global topological order across all heads.
-    order: List[Node] = []
-    seen = set()
-    for r in roots:
-        for n in _toposort(r):
-            if id(n) not in seen:
-                seen.add(id(n))
-                order.append(n)
-    # order currently parents-after-children? _toposort appends post-order
-    # (children of DAG = parents of op). Reverse to get outputs-first.
-    order = list(reversed(order))
+    order = _global_order(heads)
 
-    leaves = []
     for node in order:
-        outs = node.outputs
         cots = []
         any_nonzero = False
-        for arr, aval in zip(outs, node.out_avals):
+        for arr, aval in zip(node.outputs, node.out_avals):
             c = cotangents.pop(id(arr), None)
             if c is None:
                 c = _zeros_like_aval(aval)
             else:
                 any_nonzero = True
+                # the producing node is being processed, so every
+                # consumer has contributed: the cotangent is final —
+                # write it if this intermediate has a grad buffer
+                _write_grad(arr, c)
             cots.append(c)
         if not any_nonzero:
             continue
@@ -175,42 +219,121 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
             if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
                 continue
             _add_cot(parent, g)
-            if parent._node is None and parent._grad is not None:
-                leaves.append(parent)
 
-    # Write leaf grads per grad_req.
-    done = set()
-    for leaf in leaves:
-        if id(leaf) in done:
-            continue
-        done.add(id(leaf))
-        g = cotangents.get(id(leaf))
-        if g is None:
-            continue
-        if leaf._grad_req == "add":
-            leaf._grad._data = leaf._grad._data + g
-        elif leaf._grad_req != "null":
-            leaf._grad._data = g.astype(leaf._grad._data.dtype) \
-                if g.dtype != leaf._grad._data.dtype else g
+    # Arrays whose cotangents were never popped have no producing node
+    # on the walked tape (true leaves, incl. a head that is itself a
+    # leaf): write them now.
+    for key, g in cotangents.items():
+        _write_grad(arrs[key], g)
 
     if not retain_graph:
         for node in order:
             node.vjp_fn = None
             node.parents = []
             node.outputs = []
+            node.bwd_fn = None
+            node.primals = None
         for h in heads:
             h._node = None
+
+
+def _backward_on_tape(heads, head_grads, variables):
+    """Reverse-mode where every node-backward is dispatched through the
+    `invoke` chokepoint (as a fresh taped op replaying `jax.vjp` from the
+    node's stored primals), so the returned grads are themselves on the
+    tape and differentiable — the create_graph=True engine. The forward
+    tape is left intact (create_graph implies retain_graph)."""
+    from .ndarray import NDArray, invoke
+
+    cotangents: dict = {}  # id(NDArray) -> NDArray (taped)
+    var_ids = {id(v) for v in variables}
+    var_cots: dict = {}  # finalized cotangents of requested variables
+
+    def _add_cot(arr, cot):
+        key = id(arr)
+        cotangents[key] = cot if key not in cotangents \
+            else cotangents[key] + cot
+
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            hg = NDArray(jnp.ones(h.shape, h._data.dtype))
+        elif not isinstance(hg, NDArray):
+            hg = NDArray(jnp.asarray(hg))
+        _add_cot(h, hg)
+
+    for node in _global_order(heads):
+        cots, any_nonzero = [], False
+        for arr, aval in zip(node.outputs, node.out_avals):
+            c = cotangents.pop(id(arr), None)
+            if c is None:
+                c = NDArray(_zeros_like_aval(aval))
+            else:
+                any_nonzero = True
+                if id(arr) in var_ids:
+                    # intermediate variable: its cotangent is final
+                    # once the producing node is reached
+                    var_cots[id(arr)] = c
+            cots.append(c)
+        if not any_nonzero:
+            continue
+        if node.bwd_fn is None:
+            raise NotImplementedError(
+                "create_graph=True reached an op without a differentiable "
+                "backward (autograd.Function backwards are opaque user "
+                "code); implement the op as a pure function instead")
+        if node.primals is not None and any(
+                p._data is not pr
+                for p, pr in zip(node.parents, node.primals)):
+            raise ValueError(
+                "create_graph=True: an input of a recorded op was "
+                "mutated in place after the op ran; the replayed "
+                "backward would differentiate the wrong value")
+        # only inexact parents carry cotangents; ints (e.g. token ids)
+        # would yield float0, which has no NDArray representation
+        live = [k for k, p in enumerate(node.parents)
+                if jnp.issubdtype(p._data.dtype, jnp.inexact)]
+        if not live:
+            continue
+        n_p, bwd_fn = len(node.parents), node.bwd_fn
+
+        def replay(*flat, _n_p=n_p, _bwd=bwd_fn, _live=tuple(live)):
+            prim, cs = flat[:_n_p], flat[_n_p:]
+            grads = _bwd(prim, cs)
+            out = tuple(grads[k] for k in _live)
+            return out[0] if len(_live) == 1 else out
+
+        outs = invoke(replay, [*node.parents, *cots], n_out=len(live))
+        if len(live) == 1:
+            outs = (outs,)
+        for k, g in zip(live, outs):
+            _add_cot(node.parents[k], g)
+
+    out = []
+    for v in variables:
+        if id(v) in var_cots:
+            out.append(var_cots[id(v)])
+        elif id(v) in cotangents:
+            out.append(cotangents[id(v)])
+        else:
+            out.append(NDArray(jnp.zeros(v.shape, v._data.dtype)))
+    return out
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Functional gradient API (mx.autograd.grad): returns grads w.r.t.
-    `variables` without touching .grad buffers."""
+    `variables` without touching .grad buffers. With create_graph=True the
+    returned grads are on the tape, so they can be differentiated again
+    (reference: mxnet/autograd.py::grad + test_higher_order_grad.py)."""
     from .ndarray import NDArray
 
+    heads, head_grads = _normalize_heads(heads, head_grads)
     if create_graph:
-        raise NotImplementedError("create_graph: use jax.grad on a pure fn "
-                                  "(hybridize) for higher-order gradients")
+        single = isinstance(variables, NDArray)
+        var_list = [variables] if single else list(variables)
+        with _mode(True, train_mode):
+            out = _backward_on_tape(heads, head_grads, var_list)
+        return out[0] if single else out
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]

@@ -378,7 +378,22 @@ class HybridBlock(Block):
                 g_tr, *g_inputs = vjp_fn(tuple(cot_in))
                 return tuple(g_tr[n] for n in tr_names) + tuple(g_inputs)
 
-            node = autograd.Node(node_vjp, parents, entry.n_out)
+            def node_bwd(primals, cots, _entry=entry, _aux=aux, _rng=rng,
+                         _names=tr_names):
+                # differentiable replay for grad(create_graph=True):
+                # re-derive the vjp from the primals so the backward is
+                # itself jax-traceable (autograd._backward_on_tape)
+                ntr = len(_names)
+                tr_ = dict(zip(_names, primals[:ntr]))
+                _, vjp, _ = jax.vjp(
+                    lambda t, *i: _entry.jit_fn(t, _aux, _rng, *i),
+                    tr_, *primals[ntr:], has_aux=True)
+                g_tr, *g_inputs = vjp(tuple(cots))
+                return tuple(g_tr[n] for n in _names) + tuple(g_inputs)
+
+            node = autograd.Node(
+                node_vjp, parents, entry.n_out, bwd_fn=node_bwd,
+                primals=tuple(tr[n] for n in tr_names) + tuple(tensor_raw))
         else:
             out_flat, new_aux = entry.jit_fn(tr, aux, rng, *tensor_raw)
             node = None

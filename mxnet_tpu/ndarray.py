@@ -76,8 +76,15 @@ def invoke(fn, args: Sequence[Any], kwargs: Optional[dict] = None,
                 buf[i] = diff_args[j]
             return fn(*buf, **kwargs)
 
-        out, vjp_fn = jax.vjp(closed, *[raw[i] for i in grad_positions])
-        node = autograd.Node(vjp_fn, [args[i] for i in grad_positions], n_out)
+        prim = tuple(raw[i] for i in grad_positions)
+        out, vjp_fn = jax.vjp(closed, *prim)
+
+        def bwd_fn(primals, cots, _closed=closed, _multi=n_out > 1):
+            _, vjp = jax.vjp(_closed, *primals)
+            return vjp(tuple(cots) if _multi else cots[0])
+
+        node = autograd.Node(vjp_fn, [args[i] for i in grad_positions],
+                             n_out, bwd_fn=bwd_fn, primals=prim)
     else:
         out = fn(*raw, **kwargs)
         node = None

@@ -154,3 +154,178 @@ def test_embedding_grad():
     g = w.grad.asnumpy()
     assert np.allclose(g[0], 2.0) and np.allclose(g[2], 1.0) \
         and np.allclose(g[1], 0.0)
+
+
+# -- higher-order gradients (reference: mxnet/autograd.py grad(create_graph),
+# tests/python/unittest/test_higher_order_grad.py) -------------------------
+
+def test_second_order_elementwise():
+    """d2/dx2 x^3 = 6x, via grad(create_graph=True) then backward."""
+    x = nd.array([2.0, -1.5, 0.25])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        z = g.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 6 * x.asnumpy())
+
+
+def test_second_order_matches_jax():
+    """Chain/branch graph: validate the taped grad-of-grad against
+    jax.grad-of-grad on the same pure function."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, w):
+        return jnp.sum(jnp.tanh(x * w) + jnp.sin(x) * w ** 2)
+
+    def penalty(x, w):
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+        return jnp.sum(gx ** 2) + jnp.sum(gw ** 2)
+
+    xv = np.array([0.3, -0.7], np.float32)
+    wv = np.array([1.2, 0.4], np.float32)
+    ref_gx = jax.grad(penalty, argnums=0)(xv, wv)
+    ref_gw = jax.grad(penalty, argnums=1)(xv, wv)
+
+    x, w = nd.array(xv), nd.array(wv)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = (nd.tanh(x * w) + nd.sin(x) * w ** 2).sum()
+        gx, gw = autograd.grad(y, [x, w], create_graph=True)
+        L = (gx ** 2).sum() + (gw ** 2).sum()
+    L.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.asarray(ref_gx),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w.grad.asnumpy(), np.asarray(ref_gw),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_third_order():
+    """grad can nest: d3/dx3 x^4 = 24x."""
+    x = nd.array([1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1.sum(), x, create_graph=True)
+        z = g2.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [24 * 1.5])
+
+
+def test_second_order_through_hybridized_block():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.array([[1.0, 2.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = net(x)
+        g = autograd.grad(out.sum(), x, create_graph=True)
+        L = (g ** 2).sum()
+    L.backward()
+    # linear net: dout/dx = w, so dL/dx = 0 and dL/dw = 2w
+    assert np.allclose(x.grad.asnumpy(), 0.0)
+    p = net.collect_params()["weight"]
+    np.testing.assert_allclose(p.grad().asnumpy(),
+                               2 * p.data().asnumpy(), rtol=1e-6)
+
+
+def test_gradient_penalty_trains():
+    """WGAN-GP-style use: the gradient penalty term itself trains."""
+    from mxnet_tpu import gluon
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(1, in_units=3)
+    net.initialize(init=mx.init.Normal(1.0))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    x = nd.array(np.random.RandomState(0).rand(8, 3).astype(np.float32))
+    penalties = []
+    for _ in range(12):
+        x.attach_grad()
+        with autograd.record():
+            out = net(x).sum()
+            (gx,) = autograd.grad(out, [x], create_graph=True)
+            # drive ||d net/d x|| toward 1 per sample
+            norms = nd.sqrt((gx ** 2).sum(axis=1) + 1e-12)
+            penalty = ((norms - 1.0) ** 2).mean()
+        penalty.backward()
+        tr.step(1)
+        penalties.append(float(penalty.asscalar()))
+    assert penalties[-1] < penalties[0] * 0.1, penalties
+
+
+def test_create_graph_false_unchanged():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+        g = autograd.grad(y, x, retain_graph=True)
+    assert np.allclose(g.asnumpy(), [6.0])
+    # result of the default path is NOT differentiable further
+    assert g._node is None
+
+
+def test_create_graph_rejects_inplace_mutation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+        x += 1.0  # rebinds the input after the op recorded it
+        try:
+            autograd.grad(y, x, create_graph=True)
+        except ValueError as e:
+            assert "mutated in place" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+
+def test_grad_wrt_intermediate():
+    """grad() w.r.t. a non-leaf must return its real cotangent, not
+    silent zeros (review finding r5)."""
+    x = nd.array([1.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        h = x * 2.0
+        y = (h ** 2).sum()
+        g = autograd.grad(y, h, retain_graph=True)
+        assert np.allclose(g.asnumpy(), 2 * h.asnumpy())
+        g2 = autograd.grad(y, h, create_graph=True)
+        assert np.allclose(g2.asnumpy(), 2 * h.asnumpy())
+        # and the taped version differentiates further:
+        # d/dx sum((2h)^2)|... L = sum(g2^2) = sum(16 x^2), dL/dx = 32x
+        L = (g2 ** 2).sum()
+    L.backward()
+    assert np.allclose(x.grad.asnumpy(), 32 * x.asnumpy())
+
+
+def test_mismatched_head_grads_raise():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    try:
+        autograd.grad([y1, y2], x, head_grads=nd.array([1.0]))
+    except ValueError as e:
+        assert "head" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_backward_writes_intermediate_with_attached_buffer():
+    """An intermediate given a grad buffer by grad() must receive the
+    finalized cotangent mid-walk (backward() write-at-pop path)."""
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        h = x * 3.0
+        y = (h * h).sum()
+    g = autograd.grad(y, h)
+    assert np.allclose(g.asnumpy(), 2 * 3.0 * 2.0)
