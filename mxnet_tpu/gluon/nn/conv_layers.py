@@ -54,7 +54,9 @@ class _Conv(HybridBlock):
             wsh = self._wshape(in_channels)
         self.weight = Parameter("weight", shape=wsh,
                                 init=weight_initializer,
-                                allow_deferred_init=True)
+                                allow_deferred_init=True,
+                                fan=(self._fans(in_channels)
+                                     if in_channels else None))
         self.bias = Parameter("bias", shape=(channels,),
                               init=bias_initializer) if use_bias else None
 
@@ -82,11 +84,24 @@ class _Conv(HybridBlock):
                 out.append(k.pop(0))
         return tuple(out)
 
+    def _fans(self, in_channels):
+        """(fan_in, fan_out) matching upstream's OIHW-shape formula
+        (fan_in = I*prod(k), fan_out = O*prod(k)) independent of the
+        stored kernel layout."""
+        k = 1
+        for d in self._kernel:
+            k *= d
+        if self._transpose:
+            return ((self._channels // self._groups) * k,
+                    in_channels * k)
+        return ((in_channels // self._groups) * k, self._channels * k)
+
     def forward(self, x):
         if self.weight._data is None and self.weight._deferred is not None:
             cax = self._layout.index("C")
             in_ch = x.shape[cax]
             self.weight.shape = self._wshape(in_ch)
+            self.weight.fan = self._fans(in_ch)
             self.weight._finish_deferred_init()
         op = nd.Deconvolution if self._transpose else nd.Convolution
         out = op(x, self.weight.data(),

@@ -36,8 +36,15 @@ class Parameter:
     def __init__(self, name="param", grad_req="write", shape=None,
                  dtype="float32", lr_mult=1.0, wd_mult=1.0, init=None,
                  allow_deferred_init=False, differentiable=True,
-                 stype="default", grad_stype="default", sharding=None):
+                 stype="default", grad_stype="default", sharding=None,
+                 fan=None):
         self.name = name
+        # (fan_in, fan_out) hint for fan-aware initializers (Xavier,
+        # MSRAPrelu): conv kernels here are layout-dependent (HWIO for
+        # NHWC, OIHW for NCHW — conv_layers._weight_shape), so a shape
+        # heuristic cannot recover the fans; the layer that knows the
+        # layout sets them (upstream parity: InitDesc.attrs)
+        self.fan = tuple(fan) if fan is not None else None
         self._grad_req = grad_req if differentiable else "null"
         if isinstance(shape, int):
             shape = (shape,)
@@ -101,7 +108,8 @@ class Parameter:
                       _place=True)
         if isinstance(init, str):
             init = _init.create(init)
-        init(_init.InitDesc(self.name), arr)
+        attrs = {"fan": self.fan} if self.fan is not None else {}
+        init(_init.InitDesc(self.name, attrs=attrs), arr)
         self._data = arr
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)

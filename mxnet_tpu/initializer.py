@@ -47,7 +47,9 @@ class Initializer:
         self._kwargs = kwargs
 
     def __call__(self, name, arr: NDArray):
-        self.init_weight(str(name), arr)
+        # pass the InitDesc through unchanged: str(name) would drop
+        # .attrs (the fan hint fan-aware initializers need)
+        self.init_weight(name, arr)
 
     def init_weight(self, name: str, arr: NDArray):
         # dispatch by conventional suffixes, like the reference's
@@ -146,10 +148,20 @@ class Orthogonal(Initializer):
             arr._data.dtype)
 
 
-def _fan(shape, factor_type):
-    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
-    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
-    fan_out = shape[0] * hw
+def _fan(shape, factor_type, fan=None):
+    """Fan factor for Xavier/MSRA scaling. `fan` is the (fan_in,
+    fan_out) hint a layer attached to its Parameter (InitDesc.attrs) —
+    REQUIRED for conv kernels, whose layout here is layout-dependent
+    (HWIO for NHWC nets) so the positional heuristic below (upstream's
+    OIHW assumption) would count spatial dims as channels and produce
+    badly undersized weights (found via the squeezenet one-batch
+    overfit test: every ReLU dead at init)."""
+    if fan is not None:
+        fan_in, fan_out = fan
+    else:
+        hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+        fan_out = shape[0] * hw
     if factor_type == "avg":
         return (fan_in + fan_out) / 2.0
     if factor_type == "in":
@@ -168,7 +180,8 @@ class Xavier(Initializer):
 
     def _init_weight(self, name, arr):
         k = _random.next_key()
-        factor = _fan(arr.shape, self.factor_type)
+        factor = _fan(arr.shape, self.factor_type,
+                      fan=getattr(name, "attrs", {}).get("fan"))
         scale = math.sqrt(self.magnitude / max(factor, 1.0))
         if self.rnd_type == "uniform":
             out = jax.random.uniform(k, arr.shape, jnp.float32, -scale,
