@@ -1,15 +1,27 @@
 """DataLoader (reference: mxnet/gluon/data/dataloader.py).
 
-The reference forks worker processes; here prefetching runs on the C++
-host-runtime thread pool (runtime/engine) when available, else a Python
-thread pool — TPU input pipelines are host-CPU-bound, so threads + numpy
-batching + a device double-buffer cover the same role as the reference's
-multiprocess workers + pinned memory.
+Two worker models:
+
+- ``worker_type="thread"`` (default): prefetching on the C++
+  host-runtime thread pool (runtime/engine) when available, else a
+  Python thread pool. TPU input pipelines are host-CPU-bound and the
+  numpy-heavy batchify releases the GIL, so threads + a device
+  double-buffer cover the reference's multiprocess workers + pinned
+  memory for most pipelines.
+- ``worker_type="process"``: a multiprocessing pool like the
+  reference's, for Python-heavy transforms (PIL color jitter) that
+  hold the GIL. Uses the *spawn* context — forking a JAX-threaded
+  parent can deadlock — and each worker pins the CPU platform before
+  touching JAX so a worker can never dial a TPU tunnel. Standard
+  spawn rules apply: dataset/batchify must be picklable and script
+  entry points need an ``if __name__ == "__main__":`` guard.
 """
 from __future__ import annotations
 
+import pickle
 import queue
 import threading
+import weakref
 from typing import Optional
 
 import numpy as _np
@@ -90,11 +102,65 @@ def default_batchify_fn(data):
     return array(arr)
 
 
+def _tree_to_numpy(obj):
+    """Pickle-friendly transport form for cross-process batches."""
+    if isinstance(obj, NDArray):
+        return ("__nd__", obj.asnumpy())
+    if isinstance(obj, tuple):
+        return tuple(_tree_to_numpy(o) for o in obj)
+    if isinstance(obj, list):
+        return [_tree_to_numpy(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_to_nd(obj):
+    if isinstance(obj, tuple):
+        if len(obj) == 2 and isinstance(obj[0], str) \
+                and obj[0] == "__nd__":
+            return array(obj[1])
+        return tuple(_tree_to_nd(o) for o in obj)
+    if isinstance(obj, list):
+        return [_tree_to_nd(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_to_nd(v) for k, v in obj.items()}
+    return obj
+
+
+#: worker-process globals, set once by _process_worker_init
+_WORKER_STATE: dict = {}
+
+
+def _process_worker_init(payload):
+    """Spawn-context worker bootstrap. The dataset/batchify arrive as a
+    pickle BLOB (not initargs objects) so nothing jax-backed unpickles
+    before the platform is pinned: the axon site hook force-sets
+    jax_platforms=axon,cpu in every interpreter, and an NDArray
+    materializing in an unpinned worker would dial the TPU tunnel."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (non-axon env): harmless
+    dataset, batchify_fn = pickle.loads(payload)
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["batchify"] = batchify_fn
+
+
+def _process_worker_fn(indices):
+    ds = _WORKER_STATE["dataset"]
+    bf = _WORKER_STATE["batchify"]
+    return _tree_to_numpy(bf([ds[i] for i in indices]))
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
-                 prefetch=None, thread_pool=True, timeout=120):
+                 prefetch=None, thread_pool=True, timeout=120,
+                 worker_type="thread"):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -110,6 +176,12 @@ class DataLoader:
         self._prefetch = max(2, prefetch or 2 * max(num_workers, 1))
         self._timeout = timeout
         self._pin = pin_memory
+        if worker_type not in ("thread", "process"):
+            raise ValueError(f"worker_type {worker_type!r}: expected "
+                             "'thread' or 'process'")
+        self._worker_type = worker_type
+        self._pool = None
+        self._pool_finalizer = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -123,10 +195,60 @@ class DataLoader:
             return iter(DevicePrefetcher(it))
         return it
 
+    # -- process workers (reference: the fork's multiprocessing.Pool) ------
+    def _get_pool(self):
+        if self._pool is None:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")  # fork of a JAX-threaded
+            # parent can deadlock in the child (locks held at fork)
+            payload = pickle.dumps((self._dataset, self._batchify_fn))
+            self._pool = ctx.Pool(self._num_workers,
+                                  initializer=_process_worker_init,
+                                  initargs=(payload,))
+            self._pool_finalizer = weakref.finalize(
+                self, DataLoader._shutdown_pool, self._pool)
+        return self._pool
+
+    @staticmethod
+    def _shutdown_pool(pool):
+        try:
+            pool.terminate()
+            pool.join()
+        except Exception:
+            pass
+
+    def _iter_process(self):
+        from collections import deque
+
+        pool = self._get_pool()
+        window = deque()
+        it = iter(self._batch_sampler)
+
+        def submit():
+            indices = next(it, None)
+            if indices is None:
+                return False
+            window.append(pool.apply_async(_process_worker_fn,
+                                           (list(indices),)))
+            return True
+
+        for _ in range(self._prefetch):
+            if not submit():
+                break
+        while window:  # ordered: results yielded in submission order
+            res = window.popleft()
+            out = res.get(self._timeout)  # worker errors re-raise here
+            submit()
+            yield _tree_to_nd(out)
+
     def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
+            return
+        if self._worker_type == "process":
+            yield from self._iter_process()
             return
         # prefetch pipeline scheduled on the native host engine
         # (runtime/cc/engine.cc; Python-thread fallback has the same

@@ -19,9 +19,10 @@ class Dataset:
         return _LazyTransformDataset(self, fn)
 
     def transform_first(self, fn, lazy=True):
-        def first(x, *rest):
-            return (fn(x),) + rest if rest else fn(x)
-        return _LazyTransformDataset(self, first, unpack=True)
+        # _FirstTransform (not a closure) so the wrapped dataset stays
+        # picklable for process-worker DataLoaders
+        return _LazyTransformDataset(self, _FirstTransform(fn),
+                                     unpack=True)
 
     def filter(self, fn):
         idx = [i for i in range(len(self)) if fn(self[i])]
@@ -33,6 +34,16 @@ class Dataset:
 
     def take(self, count):
         return _SubsetDataset(self, list(range(min(count, len(self)))))
+
+
+class _FirstTransform:
+    """Apply `fn` to the first element of a sample tuple."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *rest):
+        return (self._fn(x),) + rest if rest else self._fn(x)
 
 
 class _SubsetDataset(Dataset):
