@@ -3,12 +3,16 @@ the TpuHunter probes for the whole budget and records history; the
 late-TPU fast path merges subprocess JSON lines over the CPU numbers.
 No accelerator needed — probes and the child process are faked."""
 import json
+import os
+import subprocess
 import sys
 import time
 
 import pytest
 
 import bench
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
@@ -82,3 +86,29 @@ def test_probe_once_pins_nothing(monkeypatch):
     res = bench._probe_once(timeout=0.01)  # killed instantly
     # on an axon host with a dead relay the TCP pre-check short-circuits
     assert res in ("probe_timeout", "probe_failed", "relay_refused")
+
+
+@pytest.mark.slow
+def test_bench_rehearsal_fits_headline_budget(tmp_path):
+    """BENCH_REHEARSAL=1 (round-4 verdict item 2) proves the on-chip
+    phase plan fits BENCH_BUDGET_S: the headline prefix (matmul ->
+    allreduce -> resnet infer -> resnet train) must fit with margin,
+    with every phase's full-config host work (builds, traces, TPU
+    lowerings) actually executed."""
+    env = dict(os.environ)
+    env["BENCH_REHEARSAL"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=540, env=env)
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    d = json.loads(lines[-1])
+    assert d["rehearsal"] is True
+    assert d["fits_headline_budget"] is True, d
+    for phase in ("matmul_probe", "allreduce", "resnet50_infer",
+                  "resnet50_train", "bert_base", "autotune_flash"):
+        assert phase in d["phases"], phase
+    for name in ("matmul_probe", "allreduce", "resnet50_infer",
+                 "resnet50_train"):
+        assert d["phases"][name]["ok"], d["phases"][name]
