@@ -49,6 +49,12 @@ def initialize(coordinator_address: Optional[str] = None,
     global _initialized
     if _initialized:
         return
+    if os.environ.get("MXNET_TPU_BREAK_MULTIHOST"):
+        # test-only fault injection: lets the dryrun's 2-process leg
+        # prove that a broken multihost path turns the dryrun red
+        # instead of being swallowed as "skipped"
+        raise RuntimeError("multihost.initialize deliberately broken "
+                           "(MXNET_TPU_BREAK_MULTIHOST set)")
     coordinator_address = coordinator_address or os.environ.get(
         "MXNET_TPU_COORDINATOR")
     if num_processes is None and "MXNET_TPU_NUM_PROCS" in os.environ:
