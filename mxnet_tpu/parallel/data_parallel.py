@@ -356,12 +356,31 @@ class FusedTrainStep:
         data parallelism only — parameters must be unsharded."""
         from jax import shard_map
         from .compression import compressed_psum_tree
+        from ..gluon.contrib import SyncBatchNorm
 
         for n in tr_names:
             if self._params[n].sharding is not None:
                 raise ValueError(
                     "gradient compression supports pure data parallelism; "
                     f"parameter {n!r} carries a TP sharding")
+
+        def _blocks(b):
+            yield b
+            for c in getattr(b, "_children", {}).values():
+                yield from _blocks(c)
+
+        # inside shard_map each shard normalizes over its OWN batch
+        # slice (upstream multi-device BatchNorm parity; running stats
+        # are pmean'd below). SyncBatchNorm's contract is GLOBAL batch
+        # statistics, which only the GSPMD jit path provides — refuse
+        # loudly rather than silently train with per-shard stats.
+        if any(isinstance(b, SyncBatchNorm) for b in _blocks(self.net)):
+            raise ValueError(
+                "SyncBatchNorm cannot run under gradient compression: "
+                "the compressed step runs inside shard_map, where batch "
+                "statistics are per-shard. Drop compression= (GSPMD "
+                "syncs BN stats globally) or use plain BatchNorm "
+                "(per-shard stats, upstream parity)")
         mesh = self.mesh
         dp = self.dp_axis
         ndp = mesh.shape[dp]

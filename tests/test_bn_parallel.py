@@ -1,0 +1,120 @@
+"""BatchNorm semantics per parallel path (round-4 verdict item 5).
+
+Three pinned behaviors:
+- GSPMD jit path (FusedTrainStep, batch sharded over dp): batch
+  statistics are GLOBAL — identical to single-device math — which is
+  what makes SyncBatchNorm a no-op subclass there.
+- shard_map compression path: statistics are PER-SHARD (upstream
+  multi-device BatchNorm parity); running stats are pmean'd across
+  shards, so running_var is the mean of shard variances, NOT the
+  global-batch variance.
+- SyncBatchNorm + compression refuses loudly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+
+def _bn_net():
+    mx.random.seed(0)
+    net = nn.BatchNorm(axis=1, in_channels=3)
+    net.initialize()
+    return net
+
+
+def _loss(out, _):
+    return (out * out).mean()
+
+
+def _batch():
+    rs = np.random.RandomState(0)
+    # shard means differ strongly so per-shard and global variance
+    # cannot coincide by accident
+    x = rs.rand(16, 3).astype(np.float32)
+    x += np.arange(16, dtype=np.float32)[:, None]
+    return x
+
+
+def test_bn_stats_global_under_gspmd_fused_step():
+    x = _batch()
+    y = np.zeros(16, np.float32)
+
+    def run(mesh):
+        net = _bn_net()
+        step = FusedTrainStep(net, _loss,
+                              mx.optimizer.SGD(learning_rate=0.0),
+                              mesh=mesh)
+        l = float(step(nd.array(x), nd.array(y)).asscalar())
+        step.sync_to_params()
+        p = net.collect_params()
+        return (l, p["running_mean"].data().asnumpy(),
+                p["running_var"].data().asnumpy())
+
+    l1, m1, v1 = run(None)
+    l8, m8, v8 = run(make_mesh([8], ["dp"]))
+    assert abs(l1 - l8) < 1e-5, (l1, l8)
+    np.testing.assert_allclose(m8, m1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(v8, v1, rtol=1e-5, atol=1e-6)
+    # and the stats really are the global-batch moments
+    np.testing.assert_allclose(
+        m8, 0.1 * x.mean(axis=0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        v8, 0.9 * 1.0 + 0.1 * x.var(axis=0), rtol=1e-5, atol=1e-5)
+
+
+def test_bn_stats_per_shard_under_compression():
+    x = _batch()
+    y = np.zeros(16, np.float32)
+    net = _bn_net()
+    step = FusedTrainStep(net, _loss,
+                          mx.optimizer.SGD(learning_rate=0.0),
+                          mesh=make_mesh([8], ["dp"]),
+                          compression={"type": "int8"})
+    step(nd.array(x), nd.array(y))
+    step.sync_to_params()
+    p = net.collect_params()
+    shards = x.reshape(8, 2, 3)
+    shard_mean = shards.mean(axis=1).mean(axis=0)  # pmean of means
+    shard_var = shards.var(axis=1).mean(axis=0)    # pmean of vars
+    np.testing.assert_allclose(p["running_mean"].data().asnumpy(),
+                               0.1 * shard_mean, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p["running_var"].data().asnumpy(),
+                               0.9 + 0.1 * shard_var, rtol=1e-4,
+                               atol=1e-5)
+    # the pinned semantics really differ from the global-batch var
+    assert not np.allclose(0.9 + 0.1 * shard_var,
+                           0.9 + 0.1 * x.var(axis=0), rtol=1e-3)
+
+
+def test_sync_batchnorm_refuses_compression():
+    from mxnet_tpu.gluon.contrib import SyncBatchNorm
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), SyncBatchNorm(in_channels=4))
+    net.initialize()
+    step = FusedTrainStep(net, _loss,
+                          mx.optimizer.SGD(learning_rate=0.1),
+                          mesh=make_mesh([8], ["dp"]),
+                          compression={"type": "2bit"})
+    with pytest.raises(ValueError, match="SyncBatchNorm"):
+        step(nd.array(_batch()), nd.array(np.zeros(16, np.float32)))
+
+
+def test_sync_batchnorm_allowed_under_gspmd():
+    from mxnet_tpu.gluon.contrib import SyncBatchNorm
+
+    mx.random.seed(0)
+    net = SyncBatchNorm(in_channels=3)
+    net.initialize()
+    step = FusedTrainStep(net, _loss,
+                          mx.optimizer.SGD(learning_rate=0.0),
+                          mesh=make_mesh([8], ["dp"]))
+    l = float(step(nd.array(_batch()),
+                   nd.array(np.zeros(16, np.float32))).asscalar())
+    assert np.isfinite(l)
