@@ -160,14 +160,17 @@ class DataLoader:
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
                  prefetch=None, thread_pool=True, timeout=120,
-                 worker_type="thread"):
+                 worker_type="thread", seed=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("need batch_size or batch_sampler")
             if sampler is None:
-                sampler = RandomSampler(len(dataset)) if shuffle \
-                    else SequentialSampler(len(dataset))
+                # seed= makes a shuffled epoch sequence replayable
+                # (accuracy-gated tests); default stays OS-entropy
+                # like upstream
+                sampler = RandomSampler(len(dataset), seed=seed) \
+                    if shuffle else SequentialSampler(len(dataset))
             batch_sampler = BatchSampler(sampler, batch_size,
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler

@@ -188,9 +188,15 @@ class Estimator:
                  val_metrics=None):
         self.net = net
         self.loss = loss
-        self.train_metrics = (list(train_metrics)
-                              if train_metrics else [_metric.Accuracy()])
-        self.val_metrics = list(val_metrics) if val_metrics else []
+        def as_list(m):
+            # upstream accepts one EvalMetric or a list of them
+            if m is None:
+                return None
+            return [m] if isinstance(m, _metric.EvalMetric) else list(m)
+
+        self.train_metrics = as_list(train_metrics) \
+            or [_metric.Accuracy()]
+        self.val_metrics = as_list(val_metrics) or []
         self.trainer = trainer or Trainer(
             net.collect_params(), optimizer, optimizer_params
             or {"learning_rate": 0.01})
