@@ -232,3 +232,39 @@ def test_custom_integer_input_backward():
     expect[2] = 2.0
     expect[0] = 1.0
     np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_custom_op_supports_create_graph():
+    """grad(create_graph=True) composes with mx.operator CustomOps:
+    the user's backward is jax code, so the taped replay differentiates
+    through it (d/dx (2x)^2 = 8x)."""
+    class Sq(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            self.assign(in_grad[0], req[0],
+                        2.0 * in_data[0] * out_grad[0])
+
+    @mx.operator.register("sq_hog_test")
+    class SqProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sq()
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sq_hog_test").sum()
+        g = autograd.grad(y, x, create_graph=True)
+        ((g ** 2).sum()).backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [24.0], rtol=1e-6)

@@ -194,3 +194,44 @@ def test_normalize_layouts_explicit():
         mx.nd.array(hwc)).asnumpy()
     np.testing.assert_allclose(out, (hwc - mean) / std, rtol=1e-6,
                                atol=1e-6)
+
+
+def test_gluon_color_transforms_match_legacy_augmenters():
+    """The numpy gluon transforms and the legacy mx.image jnp
+    augmenters implement the same math with the same np.random draw
+    order: under one seed their outputs agree."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    a = np.random.RandomState(3).randint(
+        0, 256, (8, 8, 3)).astype(np.uint8)
+    pairs = [
+        (T.RandomBrightness(0.4), mimg.BrightnessJitterAug(0.4)),
+        (T.RandomContrast(0.4), mimg.ContrastJitterAug(0.4)),
+        (T.RandomSaturation(0.4), mimg.SaturationJitterAug(0.4)),
+        (T.RandomHue(0.2), mimg.HueJitterAug(0.2)),
+        (T.RandomColorJitter(0.3, 0.3, 0.3),
+         mimg.ColorJitterAug(0.3, 0.3, 0.3)),
+        (T.RandomLighting(0.1), mimg.LightingAug(0.1)),
+    ]
+    for t_new, t_old in pairs:
+        np.random.seed(11)
+        out_new = t_new(a)  # numpy in -> numpy out
+        assert isinstance(out_new, np.ndarray), type(out_new)
+        np.random.seed(11)
+        out_old = t_old(mx.nd.array(a)).asnumpy()
+        np.testing.assert_allclose(out_new, out_old, rtol=1e-5,
+                                   atol=1e-3)
+
+
+def test_gluon_transforms_mirror_input_type():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    a = np.random.RandomState(0).randint(
+        0, 256, (6, 6, 3)).astype(np.uint8)
+    tf = T.Compose([T.ToTensor(layout="NHWC"),
+                    T.Normalize([0.5] * 3, [0.25] * 3, layout="NHWC")])
+    out_np = tf(a)
+    assert isinstance(out_np, np.ndarray)
+    out_nd = tf(mx.nd.array(a))
+    assert isinstance(out_nd, mx.nd.NDArray)
+    np.testing.assert_allclose(out_np, out_nd.asnumpy(), rtol=1e-6)
