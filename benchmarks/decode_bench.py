@@ -1,0 +1,125 @@
+"""Cached-decode generation throughput (tokens/sec/chip).
+
+The inference twin of the training benches: greedy decode through the
+Llama flash-decode path, bf16 cache vs int8-quantized cache (the
+design claim is ~2x decode HBM-traffic reduction at large S — this
+bench is what turns that from UNMEASURED to MEASURED the moment a chip
+window opens). On CPU it runs a tiny config as a pipeline check and
+reports honestly (vs_baseline 0.0: no published reference decode
+number applies off-chip).
+
+One JSON line, rc 0, BudgetGuard — same contract as every bench here.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+from bench import BudgetGuard, _enable_compile_cache, \
+    acquire_backend_once
+
+_guard = None
+
+
+def run_phase(on_tpu, guard, headline=True):
+    """Measure greedy decode tokens/sec for both cache dtypes into
+    guard.best. Shared by this script and bench.py's leftover-chip
+    tail. headline=False (the bench.py ride-along) writes ONLY the
+    namespaced tokens_per_sec* keys, never value/phase — the shared
+    guard's last JSON line is the ResNet headline and must stay that
+    way (autotune_kernels precedent)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from mxnet_tpu.models.llama_infer import generate
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_layers=16,
+                          num_heads=16, num_kv_heads=8,
+                          max_seq_len=2048, dtype="bfloat16")
+        batch, prompt_len, new_tokens = 8, 128, 256
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2, max_seq_len=128,
+                          dtype="float32")
+        batch, prompt_len, new_tokens = 2, 16, 32
+
+    def _fetch(out):
+        return np.asarray(out.asnumpy() if hasattr(out, "asnumpy")
+                          else out)
+
+    mx.random.seed(0)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    rs = np.random.RandomState(0)
+    prompt = mx.nd.array(rs.randint(0, cfg.vocab_size,
+                                    (batch, prompt_len)),
+                         dtype="int32")
+
+    for cache_dtype in ("model", "int8"):
+        if guard.remaining() < 30.0:
+            break
+        t0 = time.perf_counter()
+        out = generate(net, prompt, max_new_tokens=new_tokens,
+                       kv_cache_dtype=cache_dtype)
+        _fetch(out)  # host fetch = honest sync
+        compile_s = time.perf_counter() - t0
+        if guard.remaining() < 20.0:
+            break
+        t0 = time.perf_counter()
+        out = generate(net, prompt, max_new_tokens=new_tokens,
+                       kv_cache_dtype=cache_dtype)
+        _fetch(out)
+        dt = time.perf_counter() - t0
+        tps = batch * new_tokens / dt
+        key = "tokens_per_sec" if cache_dtype == "model" \
+            else "tokens_per_sec_int8_cache"
+        guard.best.update({
+            key: round(tps, 2),
+            f"compile_s_{cache_dtype}": round(compile_s, 1),
+        })
+        if cache_dtype == "model" and headline:
+            guard.best.update({"value": round(tps, 2),
+                               "phase": "decode",
+                               "batch": batch,
+                               "prompt_len": prompt_len,
+                               "new_tokens": new_tokens})
+        guard.emit()
+
+
+def main():
+    global _guard
+    _guard = guard = BudgetGuard("llama_decode_tokens_per_sec",
+                                 "tokens/sec").install()
+    backend = acquire_backend_once(max_wait=min(120.0,
+                                                guard.budget_s / 3))
+    on_tpu = backend not in ("cpu",)
+    if on_tpu:
+        _enable_compile_cache()
+    guard.best.update({"backend": backend, "phase": "backend_acquired",
+                       "vs_baseline": 0.0})
+    guard.emit()
+    run_phase(on_tpu, guard)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit a JSON line; rc stays 0
+        import traceback
+
+        traceback.print_exc()
+        if _guard is not None:
+            _guard.best["error"] = f"{type(e).__name__}: {e}"[:300]
+            _guard.emit()
+        else:
+            print(json.dumps({"metric": "llama_decode_tokens_per_sec",
+                              "value": 0.0, "unit": "tokens/sec",
+                              "vs_baseline": 0.0,
+                              "error": f"{type(e).__name__}: {e}"[:300]}))

@@ -112,3 +112,22 @@ def test_bench_rehearsal_fits_headline_budget(tmp_path):
     for name in ("matmul_probe", "allreduce", "resnet50_infer",
                  "resnet50_train"):
         assert d["phases"][name]["ok"], d["phases"][name]
+
+
+@pytest.mark.slow
+def test_decode_bench_pipeline():
+    """decode_bench emits a well-formed JSON line with both cache
+    variants measured on the CPU pipeline config."""
+    env = dict(os.environ)
+    env["BENCH_BUDGET_S"] = "240"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "benchmarks", "decode_bench.py")],
+        capture_output=True, text=True, timeout=300, env=env)
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    d = json.loads(lines[-1])
+    assert d["metric"] == "llama_decode_tokens_per_sec"
+    assert d["value"] > 0, d
+    assert d["tokens_per_sec_int8_cache"] > 0, d
