@@ -985,7 +985,7 @@ def _rehearsal_main():
 
     # -- allreduce: the psum shard_map, lowered for TPU --------------------
     def allreduce():
-        from jax import shard_map
+        from mxnet_tpu.base import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import make_mesh
 

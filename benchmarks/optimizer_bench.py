@@ -1,0 +1,112 @@
+"""Eager optimizer-step wall time: per-parameter loop vs the fused
+multi-tensor path (multi_tensor.py), 200 mixed-shape parameters.
+
+This is the dispatch-bound regime the reference fork's multi_mp_sgd /
+multi_lars kernels attack: the per-param loop pays one jitted dispatch
+(plus hyper scalar churn) per tensor per step, the multi-tensor path one
+executable per dtype group. Runs honestly on CPU — dispatch overhead is
+host-side — so this bench produces a MEASURED number every round.
+
+One JSON line, rc 0, BudgetGuard like every other benchmark here.
+`value` is the speedup (per-param ms / fused ms); the acceptance floor
+for the multi-tensor PR is 3x.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+from bench import BudgetGuard
+
+#: the PR's acceptance floor: fused path must be >= 3x the loop
+SPEEDUP_FLOOR = 3.0
+
+_guard = None
+
+
+def _make_trainer(mx, jnp, shapes, multi_tensor):
+    from mxnet_tpu.gluon.parameter import Parameter
+    rs = np.random.RandomState(0)
+    params = {}
+    for i, s in enumerate(shapes):
+        p = Parameter(f"p{i:03d}", shape=s)
+        p.initialize()
+        p.set_data(rs.randn(*s).astype(np.float32))
+        p.data()._grad._data = jnp.asarray(
+            rs.randn(*s).astype(np.float32))
+        params[f"p{i:03d}"] = p
+    tr = mx.gluon.Trainer(params, "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          multi_tensor=multi_tensor)
+    return params, tr
+
+
+def _time_steps(mx, tr, steps):
+    mx.nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tr.step(batch_size=32)
+    mx.nd.waitall()
+    return (time.perf_counter() - t0) / steps * 1e3  # ms/step
+
+
+def main():
+    global _guard
+    _guard = guard = BudgetGuard(
+        "eager_optimizer_step_speedup_multi_tensor", "x").install()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # dispatch-bound host bench
+
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+
+    n_params = int(os.environ.get("BENCH_OPT_PARAMS", "200"))
+    steps = int(os.environ.get("BENCH_OPT_STEPS", "10"))
+    base_shapes = [(512,), (256, 64), (64, 32, 3), (128,),
+                   (32, 16, 3, 3), (1024,)]
+    shapes = [base_shapes[i % len(base_shapes)] for i in range(n_params)]
+
+    results = {}
+    for label, mt in (("per_param_loop", False), ("multi_tensor", True)):
+        params, tr = _make_trainer(mx, jnp, shapes, mt)
+        tr.step(batch_size=32)  # warmup: compile
+        mx.nd.waitall()
+        results[label] = _time_steps(mx, tr, steps)
+        if mt:
+            results["fused_compiles"] = tr._mt_updater.compiles
+            results["fused_cache_size"] = tr._mt_updater.cache_size
+        guard.best["phase"] = label
+
+    speedup = results["per_param_loop"] / results["multi_tensor"]
+    guard.best.update({
+        "value": round(speedup, 2),
+        "vs_baseline": round(speedup / SPEEDUP_FLOOR, 3),
+        "phase": "done",
+        "num_params": n_params,
+        "steps_timed": steps,
+        "per_param_loop_ms_per_step": round(results["per_param_loop"], 3),
+        "multi_tensor_ms_per_step": round(results["multi_tensor"], 3),
+        "fused_compiles": results["fused_compiles"],
+        "fused_cache_size": results["fused_cache_size"],
+    })
+    guard.emit()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit a JSON line; rc stays 0
+        import traceback
+
+        traceback.print_exc()
+        best = dict(_guard.best) if _guard is not None else {
+            "metric": "eager_optimizer_step_speedup_multi_tensor",
+            "value": 0.0, "unit": "x", "vs_baseline": 0.0}
+        best["error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(best))

@@ -24,6 +24,7 @@ from . import sparse
 from . import initializer
 from . import init  # alias namespace
 from . import optimizer
+from . import multi_tensor
 from .optimizer import lr_scheduler
 from . import lr_scheduler as _lr_sched_alias  # noqa: F401
 from . import metric

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..base import typeof as _typeof
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
@@ -408,7 +409,7 @@ class HybridBlock(Block):
             outs.append(o)
         if node is not None:
             node.outputs = outs
-            node.out_avals = [jax.typeof(r) for r in out_flat]
+            node.out_avals = [_typeof(r) for r in out_flat]
         return jax.tree_util.tree_unflatten(entry.out_treedef, outs)
 
     def _build(self, tensor_pos, proto_args, training, params):

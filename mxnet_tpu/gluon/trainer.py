@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from .. import multi_tensor as _mt
 from .. import optimizer as opt
 from ..kvstore import KVStore, create as kv_create
 from ..ndarray import NDArray
@@ -25,7 +26,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, multi_tensor=True):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -44,6 +45,11 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._init_done = False
         self._scale = 1.0
+        # multi-tensor fused update (multi_tensor.py): the whole eager
+        # step compiles to one XLA executable per dtype group instead of
+        # one dispatch per parameter; opt out with multi_tensor=False
+        self._multi_tensor = multi_tensor
+        self._mt_updater = None
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):
@@ -91,12 +97,16 @@ class Trainer:
 
     def _row_sparse_grad(self, p: Parameter):
         """Convert a dense grad of an embedding into row_sparse using the
-        rows touched in the last forward (grad rows that are non-zero)."""
-        g = p.grad()
-        import numpy as _np
-        arr = _np.asarray(jax.device_get(g._data))
-        nz = _np.where(_np.any(arr != 0, axis=tuple(range(1, arr.ndim))))[0]
-        return RowSparseNDArray(nz.astype(_np.int64), arr[nz], arr.shape)
+        rows touched in the last forward (grad rows that are non-zero).
+        The mask and row gather run in jnp on device — only the touched
+        rows (not the whole dense grad) ever leave the accelerator; the
+        single host sync is nonzero's size query."""
+        arr = p.grad()._data
+        mask = jnp.any(arr.reshape(arr.shape[0], -1) != 0, axis=1)
+        (nz,) = jnp.nonzero(mask)  # canonical int dtype (int32 on x32)
+        return RowSparseNDArray(NDArray(nz),
+                                NDArray(jnp.take(arr, nz, axis=0)),
+                                arr.shape)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """rescale grads by 1/batch_size then update (reference
@@ -108,10 +118,30 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)
 
+    def _fused_indices(self):
+        """Dense trainable parameters eligible for the multi-tensor fast
+        path; row_sparse grads and update-on-kvstore stay on the loop."""
+        on_kv = self._kvstore is not None and self._update_on_kvstore
+        if (not self._multi_tensor or on_kv
+                or not _mt.MultiTensorUpdater.supports(self._optimizer)
+                or (self._kvstore is not None
+                    and not self._kvstore.supports_flat_pushpull())):
+            return []
+        return [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"
+                and p._grad_stype != "row_sparse"]
+
     def _update(self):
         on_kv = self._kvstore is not None and self._update_on_kvstore
+        fused = self._fused_indices()
+        if fused:
+            if self._mt_updater is None:
+                self._mt_updater = _mt.MultiTensorUpdater(self._optimizer)
+            self._mt_updater.step(fused, self._states,
+                                  kvstore=self._kvstore)
+        done = {i for i, _ in fused}
         for i, p in enumerate(self._params):
-            if p.grad_req == "null":
+            if i in done or p.grad_req == "null":
                 continue
             grad = p.grad()
             if p._grad_stype == "row_sparse":
@@ -138,7 +168,11 @@ class Trainer:
             pickle.dump({"states": host,
                          "num_update": self._optimizer.num_update,
                          "index_update_count":
-                             self._optimizer._index_update_count}, f)
+                             self._optimizer._index_update_count,
+                         # loss-scale config: a resumed run must keep
+                         # stepping with the same effective grad scale
+                         "scale": self._scale,
+                         "rescale_grad": self._optimizer.rescale_grad}, f)
 
     def load_states(self, fname):
         import pickle
@@ -148,3 +182,7 @@ class Trainer:
         self._states = jax.tree_util.tree_map(jnp.asarray, blob["states"])
         self._optimizer.num_update = blob["num_update"]
         self._optimizer._index_update_count = blob["index_update_count"]
+        # pre-scale checkpoints (old format) keep the live values
+        self._scale = blob.get("scale", self._scale)
+        if "rescale_grad" in blob:
+            self._optimizer.rescale_grad = blob["rescale_grad"]

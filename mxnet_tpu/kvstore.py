@@ -72,6 +72,10 @@ class KVStore:
             res.append(jnp.zeros(vals[len(res)].shape, jnp.float32))
         out = []
         for i, v in enumerate(vals):
+            if res[i].shape != v._data.shape:
+                # key reused with a new shape (e.g. a flat bucket after
+                # group membership changed): stale feedback is meaningless
+                res[i] = jnp.zeros(v._data.shape, jnp.float32)
             g = v._data.astype(jnp.float32) + res[i]
             if ctype == "2bit":
                 sent = dequantize_2bit(quantize_2bit(g, thr), thr)
@@ -156,6 +160,28 @@ class KVStore:
             else agg._data
         for o in outs:
             o._data = jax.device_put(raw, o.ctx.jax_device)
+
+    # -- flattened multi-tensor buckets (Trainer fast path) ----------------
+    def supports_flat_pushpull(self) -> bool:
+        """Whether gradients may be flattened into anonymous buckets
+        before pushpull. True whenever aggregation (+ compression) is
+        elementwise and keys need no prior init — the in-process stores
+        in sync-only mode (an attached optimizer updates per-key store
+        state, which anonymous buckets do not have). The PS store
+        overrides to False: its keys are server-side state."""
+        return self._optimizer is None
+
+    def pushpull_buckets(self, tag, buckets, priority=0):
+        """Allreduce flattened gradient buckets in place: ONE pushpull
+        (psum / quantized collective with error feedback) per ~4 MB
+        bucket instead of one per tensor (multi_tensor.py). `tag`
+        namespaces the residual state so distinct groups never share
+        error feedback. Keys are strings — a tuple would be unpacked as
+        a key *list* by pushpull."""
+        for bi, b in enumerate(buckets):
+            self.pushpull(f"__flat__/{tag}/{bi}", b, out=b,
+                          priority=priority)
+        return buckets
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """PS-path sparse pull: only requested rows travel (reference:
@@ -332,6 +358,9 @@ class DistPSKVStore(KVStore):
                 # KVStore's dense branch (a caller indexing by row id
                 # must see the same shape under every kv type)
                 self.pull(key, out=o)
+
+    def supports_flat_pushpull(self) -> bool:
+        return False  # server keys are stateful; buckets have no init
 
     def set_optimizer(self, optimizer):
         # "update on kvstore": the SERVER owns the optimizer + states

@@ -1,0 +1,256 @@
+"""Multi-tensor fused optimizer step for the eager Trainer path.
+
+Reference parity: the fork's multi_mp_sgd / multi_lars / multi_sum_sq
+kernels — ONE kernel launch updates every tensor of a group instead of
+O(num_params) tiny launches. TPU-first redesign: the whole eager
+optimizer step becomes one (or a few, dtype-grouped) XLA executables.
+
+Per group of parameters sharing (weight dtype, multi-precision mode,
+optimizer-state structure):
+
+  1. gradients are flattened into ~4 MB buckets (`plan_buckets` /
+     `flatten_buckets`) so the cross-replica sync is one collective per
+     bucket instead of one per tensor — which is also what makes
+     quantized allreduce pay off (EQuARX, arXiv:2506.17615: 2-bit codes
+     + error feedback ride the wire per-bucket);
+  2. a single jitted, state-donating function rescales, clips, runs the
+     optimizer's `_step` math over every tensor in the group (so
+     SGD/NAG/Adam/AdamW/LAMB/LARS all fuse for free, including
+     multi-precision fp32 master weights), and returns new weights +
+     states;
+  3. executables are cached per (shapes, dtypes, state-structure) key —
+     the Trainer-side analogue of `HybridBlock._jit_cache` — so repeated
+     same-shape steps never retrace.
+
+Per-tensor hyperparameters (lr, wd, step count) enter as traced vectors
+and the global rescale as a traced scalar, so LR schedules, lr_mult /
+wd_mult and loss-scale changes never trigger recompiles. The math is the
+SAME `Optimizer._step` the per-parameter loop jits, applied in the same
+order with the same 0-d hyper values, so the fused path is numerically
+identical to the loop it replaces.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MultiTensorUpdater", "plan_buckets", "flatten_buckets",
+           "unflatten_buckets", "DEFAULT_BUCKET_BYTES"]
+
+#: bucket size for flattened-gradient collectives (~4 MB, the sweet spot
+#: between per-tensor launch overhead and collective latency hiding)
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+# -- bucketing (pure shape arithmetic; traceable flatten/unflatten) --------
+
+def plan_buckets(shapes: Sequence[Tuple[int, ...]], dtypes: Sequence,
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+    """Partition tensors into contiguous flat buckets of <= bucket_bytes
+    (a tensor larger than the budget gets a bucket of its own).
+
+    Returns a list of buckets; each bucket is a list of
+    (tensor_index, offset, size, shape) with static offsets so slicing
+    stays free inside jit.
+    """
+    plans, cur, cur_bytes, off = [], [], 0, 0
+    for k, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        size = int(_np.prod(shape)) if len(shape) else 1
+        nbytes = size * jnp.dtype(dtype).itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            plans.append(cur)
+            cur, cur_bytes, off = [], 0, 0
+        cur.append((k, off, size, tuple(shape)))
+        off += size
+        cur_bytes += nbytes
+    if cur:
+        plans.append(cur)
+    return plans
+
+
+def flatten_buckets(leaves: Sequence, plans, dtype=None) -> List:
+    """Concatenate raveled tensors per bucket (jit-traceable)."""
+    out = []
+    for plan in plans:
+        parts = [leaves[k].reshape(-1) for (k, _, _, _) in plan]
+        if dtype is not None:
+            parts = [p.astype(dtype) for p in parts]
+        out.append(parts[0] if len(parts) == 1
+                   else jnp.concatenate(parts))
+    return out
+
+
+def unflatten_buckets(buckets: Sequence, plans, n: int) -> List:
+    """Inverse of flatten_buckets: static slices back to tensor shapes."""
+    leaves = [None] * n
+    for b, plan in zip(buckets, plans):
+        for (k, off, size, shape) in plan:
+            leaves[k] = jax.lax.slice(b, (off,), (off + size,)) \
+                .reshape(shape)
+    return leaves
+
+
+# -- the fused updater ------------------------------------------------------
+
+class _GroupExec:
+    """Compiled artifacts for one parameter group: the fused update
+    executable, the (optional) gradient flatten executable and its
+    bucket plan."""
+
+    __slots__ = ("update_fn", "flatten_fn", "plans")
+
+    def __init__(self, update_fn, flatten_fn=None, plans=None):
+        self.update_fn = update_fn
+        self.flatten_fn = flatten_fn
+        self.plans = plans
+
+
+class MultiTensorUpdater:
+    """Applies one optimizer step to many parameters as a handful of
+    fused XLA executables (one per dtype/state-structure group)."""
+
+    def __init__(self, optimizer, bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+        self.optimizer = optimizer
+        self.bucket_bytes = bucket_bytes
+        self._cache: Dict = {}
+        #: trace count — cache misses; steady state adds zero
+        self.compiles = 0
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    @staticmethod
+    def supports(optimizer) -> bool:
+        """A rule fuses iff it uses the stock update() driver around a
+        pure `_step` (SGLD draws eager RNG and opts out via
+        `supports_fused = False`)."""
+        from .optimizer import Optimizer
+        cls = type(optimizer)
+        return (getattr(cls, "supports_fused", True)
+                and cls.update is Optimizer.update
+                and cls._step is not Optimizer._step)
+
+    # -- grouping ----------------------------------------------------------
+    def _mp_active(self, p, state) -> bool:
+        opt = self.optimizer
+        return (opt._use_mp(p.data()) and isinstance(state, tuple)
+                and len(state) == 2 and isinstance(state[0], jax.Array))
+
+    def step(self, indexed_params, states: Dict, kvstore=None):
+        """One fused optimizer step over `indexed_params`
+        ([(index, Parameter), ...]). Mutates parameter data in place and
+        rebinds `states[index]`, exactly like the per-param loop."""
+        opt = self.optimizer
+        groups: "OrderedDict" = OrderedDict()
+        for i, p in indexed_params:
+            state = states.get(i)
+            mp = self._mp_active(p, state)
+            key = (str(p.data()._data.dtype), mp,
+                   jax.tree_util.tree_structure(state))
+            groups.setdefault(key, []).append((i, p, state))
+        # bump every update count first; identical to the interleaved
+        # loop because all counts advance in lockstep (num_update is the
+        # running max, reached at the first parameter either way)
+        for i, _ in indexed_params:
+            opt._update_count(i)
+        for gid, members in enumerate(groups.values()):
+            self._apply_group(gid, members, states, kvstore)
+
+    # -- per-group fused executables ---------------------------------------
+    def _apply_group(self, gid, members, states, kvstore):
+        opt = self.optimizer
+        _, p0, s0 = members[0]
+        mp = self._mp_active(p0, s0)
+        wdtype = p0.data()._data.dtype
+        if mp:
+            ws = [st[0] for (_, _, st) in members]       # fp32 masters
+            states_in = [st[1] for (_, _, st) in members]
+        else:
+            ws = [p.data()._data for (_, p, _) in members]
+            states_in = [st for (_, _, st) in members]
+        gs = [p.grad()._data for (_, p, _) in members]
+        idxs = [i for (i, _, _) in members]
+        lrs, wds, ts, rescale = opt._fused_hyper_vectors(idxs)
+
+        bucketed = kvstore is not None
+        cache_key = (type(opt), gid, mp, str(wdtype), bucketed,
+                     tuple((tuple(g.shape), str(g.dtype)) for g in gs),
+                     jax.tree_util.tree_structure(states_in))
+        exe = self._cache.get(cache_key)
+        if exe is None:
+            exe = self._build(members, mp, wdtype, bucketed, gs)
+            self._cache[cache_key] = exe
+            self.compiles += 1
+
+        if bucketed:
+            buckets = exe.flatten_fn(gs)
+            gs = self._sync_buckets(kvstore, gid, buckets)
+
+        if mp:
+            new_ws, new_states, low_ws = exe.update_fn(
+                states_in, ws, gs, lrs, wds, ts, rescale)
+            for k, (i, p, _) in enumerate(members):
+                p.data()._data = low_ws[k]
+                states[i] = (new_ws[k], new_states[k])
+        else:
+            new_ws, new_states = exe.update_fn(
+                states_in, ws, gs, lrs, wds, ts, rescale)
+            for k, (i, p, _) in enumerate(members):
+                p.data()._data = new_ws[k]
+                states[i] = new_states[k]
+
+    def _sync_buckets(self, kvstore, gid, buckets):
+        """One pushpull (psum / compressed allreduce) per flat bucket —
+        the O(num_params) -> O(num_buckets) collective reduction."""
+        from .ndarray import NDArray
+        nds = [NDArray(b) for b in buckets]
+        kvstore.pushpull_buckets(gid, nds)
+        return [nd._data for nd in nds]
+
+    def _build(self, members, mp, wdtype, bucketed, gs) -> _GroupExec:
+        opt = self.optimizer
+        n = len(members)
+        plans = flatten_fn = None
+        if bucketed:
+            plans = plan_buckets([g.shape for g in gs],
+                                 [g.dtype for g in gs], self.bucket_bytes)
+            _plans = plans
+
+            def _flatten(grads):
+                return flatten_buckets(grads, _plans)
+
+            flatten_fn = jax.jit(_flatten)
+
+        def run(states_in, ws, grads, lrs, wds, ts, rescale):
+            if bucketed:
+                grads = unflatten_buckets(grads, plans, n)
+            new_ws, new_states, low_ws = [], [], []
+            for k in range(n):
+                hyper = {"lr": lrs[k], "wd": wds[k], "t": ts[k],
+                         "rescale": rescale}
+                g = grads[k]
+                if mp:
+                    g = g.astype(jnp.float32)
+                nw, ns = opt._step(ws[k], g, states_in[k], hyper)
+                new_ws.append(nw)
+                new_states.append(ns)
+                if mp:
+                    low_ws.append(nw.astype(wdtype))
+            if mp:
+                return new_ws, new_states, low_ws
+            return new_ws, new_states
+
+        # donate the optimizer state (and, under multi-precision, the
+        # fp32 masters — argnum 1 is the master list then): both are
+        # owned exclusively by the Trainer and rebound after the call.
+        # Weights are NOT donated on the non-mp path: the autograd tape
+        # and user views may still alias those buffers.
+        donate = (0, 1) if mp else (0,)
+        return _GroupExec(jax.jit(run, donate_argnums=donate),
+                          flatten_fn, plans)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import autograd
-from .base import resolve_dtype, dtype_name
+from .base import resolve_dtype, dtype_name, typeof as _typeof
 from .context import Context, current_context
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
@@ -39,7 +39,7 @@ def _wrap_outputs(node: Optional[autograd.Node], raw_outs: List[Any],
         outs.append(nd)
     if node is not None:
         node.outputs = outs
-        node.out_avals = [jax.typeof(r) for r in raw_outs]
+        node.out_avals = [_typeof(r) for r in raw_outs]
     return tuple(outs) if multi else outs[0]
 
 

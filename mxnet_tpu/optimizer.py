@@ -50,6 +50,11 @@ def _state_zeros(weight, n):
 
 
 class Optimizer:
+    #: rules whose update() is the stock driver around a pure `_step`
+    #: fuse into the multi-tensor path (multi_tensor.MultiTensorUpdater);
+    #: rules with eager side effects (SGLD's RNG draw) opt out
+    supports_fused = True
+
     def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
                  clip_gradient=None, lr_scheduler=None, param_dict=None,
                  multi_precision=False, begin_num_update=0, **kwargs):
@@ -145,6 +150,17 @@ class Optimizer:
                 "t": jnp.asarray(self._index_update_count.get(index, 1),
                                  jnp.int32),
                 "rescale": _f32(self.rescale_grad)}
+
+    def _fused_hyper_vectors(self, indices):
+        """Per-tensor hyperparameters for a fused multi-tensor group,
+        as traced vectors (lr/wd/t) + a traced scalar rescale — value
+        changes (LR schedules, loss scale) never retrace. Entry k is
+        exactly what _hyper(indices[k]) would produce."""
+        lrs = jnp.asarray([self._get_lr(i) for i in indices], jnp.float32)
+        wds = jnp.asarray([self._get_wd(i) for i in indices], jnp.float32)
+        ts = jnp.asarray([self._index_update_count.get(i, 1)
+                          for i in indices], jnp.int32)
+        return lrs, wds, ts, _f32(self.rescale_grad)
 
     def _jit_step(self):
         if self._jitted is None:
@@ -483,6 +499,8 @@ class Signum(Optimizer):
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference parity). Draws the
     noise key eagerly per update, so this rule is not jit-cached."""
+
+    supports_fused = False  # eager RNG draw per update
 
     def update(self, index, weight, grad, state):
         from . import random as _random

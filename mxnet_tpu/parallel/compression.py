@@ -84,14 +84,42 @@ def compressed_psum(grad, residual, axis_name, scheme="2bit",
 
 
 def compressed_psum_tree(grads, residuals, axis_name, scheme="2bit",
-                         threshold=0.5):
-    """Apply compressed_psum leaf-wise over a gradient pytree."""
+                         threshold=0.5, bucket_bytes=None):
+    """Apply compressed_psum over a gradient pytree.
+
+    Default: leaf-wise — one quantized collective per tensor. With
+    `bucket_bytes` set, leaves are flattened (fp32) into contiguous
+    buckets of that size first, so a model with hundreds of tensors
+    pays O(num_buckets) collectives instead of O(num_tensors)
+    (EQuARX-style bucketed quantized allreduce; multi_tensor.py shares
+    the bucket planner). Note the int8 scheme's shared scale then
+    becomes per-bucket rather than per-tensor; the 2-bit scheme is
+    elementwise and numerically unchanged. Residuals keep their
+    leaf-wise structure either way, so carried state is
+    layout-compatible across both modes.
+    """
     flat_g, treedef = jax.tree_util.tree_flatten(grads)
     flat_r = treedef.flatten_up_to(residuals)
-    out_g, out_r = [], []
-    for g, r in zip(flat_g, flat_r):
-        rg, nr = compressed_psum(g, r, axis_name, scheme, threshold)
-        out_g.append(rg)
-        out_r.append(nr)
+    if bucket_bytes:
+        from ..multi_tensor import (flatten_buckets, plan_buckets,
+                                    unflatten_buckets)
+        shapes = [g.shape for g in flat_g]
+        plans = plan_buckets(shapes, [jnp.float32] * len(flat_g),
+                             int(bucket_bytes))
+        bg = flatten_buckets(flat_g, plans, dtype=jnp.float32)
+        br = flatten_buckets(flat_r, plans, dtype=jnp.float32)
+        out_bg, out_br = [], []
+        for g, r in zip(bg, br):
+            rg, nr = compressed_psum(g, r, axis_name, scheme, threshold)
+            out_bg.append(rg)
+            out_br.append(nr)
+        out_g = unflatten_buckets(out_bg, plans, len(flat_g))
+        out_r = unflatten_buckets(out_br, plans, len(flat_r))
+    else:
+        out_g, out_r = [], []
+        for g, r in zip(flat_g, flat_r):
+            rg, nr = compressed_psum(g, r, axis_name, scheme, threshold)
+            out_g.append(rg)
+            out_r.append(nr)
     return (jax.tree_util.tree_unflatten(treedef, out_g),
             jax.tree_util.tree_unflatten(treedef, out_r))

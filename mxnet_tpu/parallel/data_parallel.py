@@ -354,7 +354,7 @@ class FusedTrainStep:
         we can quantize (psum of int codes + error feedback) instead of
         the implicit fp32 AllReduce XLA inserts in the backward. Pure
         data parallelism only — parameters must be unsharded."""
-        from jax import shard_map
+        from ..base import shard_map
         from .compression import compressed_psum_tree
         from ..gluon.contrib import SyncBatchNorm
 
@@ -386,6 +386,9 @@ class FusedTrainStep:
         ndp = mesh.shape[dp]
         scheme = self.compression.get("type", "2bit")
         threshold = float(self.compression.get("threshold", 0.5))
+        # optional bucketed collective: O(num_buckets) psums instead of
+        # O(num_tensors) (compression={"bucket_bytes": 4 << 20})
+        bucket_bytes = self.compression.get("bucket_bytes")
         opt = self.optimizer
 
         def step(tr, aux, states, hyper, key, resid, *batch):
@@ -394,7 +397,8 @@ class FusedTrainStep:
             resid = jax.tree_util.tree_map(lambda r: r[0], resid)
             loss, new_aux, grads = local_grads(tr, aux, key, batch)
             grads, new_resid = compressed_psum_tree(
-                grads, resid, dp, scheme, threshold)
+                grads, resid, dp, scheme, threshold,
+                bucket_bytes=bucket_bytes)
             loss = lax.pmean(loss, dp)
             # aux (e.g. BatchNorm running stats) computed on the local
             # shard: average across replicas like the fp32 path would

@@ -23,7 +23,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..base import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .mesh import current_mesh
@@ -56,7 +56,9 @@ def _vary(x, axis_name):
     try:
         if hasattr(jax.lax, "pcast"):
             return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, (axis_name,))
+        return x  # older jax: no varying types, carries vary implicitly
     except ValueError:
         return x  # already varying over axis_name
 

@@ -24,7 +24,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..base import shard_map
 
 from ..ndarray import NDArray
 from .mesh import current_mesh
@@ -72,7 +72,9 @@ def ring_attention_local(q, k, v, axis_name, causal=True, scale=None):
         # carry type matches its (q/k/v-dependent, hence varying) outputs
         if hasattr(jax.lax, "pcast"):
             return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, (axis_name,))
+        return x  # older jax: no varying types, carries vary implicitly
 
     o0 = _vary(jnp.zeros((B, H, Tq, D), jnp.float32))
     m0 = _vary(jnp.full((B, H, Tq), _NEG, jnp.float32))

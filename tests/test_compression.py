@@ -6,7 +6,9 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from mxnet_tpu.base import shard_map
 from jax.sharding import PartitionSpec as P
 
 import mxnet_tpu as mx

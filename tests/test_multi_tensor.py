@@ -1,0 +1,338 @@
+"""Multi-tensor fused optimizer step (multi_tensor.py): numerical parity
+with the per-parameter loop, compile-cache behaviour, bucketed
+collectives, and the Trainer satellite fixes (row_sparse device path,
+loss-scale state round-trip). All fast — this file is tier-1."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.parameter import Parameter
+from mxnet_tpu.multi_tensor import (MultiTensorUpdater, flatten_buckets,
+                                    plan_buckets, unflatten_buckets)
+
+SHAPES = [(4,), (3, 5), (2, 2, 2), (7,), (1, 9)]
+
+
+def make_trainer(shapes, multi_tensor, optimizer="sgd", opt_kwargs=None,
+                 kvstore="device", compression=None, dtype="float32",
+                 seed=0):
+    rs = np.random.RandomState(seed)
+    params = {}
+    for i, s in enumerate(shapes):
+        p = Parameter(f"p{i}", shape=s, dtype=dtype)
+        p.initialize()
+        p.set_data(rs.randn(*s).astype(np.float32))
+        params[f"p{i}"] = p
+    tr = mx.gluon.Trainer(
+        params, optimizer,
+        opt_kwargs or {"learning_rate": 0.1, "momentum": 0.9},
+        kvstore=kvstore, compression_params=compression,
+        multi_tensor=multi_tensor)
+    return params, tr
+
+
+def set_grads(params, seed):
+    rs = np.random.RandomState(seed)
+    for p in params.values():
+        if p.grad_req == "null":
+            continue
+        p.data()._grad._data = jnp.asarray(
+            rs.randn(*p.shape)).astype(p.data()._data.dtype)
+
+
+def run_parity(optimizer, opt_kwargs, steps=3, atol=0.0, dtype="float32",
+               kvstore="device", compression=None, shapes=SHAPES):
+    outs = []
+    for mt in (True, False):
+        params, tr = make_trainer(shapes, mt, optimizer, opt_kwargs,
+                                  kvstore=kvstore, compression=compression,
+                                  dtype=dtype)
+        for step in range(steps):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        outs.append({k: p.data().asnumpy().astype(np.float32)
+                     for k, p in params.items()})
+        if mt:
+            assert tr._mt_updater is not None, "fast path did not engage"
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0,
+                                   atol=atol, err_msg=k)
+
+
+# -- parity matrix ----------------------------------------------------------
+
+def test_parity_sgd_momentum_exact():
+    run_parity("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01},
+               atol=0.0)
+
+
+def test_parity_adam():
+    run_parity("adam", {"learning_rate": 0.01, "wd": 0.001}, atol=1e-6)
+
+
+def test_parity_lamb():
+    run_parity("lamb", {"learning_rate": 0.01, "wd": 0.01}, atol=1e-6)
+
+
+def test_parity_multi_precision_bf16_master_fp32():
+    outs = []
+    for mt in (True, False):
+        params, tr = make_trainer(
+            SHAPES, mt, "sgd",
+            {"learning_rate": 0.01, "momentum": 0.9,
+             "multi_precision": True}, dtype="bfloat16")
+        for step in range(4):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        for st in tr._states.values():
+            assert isinstance(st, tuple) and st[0].dtype == jnp.float32, \
+                "fp32 master weight lost"
+        outs.append({k: p.data().asnumpy().astype(np.float32)
+                     for k, p in params.items()})
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_parity_compressed_tpu_sync_exact():
+    # 2-bit quantization + error feedback is elementwise, so bucketed
+    # compression must match per-tensor compression bit for bit
+    run_parity("sgd", {"learning_rate": 0.1, "momentum": 0.9}, steps=4,
+               atol=0.0, kvstore="tpu_sync",
+               compression={"type": "2bit", "threshold": 0.5})
+
+
+def test_parity_tpu_sync_uncompressed_exact():
+    run_parity("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+               atol=0.0, kvstore="tpu_sync")
+
+
+def test_parity_stale_grad_null_mixed():
+    outs, frozen = [], {}
+    for mt in (True, False):
+        params, tr = make_trainer(SHAPES, mt, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9})
+        # freeze two params mid-matrix AFTER trainer construction —
+        # the stale-grad case: they must be skipped, not updated
+        params["p1"].grad_req = "null"
+        params["p3"].grad_req = "null"
+        frozen = {k: params[k].data().asnumpy() for k in ("p1", "p3")}
+        for step in range(3):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        for k, v in frozen.items():
+            np.testing.assert_array_equal(params[k].data().asnumpy(), v)
+        outs.append({k: p.data().asnumpy() for k, p in params.items()})
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0, atol=0,
+                                   err_msg=k)
+
+
+def test_parity_lr_scheduler_no_retrace():
+    outs = []
+    for mt in (True, False):
+        params, tr = make_trainer(
+            SHAPES, mt, "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9,
+             "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+                 step=2, factor=0.5, base_lr=0.1)})
+        for step in range(5):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        if mt:
+            # LR changed mid-run; hyper values are traced, not baked
+            assert tr._mt_updater.compiles == 1
+        outs.append({k: p.data().asnumpy() for k, p in params.items()})
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0, atol=0,
+                                   err_msg=k)
+
+
+# -- compile cache ----------------------------------------------------------
+
+def test_compile_cache_hit_no_retrace():
+    params, tr = make_trainer(SHAPES, True, "adam",
+                              {"learning_rate": 0.01})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    upd = tr._mt_updater
+    first = upd.compiles
+    assert first == upd.cache_size > 0
+    for step in range(1, 4):  # same shapes -> zero retraces
+        set_grads(params, step)
+        tr.step(batch_size=2)
+    assert upd.compiles == first
+    assert upd.cache_size == first
+
+
+def test_compile_cache_groups_by_dtype():
+    rs = np.random.RandomState(0)
+    params = {}
+    for i, (s, dt) in enumerate([((4,), "float32"), ((3, 3), "float32"),
+                                 ((5,), "bfloat16"), ((2, 2), "bfloat16")]):
+        p = Parameter(f"p{i}", shape=s, dtype=dt)
+        p.initialize()
+        p.set_data(rs.randn(*s).astype(np.float32))
+        params[f"p{i}"] = p
+    tr = mx.gluon.Trainer(params, "sgd", {"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    tr.step(batch_size=2)
+    assert tr._mt_updater.cache_size == 2  # one executable per dtype group
+    assert tr._mt_updater.compiles == 2
+
+
+def test_multi_tensor_opt_out_flag():
+    params, tr = make_trainer(SHAPES, False, "sgd", {"learning_rate": 0.1})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    assert tr._mt_updater is None
+
+
+def test_sgld_falls_back_to_loop():
+    assert not MultiTensorUpdater.supports(mx.optimizer.SGLD())
+    params, tr = make_trainer(SHAPES[:2], True, "sgld",
+                              {"learning_rate": 0.01})
+    set_grads(params, 0)
+    tr.step(batch_size=2)  # must not crash, must not engage fast path
+    assert tr._mt_updater is None
+
+
+def test_supports_covers_standard_rules():
+    for name in ["sgd", "nag", "adam", "adamw", "lamb", "lars", "rmsprop",
+                 "adagrad", "adadelta", "ftrl", "signum"]:
+        assert MultiTensorUpdater.supports(mx.optimizer.create(name)), name
+
+
+# -- bucket planner ---------------------------------------------------------
+
+def test_plan_buckets_respects_budget_and_order():
+    shapes = [(100,), (200,), (50,), (1000,), (10,)]
+    plans = plan_buckets(shapes, [jnp.float32] * 5, bucket_bytes=1200)
+    # every tensor appears exactly once, in order, offsets contiguous
+    seen = []
+    for plan in plans:
+        off = 0
+        nbytes = 0
+        for (k, o, size, shape) in plan:
+            assert o == off
+            off += size
+            nbytes += size * 4
+            seen.append(k)
+        assert nbytes <= 1200 or len(plan) == 1  # oversize = own bucket
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_bucket_flatten_roundtrip():
+    rs = np.random.RandomState(3)
+    leaves = [jnp.asarray(rs.randn(*s).astype(np.float32))
+              for s in SHAPES]
+    plans = plan_buckets([l.shape for l in leaves],
+                         [l.dtype for l in leaves], bucket_bytes=64)
+    buckets = flatten_buckets(leaves, plans)
+    assert len(buckets) > 1
+    back = unflatten_buckets(buckets, plans, len(leaves))
+    for a, b in zip(leaves, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compressed_psum_tree_bucketed_matches_leafwise_2bit():
+    from mxnet_tpu.parallel.compression import compressed_psum_tree
+    from jax.sharding import Mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    rs = np.random.RandomState(0)
+    grads = {f"g{i}": jnp.asarray(rs.randn(4, 3, 5).astype(np.float32))
+             for i in range(3)}
+    resid = jax.tree_util.tree_map(
+        lambda g: jnp.zeros((4,) + g.shape[1:], jnp.float32), grads)
+
+    def run(bucket_bytes):
+        def f(g, r):
+            out_g, out_r = compressed_psum_tree(
+                jax.tree_util.tree_map(lambda x: x[0], g),
+                jax.tree_util.tree_map(lambda x: x[0], r),
+                "dp", "2bit", 0.5, bucket_bytes=bucket_bytes)
+            return jax.tree_util.tree_map(lambda x: x[None],
+                                          (out_g, out_r))
+        out = shard_map(f, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                        out_specs=P("dp"))(grads, resid)
+        # reduced values are replicated; read shard 0
+        return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), out)
+
+    leafwise_g, leafwise_r = run(None)
+    bucketed_g, bucketed_r = run(32)  # tiny buckets -> multiple psums
+    for k in grads:
+        np.testing.assert_array_equal(leafwise_g[k], bucketed_g[k])
+        np.testing.assert_array_equal(leafwise_r[k], bucketed_r[k])
+
+
+# -- satellite fixes --------------------------------------------------------
+
+def test_row_sparse_grad_stays_on_device():
+    p = Parameter("emb", shape=(6, 3), grad_stype="row_sparse")
+    p.initialize()
+    p.set_data(np.ones((6, 3), np.float32))
+    tr = mx.gluon.Trainer({"emb": p}, "sgd", {"learning_rate": 0.1})
+    g = np.zeros((6, 3), np.float32)
+    g[1] = 1.0
+    g[4] = 2.0
+    p.data()._grad._data = jnp.asarray(g)
+    rsp = tr._row_sparse_grad(p)
+    assert rsp.indices.asnumpy().tolist() == [1, 4]
+    # int64 when x64 is enabled, int32 otherwise (jax config-dependent)
+    assert np.issubdtype(rsp.indices.dtype, np.integer)
+    assert rsp.data.shape == (2, 3)  # only touched rows materialized
+    tr.step(batch_size=1)
+    out = p.data().asnumpy()
+    np.testing.assert_allclose(out[1], 0.9, atol=1e-6)   # 1 - lr*g
+    np.testing.assert_allclose(out[4], 0.8, atol=1e-6)
+    np.testing.assert_allclose(out[0], 1.0)  # untouched row unchanged
+
+
+def test_save_load_states_roundtrip_scale(tmp_path):
+    params, tr = make_trainer(SHAPES[:2], True, "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    tr._scale = 128.0  # loss-scale config (amp dynamic scaling)
+    tr._optimizer.rescale_grad = 128.0 / 2
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+
+    params2, tr2 = make_trainer(SHAPES[:2], True, "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+    tr2.load_states(fname)
+    assert tr2._scale == 128.0
+    assert tr2._optimizer.rescale_grad == 64.0
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    # resumed momentum matches
+    for i in tr._states:
+        np.testing.assert_allclose(np.asarray(tr._states[i]),
+                                   np.asarray(tr2._states[i]))
+
+
+def test_load_states_old_format_keeps_live_scale(tmp_path):
+    import pickle
+    params, tr = make_trainer(SHAPES[:2], True, "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    fname = str(tmp_path / "old.states")
+    host = jax.tree_util.tree_map(
+        lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x,
+        tr._states)
+    with open(fname, "wb") as f:  # pre-scale blob layout
+        pickle.dump({"states": host, "num_update": 1,
+                     "index_update_count": {0: 1, 1: 1}}, f)
+    params2, tr2 = make_trainer(SHAPES[:2], True, "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+    tr2._scale = 7.0
+    tr2.load_states(fname)
+    assert tr2._scale == 7.0  # old files do not clobber live config
