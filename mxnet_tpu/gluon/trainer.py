@@ -26,7 +26,8 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, multi_tensor=True):
+                 update_on_kvstore=None, multi_tensor=True,
+                 zero1=False, zero1_shards=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -50,6 +51,12 @@ class Trainer:
         # one dispatch per parameter; opt out with multi_tensor=False
         self._multi_tensor = multi_tensor
         self._mt_updater = None
+        # ZeRO-1 weight-update sharding (arXiv:2004.13336): grads
+        # reduce-scatter per bucket, each replica updates its 1/N shard
+        # with shard-sized optimizer state, weights all-gather back
+        self._zero1 = bool(zero1)
+        self._zero1_shards = zero1_shards
+        self._zero1_active = False
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):
@@ -71,12 +78,51 @@ class Trainer:
                 self._kvstore.init(i, p.data())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
+        self._zero1_active = self._resolve_zero1()
         if not (self._kvstore is not None and self._update_on_kvstore):
+            skip = set()
+            if self._zero1_active:
+                # fused-eligible params keep their state SHARD-SIZED
+                # inside the updater's resident groups; creating the
+                # full per-param state here would defeat the N-fold
+                # memory cut. The loop fallback creates lazily for any
+                # param that later drops off the fused path.
+                skip = {i for i, p in enumerate(self._params)
+                        if p._grad_stype != "row_sparse"}
             for i, p in enumerate(self._params):
+                if i in skip:
+                    continue
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(
                         i, p.data())
         self._init_done = True
+
+    def _resolve_zero1(self) -> bool:
+        """Whether the ZeRO-1 sharded update can actually run; degrades
+        to the unsharded fused path with ONE warning otherwise."""
+        if not self._zero1:
+            return False
+        import warnings
+        if self._kvstore is not None and self._update_on_kvstore:
+            warnings.warn(
+                "zero1=True is incompatible with update_on_kvstore "
+                "(the store owns the optimizer); running unsharded")
+            return False
+        if not self._multi_tensor or \
+                not _mt.MultiTensorUpdater.supports(self._optimizer):
+            warnings.warn(
+                "zero1=True requires the multi-tensor fused path "
+                f"(multi_tensor=True and a fusable rule; got "
+                f"{type(self._optimizer).__name__}); running unsharded")
+            return False
+        if self._kvstore is not None and \
+                not self._kvstore.supports_reduce_scatter():
+            warnings.warn(
+                f"kvstore '{self._kvstore.type}' cannot reduce-scatter "
+                "grad buckets; zero1 degrades to the unsharded fused "
+                "path")
+            return False
+        return True
 
     @property
     def learning_rate(self):
@@ -136,7 +182,9 @@ class Trainer:
         fused = self._fused_indices()
         if fused:
             if self._mt_updater is None:
-                self._mt_updater = _mt.MultiTensorUpdater(self._optimizer)
+                self._mt_updater = _mt.MultiTensorUpdater(
+                    self._optimizer, zero1=self._zero1_active,
+                    num_shards=self._zero1_shards)
             self._mt_updater.step(fused, self._states,
                                   kvstore=self._kvstore)
         done = {i for i, _ in fused}
@@ -154,6 +202,13 @@ class Trainer:
                 if self._kvstore is not None:
                     # sync-only store: allreduce grads, update locally
                     self._kvstore.pushpull(i, grad, out=grad)
+                if i not in self._states:
+                    # zero1 skipped this param's full-size state at
+                    # init expecting it on the fused path; it fell back
+                    # to the loop (e.g. grad_req changed), so create now
+                    self._states[i] = \
+                        self._optimizer.create_state_multi_precision(
+                            i, p.data())
                 self._states[i] = self._optimizer.update(
                     i, p.data(), grad, self._states[i])
 
@@ -161,9 +216,16 @@ class Trainer:
     def save_states(self, fname):
         import pickle
         self._init_states()
+        merged = dict(self._states)
+        if self._mt_updater is not None and self._mt_updater.zero1:
+            # gather-on-save: sharded bucket state goes back to full
+            # per-parameter trees, so the checkpoint loads under ANY
+            # replica count (or with zero1 off). A copy keeps the live
+            # states dict clean — resident groups stay sharded.
+            self._mt_updater.zero1_export_states(merged)
         host = jax.tree_util.tree_map(
             lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x,
-            self._states)
+            merged)
         with open(fname, "wb") as f:
             pickle.dump({"states": host,
                          "num_update": self._optimizer.num_update,
@@ -180,6 +242,11 @@ class Trainer:
         with open(fname, "rb") as f:
             blob = pickle.load(f)
         self._states = jax.tree_util.tree_map(jnp.asarray, blob["states"])
+        if self._mt_updater is not None and self._mt_updater.zero1:
+            # drop resident sharded state; the next step re-imports the
+            # loaded per-param trees into (possibly differently sized)
+            # shard groups — checkpoints are replica-count-portable
+            self._mt_updater.zero1_reset()
         self._optimizer.num_update = blob["num_update"]
         self._optimizer._index_update_count = blob["index_update_count"]
         # pre-scale checkpoints (old format) keep the live values

@@ -135,8 +135,10 @@ def _pallas_forward(q, k, v, causal, scale, block_q=None, block_k=None,
                 s = _mask_lengths(s, ki, block_k, len_b)
             m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
-            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
-            corr = jnp.where(jnp.isfinite(m_), jnp.exp(m_ - m_new), 0.0)
+            # "row has seen a valid key" == running max left -inf; spelled
+            # as a comparison because Mosaic has no is_finite lowering
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_ > -jnp.inf, jnp.exp(m_ - m_new), 0.0)
             l_new = corr * l_ + jnp.sum(p, axis=-1)
             acc_new = corr[:, None] * acc_ + p @ vblk
             return m_new, l_new, acc_new

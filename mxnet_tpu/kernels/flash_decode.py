@@ -102,8 +102,11 @@ def _flash_decode_pallas(q, k_cache, v_cache, valid_len, scale,
             s = jnp.where(pos < vl, s, -jnp.inf)
             m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
-            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
-            corr = jnp.where(jnp.isfinite(m_),
+            # comparison instead of jnp.isfinite: Mosaic has no
+            # is_finite lowering; the running max only leaves -inf
+            # once a valid key has been seen
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_ > -jnp.inf,
                              jnp.exp(m_ - m_new), 0.0)
             return (m_new, corr * l_ + jnp.sum(p, axis=-1),
                     corr[:, None] * acc_ + p @ vblk)
@@ -220,8 +223,8 @@ def _flash_decode_pallas_q8(q, k8, ks, v8, vs, valid_len, scale,
             s = jnp.where(pos < vl, s, -jnp.inf)
             m_new = jnp.maximum(m_, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
-            p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
-            corr = jnp.where(jnp.isfinite(m_),
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_ > -jnp.inf,
                              jnp.exp(m_ - m_new), 0.0)
             ps = p * vsb[:, 0][None, :]                  # fold v scale
             return (m_new, corr * l_ + jnp.sum(p, axis=-1),

@@ -183,6 +183,35 @@ class KVStore:
                           priority=priority)
         return buckets
 
+    # -- ZeRO-1 bucket collectives (multi_tensor.py zero1 path) ------------
+    def supports_reduce_scatter(self) -> bool:
+        """Whether grad buckets may be reduce-scattered so each replica
+        sees only its 1/N shard after the sync. Requires the same
+        elementwise aggregation semantics as flat pushpull — an attached
+        optimizer (update-on-kvstore) or stale per-replica application
+        (dist_async) makes the shard-local update meaningless, and the
+        PS store's server-side keys cannot host anonymous shards."""
+        return self._optimizer is None
+
+    def reduce_scatter_buckets(self, tag, buckets, priority=0):
+        """Cross-replica reduction of flat grad buckets, scatter-ready:
+        in-process stores share one address space, so the reduction (+
+        2-bit/int8 error-feedback compression) is performed here per
+        bucket and the caller's sharded executable takes the 1/N slice
+        placement for free. Residuals are namespaced apart from the
+        allreduce path ONLY by tag reuse rules — the same `__flat__`
+        keys are used so a zero1 toggle mid-run inherits feedback state
+        and stays bit-identical to pushpull_buckets' compression."""
+        return self.pushpull_buckets(tag, buckets, priority)
+
+    def all_gather_buckets(self, tag, buckets, priority=0):
+        """Rebuild full flat buckets from updated weight shards. The
+        in-process stores keep every shard in one address space (the
+        sharded executable's output layout IS the gathered bucket), so
+        this is the identity; a multi-process store must override with a
+        real all-gather."""
+        return buckets
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """PS-path sparse pull: only requested rows travel (reference:
         kvstore dist row_sparse_pull)."""
@@ -253,6 +282,11 @@ class AsyncKVStore(KVStore):
     aggregation barrier — each update sees whatever weights the previous
     ones left (single-process model of PS staleness; multi-process
     arrival order comes from the host threads driving the pushes)."""
+
+    def supports_reduce_scatter(self) -> bool:
+        # stale per-replica application is incompatible with a single
+        # reduced shard — zero1 must degrade to the unsharded path
+        return False
 
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
@@ -361,6 +395,15 @@ class DistPSKVStore(KVStore):
 
     def supports_flat_pushpull(self) -> bool:
         return False  # server keys are stateful; buckets have no init
+
+    def supports_reduce_scatter(self) -> bool:
+        return False  # ditto: no anonymous shard keys on the server
+
+    def reduce_scatter_buckets(self, tag, buckets, priority=0):
+        raise RuntimeError(
+            "the parameter-server store cannot reduce-scatter anonymous "
+            "buckets; Trainer(zero1=True) should have degraded to the "
+            "unsharded fused path (supports_reduce_scatter() is False)")
 
     def set_optimizer(self, optimizer):
         # "update on kvstore": the SERVER owns the optimizer + states

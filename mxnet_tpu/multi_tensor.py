@@ -40,11 +40,20 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["MultiTensorUpdater", "plan_buckets", "flatten_buckets",
-           "unflatten_buckets", "DEFAULT_BUCKET_BYTES"]
+           "unflatten_buckets", "DEFAULT_BUCKET_BYTES",
+           "zero1_padded_sizes", "bucket_segments", "zero1_update_shard"]
 
 #: bucket size for flattened-gradient collectives (~4 MB, the sweet spot
 #: between per-tensor launch overhead and collective latency hiding)
 DEFAULT_BUCKET_BYTES = 4 << 20
+
+#: shard granularity for ZeRO-1 bucket padding: every shard is a whole
+#: number of TPU lanes so the per-replica slice keeps the (8, 128)
+#: layout tileable
+ZERO1_LANE = 128
+
+#: mesh axis name for the eager updater's weight-update shards
+ZERO1_AXIS = "z1"
 
 
 # -- bucketing (pure shape arithmetic; traceable flatten/unflatten) --------
@@ -86,13 +95,98 @@ def flatten_buckets(leaves: Sequence, plans, dtype=None) -> List:
 
 
 def unflatten_buckets(buckets: Sequence, plans, n: int) -> List:
-    """Inverse of flatten_buckets: static slices back to tensor shapes."""
+    """Inverse of flatten_buckets: static slices back to tensor shapes.
+    Tolerates trailing padding in the buckets (offsets are static, so a
+    ZeRO-1 padded bucket unflattens with the same plan)."""
     leaves = [None] * n
     for b, plan in zip(buckets, plans):
         for (k, off, size, shape) in plan:
             leaves[k] = jax.lax.slice(b, (off,), (off + size,)) \
                 .reshape(shape)
     return leaves
+
+
+# -- ZeRO-1 sharding helpers (arXiv:2004.13336) -----------------------------
+
+def zero1_padded_sizes(plans, num_shards: int,
+                       lane: int = ZERO1_LANE) -> List[int]:
+    """Padded total size per bucket: the smallest multiple of
+    num_shards*lane covering the bucket, so every replica owns an equal,
+    lane-aligned contiguous shard."""
+    quantum = num_shards * lane
+    out = []
+    for plan in plans:
+        used = plan[-1][1] + plan[-1][2]
+        out.append(max(quantum, -(-used // quantum) * quantum))
+    return out
+
+
+def pad_buckets(buckets: Sequence, plans, padded: Sequence[int]) -> List:
+    """Zero-pad flat buckets to their ZeRO-1 padded sizes (traceable)."""
+    out = []
+    for b, plan, tot in zip(buckets, plans, padded):
+        used = plan[-1][1] + plan[-1][2]
+        if tot > used:
+            b = jnp.concatenate([b, jnp.zeros((tot - used,), b.dtype)])
+        out.append(b)
+    return out
+
+
+def bucket_segments(plans, padded: Sequence[int], n: int) -> List:
+    """Per-bucket int32 segment ids mapping each flat element to its
+    group-local tensor index; padding elements get the out-of-range id
+    `n` so they pick up the harmless pad entry of the hyper vectors and
+    form their own (all-zero) norm segment."""
+    segs = []
+    for plan, tot in zip(plans, padded):
+        s = _np.full((tot,), n, _np.int32)
+        for (k, off, size, _) in plan:
+            s[off:off + size] = k
+        segs.append(s)
+    return segs
+
+
+def _tensorwise_norm(seg, num_segments: int, axis_name):
+    """Build `norm(x)` for Optimizer._zero1_step: per-element broadcast
+    of each tensor's GLOBAL L2 norm, computed as segment partial sums on
+    the local shard + a cross-shard psum."""
+    def norm(x):
+        part = jax.ops.segment_sum(jnp.square(x.astype(jnp.float32)), seg,
+                                   num_segments=num_segments,
+                                   indices_are_sorted=True)
+        if axis_name is not None:
+            part = jax.lax.psum(part, axis_name)
+        return jnp.sqrt(part)[seg]
+    return norm
+
+
+def zero1_update_shard(opt, w, g, state, hyper, seg, num_segments: int,
+                       axis_name):
+    """Run one fused optimizer update on a 1/N contiguous shard of a
+    flattened bucket. `hyper` values may be scalars (FusedTrainStep) or
+    per-element vectors (eager updater); norm-based rules (LAMB/LARS)
+    get exact global per-tensor norms through the seg/psum helper."""
+    return opt._zero1_step(w, g, state, hyper,
+                           _tensorwise_norm(seg, num_segments, axis_name))
+
+
+class _FlatWeight:
+    """Minimal weight stand-in for Optimizer.create_state on a flat
+    bucket (works under jax.eval_shape, so probing a state's structure
+    and dtypes never allocates bucket-sized buffers)."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data):
+        self._data = data
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
 
 
 # -- the fused updater ------------------------------------------------------
@@ -110,16 +204,62 @@ class _GroupExec:
         self.plans = plans
 
 
+class _ZeroGroup:
+    """One ZeRO-1 parameter group: compiled executables plus the
+    RESIDENT sharded optimizer state. Unlike the unsharded path (state
+    lives per-parameter in Trainer._states), the authoritative state
+    here is one tree per flat bucket, laid out P(z1) across the update
+    mesh so each device holds 1/N of every moment/master buffer."""
+
+    __slots__ = ("idxs", "mp", "plans", "padded", "segs", "shard",
+                 "flatten_fn", "flatpad_fn", "pad_fn", "wpad_fn",
+                 "update_fn", "unflatten_fn", "states", "masters",
+                 "wshards", "wrote", "home")
+
+    def __init__(self, idxs, mp, plans, padded, segs, shard, flatten_fn,
+                 flatpad_fn, pad_fn, wpad_fn, update_fn, unflatten_fn,
+                 states, masters, home):
+        self.idxs = idxs
+        self.mp = mp
+        self.plans = plans
+        self.padded = padded
+        self.segs = segs
+        self.shard = shard        # NamedSharding(mesh, P(z1))
+        self.flatten_fn = flatten_fn
+        self.flatpad_fn = flatpad_fn
+        self.pad_fn = pad_fn
+        self.wpad_fn = wpad_fn
+        self.update_fn = update_fn
+        self.unflatten_fn = unflatten_fn
+        self.states = states      # per bucket: sharded state tree
+        self.masters = masters    # per bucket: sharded fp32 flat (mp)
+        self.home = home          # SingleDeviceSharding: gather target
+        #: resident P(z1) weight buckets (non-mp) — valid while `wrote`
+        #: still matches the parameters' live arrays
+        self.wshards = None
+        #: the per-tensor arrays written back last step, for the
+        #: identity staleness check (set_data() breaks the match and
+        #: forces a re-import)
+        self.wrote = None
+
+
 class MultiTensorUpdater:
     """Applies one optimizer step to many parameters as a handful of
     fused XLA executables (one per dtype/state-structure group)."""
 
-    def __init__(self, optimizer, bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+    def __init__(self, optimizer, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 zero1: bool = False, num_shards: int = None):
         self.optimizer = optimizer
         self.bucket_bytes = bucket_bytes
         self._cache: Dict = {}
         #: trace count — cache misses; steady state adds zero
         self.compiles = 0
+        #: ZeRO-1 weight-update sharding: shard the fused step (and all
+        #: optimizer state) over `num_shards` local devices
+        self.zero1 = bool(zero1)
+        self._num_shards = num_shards
+        self._zmesh = None
+        self._zgroups: Dict = {}
 
     @property
     def cache_size(self) -> int:
@@ -149,10 +289,17 @@ class MultiTensorUpdater:
         opt = self.optimizer
         groups: "OrderedDict" = OrderedDict()
         for i, p in indexed_params:
-            state = states.get(i)
-            mp = self._mp_active(p, state)
-            key = (str(p.data()._data.dtype), mp,
-                   jax.tree_util.tree_structure(state))
+            if self.zero1 and i not in states:
+                # state lives shard-sized inside a _ZeroGroup (or is yet
+                # to be created there) — group by weight dtype + mp only
+                mp = opt._use_mp(p.data())
+                skey = ("__zero1__", mp)
+                state = None
+            else:
+                state = states.get(i)
+                mp = self._mp_active(p, state)
+                skey = jax.tree_util.tree_structure(state)
+            key = (str(p.data()._data.dtype), mp, skey)
             groups.setdefault(key, []).append((i, p, state))
         # bump every update count first; identical to the interleaved
         # loop because all counts advance in lockstep (num_update is the
@@ -160,7 +307,10 @@ class MultiTensorUpdater:
         for i, _ in indexed_params:
             opt._update_count(i)
         for gid, members in enumerate(groups.values()):
-            self._apply_group(gid, members, states, kvstore)
+            if self.zero1:
+                self._apply_group_zero1(gid, members, states, kvstore)
+            else:
+                self._apply_group(gid, members, states, kvstore)
 
     # -- per-group fused executables ---------------------------------------
     def _apply_group(self, gid, members, states, kvstore):
@@ -254,3 +404,278 @@ class MultiTensorUpdater:
         donate = (0, 1) if mp else (0,)
         return _GroupExec(jax.jit(run, donate_argnums=donate),
                           flatten_fn, plans)
+
+    # -- ZeRO-1 weight-update sharding (arXiv:2004.13336) ------------------
+    def _zero1_mesh(self):
+        if self._zmesh is None:
+            devs = jax.devices()
+            n = self._num_shards or len(devs)
+            n = max(1, min(int(n), len(devs)))
+            self._zmesh = jax.sharding.Mesh(_np.asarray(devs[:n]),
+                                            (ZERO1_AXIS,))
+        return self._zmesh
+
+    @property
+    def num_shards(self) -> int:
+        return int(self._zero1_mesh().devices.size)
+
+    def _apply_group_zero1(self, gid, members, states, kvstore):
+        """ZeRO-1 analogue of _apply_group: reduce(-scatter) the grad
+        buckets, update only this replica's 1/N shard of every bucket
+        (state resident sharded on the update mesh), gather the new
+        weights back to full per-tensor form."""
+        opt = self.optimizer
+        idxs = tuple(i for (i, _, _) in members)
+        _, p0, s0 = members[0]
+        wdtype = p0.data()._data.dtype
+        mp = (self._mp_active(p0, s0) if s0 is not None
+              else opt._use_mp(p0.data()))
+        gs = [p.grad()._data for (_, p, _) in members]
+        cache_key = (type(opt), mp, str(wdtype), idxs,
+                     tuple((tuple(g.shape), str(g.dtype)) for g in gs))
+        zg = self._zgroups.get(cache_key)
+        if zg is None:
+            # group composition changed (e.g. a grad_req toggled):
+            # spill any overlapping group's sharded state back to
+            # per-param form so the rebuild imports live values
+            for k2 in [k for k, g2 in self._zgroups.items()
+                       if set(g2.idxs) & set(idxs)]:
+                self._export_group(self._zgroups.pop(k2), states)
+            zg = self._build_zero1(members, mp, wdtype, states)
+            self._zgroups[cache_key] = zg
+            self.compiles += 1
+
+        lrs, wds, ts, rescale = opt._fused_hyper_vectors(list(idxs))
+        # entry n is the padding segment's hyper: lr/wd 0, t=1 (keeps
+        # Adam's bias correction away from 1-beta**0 == 0)
+        lrs = jnp.concatenate([lrs, jnp.zeros((1,), lrs.dtype)])
+        wds = jnp.concatenate([wds, jnp.zeros((1,), wds.dtype)])
+        ts = jnp.concatenate([ts, jnp.ones((1,), ts.dtype)])
+        extras = opt._zero1_hyper_extras(lrs, wds, ts)
+
+        if kvstore is not None:
+            buckets = self._reduce_scatter(kvstore, gid,
+                                           zg.flatten_fn(gs))
+            pads = zg.pad_fn(buckets)
+        else:
+            pads = zg.flatpad_fn(gs)
+        # THE scatter: pad on the source device, then place each grad
+        # bucket P(z1) so every replica receives exactly its 1/N slice
+        # (params/grads may be committed to a single device — explicit
+        # device_put is the one legal path onto the update mesh)
+        g_bks = jax.device_put(pads, [zg.shard] * len(pads))
+        if mp:
+            zg.states, zg.masters, w_bks = zg.update_fn(
+                zg.states, zg.masters, g_bks, zg.segs,
+                lrs, wds, ts, rescale, extras)
+        else:
+            ws = [p.data()._data for (_, p, _) in members]
+            if zg.wrote is not None and len(zg.wrote) == len(ws) and \
+                    all(a is b for a, b in zip(ws, zg.wrote)):
+                # weights unchanged since our last write-back: reuse the
+                # resident sharded buckets, skip the re-upload
+                w_in = zg.wshards
+            else:
+                w_in = jax.device_put(zg.wpad_fn(ws),
+                                      [zg.shard] * len(zg.padded))
+            zg.states, w_bks = zg.update_fn(
+                zg.states, w_in, g_bks, zg.segs, lrs, wds, ts, rescale,
+                extras)
+            zg.wshards = w_bks
+        # the all-gather: one device_put per bucket back to the home
+        # device (single-process gather — no host bounce). The arrays
+        # land committed there, which matches where eager NDArray data
+        # already lives; explicit device_put remains the path back onto
+        # any mesh.
+        new_ws = zg.unflatten_fn(jax.device_put(
+            w_bks, [zg.home] * len(w_bks)))
+        for k, (i, p, _) in enumerate(members):
+            p.data()._data = new_ws[k]
+        if not mp:
+            zg.wrote = list(new_ws)
+
+    def _reduce_scatter(self, kvstore, gid, buckets):
+        """Cross-replica reduction of the UNPADDED grad buckets (keeps
+        compression residuals bit-identical to the allreduce path); the
+        scatter placement is done by the sharded executable's specs."""
+        from .ndarray import NDArray
+        nds = [NDArray(b) for b in buckets]
+        kvstore.reduce_scatter_buckets(gid, nds)
+        return [nd._data for nd in nds]
+
+    def _build_zero1(self, members, mp, wdtype, states) -> _ZeroGroup:
+        opt = self.optimizer
+        mesh = self._zero1_mesh()
+        nsh = int(mesh.devices.size)
+        n = len(members)
+        idxs = [i for (i, _, _) in members]
+        P = jax.sharding.PartitionSpec
+        shard = jax.sharding.NamedSharding(mesh, P(ZERO1_AXIS))
+        gs = [p.grad()._data for (_, p, _) in members]
+        plans = plan_buckets([g.shape for g in gs], [g.dtype for g in gs],
+                             self.bucket_bytes)
+        padded = zero1_padded_sizes(plans, nsh)
+        segs = [jax.device_put(jnp.asarray(s), shard)
+                for s in bucket_segments(plans, padded, n)]
+
+        missing = [i for i in idxs if i not in states]
+        if len(missing) == n:
+            bucket_states, masters = self._fresh_zero1_state(
+                members, mp, wdtype, plans, padded, shard)
+        else:
+            member_states = []
+            for (i, p, _) in members:
+                st = states.pop(i) if i in states else \
+                    opt.create_state_multi_precision(i, p.data())
+                member_states.append(st)
+            bucket_states, masters = self._import_zero1_state(
+                member_states, mp, plans, padded, shard)
+
+        nbk = len(plans)
+        from .base import shard_map
+
+        def body(st_bks, m_or_w_bks, g_bks, seg_bks, lrs, wds, ts,
+                 rescale, extras):
+            new_st, new_w, low_w = [], [], []
+            for j in range(nbk):
+                seg = seg_bks[j]
+                hyper = {"lr": lrs[seg], "wd": wds[seg], "t": ts[seg],
+                         "rescale": rescale}
+                for k2, vec in extras.items():
+                    hyper[k2] = vec[seg]
+                g = g_bks[j]
+                if mp:
+                    g = g.astype(jnp.float32)
+                nw, ns = zero1_update_shard(opt, m_or_w_bks[j], g,
+                                            st_bks[j], hyper, seg,
+                                            n + 1, ZERO1_AXIS)
+                new_st.append(ns)
+                new_w.append(nw)
+                if mp:
+                    low_w.append(nw.astype(wdtype))
+            if mp:
+                return new_st, new_w, low_w
+            return new_st, new_w
+
+        Pz, Pr = P(ZERO1_AXIS), P()
+        run = shard_map(
+            body, mesh=mesh,
+            in_specs=(Pz, Pz, Pz, Pz, Pr, Pr, Pr, Pr, Pr),
+            out_specs=(Pz, Pz, Pz) if mp else (Pz, Pz),
+            check_rep=False)
+
+        # donate the resident sharded state, the masters (mp) or
+        # resident weight buckets, and the scattered grad buckets —
+        # nothing user-visible aliases them
+        update_fn = jax.jit(run, donate_argnums=(0, 1, 2))
+        flatten_fn = jax.jit(lambda gs_: flatten_buckets(gs_, plans))
+        pad_fn = jax.jit(lambda bks: pad_buckets(bks, plans, padded))
+        flatpad_fn = jax.jit(lambda gs_: pad_buckets(
+            flatten_buckets(gs_, plans), plans, padded))
+        wpad_fn = flatpad_fn
+        unflatten_fn = jax.jit(
+            lambda bks: unflatten_buckets(bks, plans, n))
+        ws0 = members[0][1].data()._data
+        home = jax.sharding.SingleDeviceSharding(
+            next(iter(ws0.devices())))
+        return _ZeroGroup(idxs, mp, plans, padded, segs, shard,
+                          flatten_fn, flatpad_fn, pad_fn, wpad_fn,
+                          update_fn, unflatten_fn, bucket_states,
+                          masters, home)
+
+    def _fresh_zero1_state(self, members, mp, wdtype, plans, padded,
+                           shard):
+        """Shard-sized state allocation from init: structure/dtypes come
+        from an eval_shape probe of create_state on the flat bucket (no
+        full-size buffer is ever materialized); fp32 masters are the
+        flattened weights, laid out P(z1) per bucket."""
+        opt = self.optimizer
+        i0 = members[0][0]
+        sdtype = jnp.float32 if mp else wdtype
+        ws = [p.data()._data for (_, p, _) in members]
+        bucket_states, masters = [], []
+        for plan, tot in zip(plans, padded):
+            probe = jax.eval_shape(
+                lambda tot=tot: opt.create_state(
+                    i0, _FlatWeight(jax.ShapeDtypeStruct((tot,),
+                                                         sdtype))))
+            bucket_states.append(jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype, device=shard),
+                probe))
+            if mp:
+                flat = pad_buckets(
+                    flatten_buckets(ws, [plan], dtype=jnp.float32),
+                    [plan], [tot])[0]
+                masters.append(jax.device_put(flat, shard))
+        return bucket_states, (masters if mp else None)
+
+    def _import_zero1_state(self, member_states, mp, plans, padded,
+                            shard):
+        """Flatten existing per-parameter state trees (e.g. from
+        load_states) into the resident sharded bucket form."""
+        if mp:
+            m_list = [st[0] for st in member_states]
+            inners = [st[1] for st in member_states]
+        else:
+            m_list, inners = None, list(member_states)
+        tdef = jax.tree_util.tree_structure(inners[0])
+        leaves = [jax.tree_util.tree_flatten(t)[0] for t in inners]
+        nleaves = len(leaves[0])
+        bucket_states, masters = [], []
+        for plan, tot in zip(plans, padded):
+            bl = []
+            for j in range(nleaves):
+                flat = pad_buckets(
+                    flatten_buckets([l[j] for l in leaves], [plan]),
+                    [plan], [tot])[0]
+                bl.append(jax.device_put(flat, shard))
+            bucket_states.append(jax.tree_util.tree_unflatten(tdef, bl))
+            if mp:
+                flat = pad_buckets(flatten_buckets(m_list, [plan]),
+                                   [plan], [tot])[0]
+                masters.append(jax.device_put(flat, shard))
+        return bucket_states, (masters if mp else None)
+
+    def _export_group(self, zg, states):
+        """Gather one group's sharded state back to per-parameter trees
+        (host gather + static slices) into `states`, keyed by parameter
+        index — the save-side of replica-count-portable checkpoints."""
+        for bi, plan in enumerate(zg.plans):
+            leaves, tdef = jax.tree_util.tree_flatten(zg.states[bi])
+            leaves_h = [_np.asarray(a) for a in leaves]
+            m_h = _np.asarray(zg.masters[bi]) if zg.mp else None
+            for (k, off, size, shape) in plan:
+                inner = jax.tree_util.tree_unflatten(
+                    tdef, [jnp.asarray(lh[off:off + size].reshape(shape))
+                           for lh in leaves_h])
+                i = zg.idxs[k]
+                if zg.mp:
+                    states[i] = (jnp.asarray(
+                        m_h[off:off + size].reshape(shape)), inner)
+                else:
+                    states[i] = inner
+
+    def zero1_export_states(self, states: Dict):
+        """Materialize every resident group's optimizer state into
+        per-parameter entries of `states` (gather-on-save: checkpoints
+        stay replica-count-portable). Groups keep running sharded."""
+        for zg in self._zgroups.values():
+            self._export_group(zg, states)
+
+    def zero1_reset(self):
+        """Drop resident sharded state; the next step() re-imports from
+        the per-parameter states dict (used by Trainer.load_states)."""
+        self._zgroups.clear()
+
+    def zero1_state_nbytes(self) -> Tuple[int, int]:
+        """(total_bytes, per_replica_bytes) of resident optimizer state
+        (moments + fp32 masters); per-replica is total/N by layout."""
+        total = 0
+        for zg in self._zgroups.values():
+            for st in zg.states:
+                for leaf in jax.tree_util.tree_leaves(st):
+                    total += leaf.nbytes
+            if zg.mp:
+                for m in zg.masters:
+                    total += m.nbytes
+        return total, total // max(1, self.num_shards)

@@ -196,6 +196,39 @@ class Optimizer:
     def _step(self, w, g, state, hyper):
         raise NotImplementedError
 
+    def _bias_correction(self, hyper):
+        """Adam-family bias corrections (1 - beta**t). Rules that carry
+        beta1/beta2 call this so the ZeRO-1 eager path can hand in the
+        values precomputed per tensor (`bc1`/`bc2` in hyper) instead of
+        re-deriving them from a per-element `t` vector — see
+        `_zero1_hyper_extras`."""
+        if "bc1" in hyper:
+            return hyper["bc1"], hyper["bc2"]
+        t = hyper["t"].astype(jnp.float32)
+        return 1.0 - self.beta1 ** t, 1.0 - self.beta2 ** t
+
+    def _zero1_hyper_extras(self, lrs, wds, ts):
+        """Hyper transforms that are NONLINEAR in the per-tensor vectors
+        (e.g. Adam's 1-beta**t), evaluated on the tiny vectors OUTSIDE
+        the sharded executable and passed in as plain inputs. Inside the
+        executable `(1 - beta ** ts)[seg]` is a gather of a computed
+        value, and XLA:CPU fuses the producer into the consumer loop —
+        re-evaluating the pow for every bucket element (~4x step cost
+        for Adam). Keys land in `hyper` gathered per element."""
+        return {}
+
+    def _zero1_step(self, w, g, state, hyper, norm):
+        """One update on a 1/N contiguous shard of a flattened bucket
+        (ZeRO-1 weight-update sharding, multi_tensor.py). `hyper` values
+        may be scalars or per-element vectors; `norm(x)` returns the
+        per-element broadcast of each tensor's GLOBAL L2 norm (segment
+        partial sums + cross-shard psum). Elementwise rules — everything
+        whose `_step` treats elements independently — are sharding-
+        invariant, so the default just runs `_step` on the shard. Rules
+        that reduce over whole tensors (LAMB/LARS norms) MUST override
+        and route every tensor-wide reduction through `norm`."""
+        return self._step(w, g, state, hyper)
+
     def _sparse_step(self, w, grad, state, hyper):
         """Lazy row-sparse path: run the dense rule on touched rows only
         (reference: lazy_update kernels)."""
@@ -274,9 +307,10 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return _state_zeros(weight, 2)
 
-    def _bias_correction(self, hyper):
-        t = hyper["t"].astype(jnp.float32)
-        return 1.0 - self.beta1 ** t, 1.0 - self.beta2 ** t
+    def _zero1_hyper_extras(self, lrs, wds, ts):
+        t = ts.astype(jnp.float32)
+        return {"bc1": 1.0 - self.beta1 ** t,
+                "bc2": 1.0 - self.beta2 ** t}
 
     def _step(self, w, g, state, hyper):
         m, v = state
@@ -322,6 +356,13 @@ class LAMB(Optimizer):
     def create_state(self, index, weight):
         return _state_zeros(weight, 2)
 
+    def _zero1_hyper_extras(self, lrs, wds, ts):
+        if not self.bias_correction:
+            return {}
+        t = ts.astype(jnp.float32)
+        return {"bc1": 1.0 - self.beta1 ** t,
+                "bc2": 1.0 - self.beta2 ** t}
+
     def _step(self, w, g, state, hyper):
         m, v = state
         lr, wd = hyper["lr"], hyper["wd"]
@@ -330,12 +371,36 @@ class LAMB(Optimizer):
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         mh, vh = m, v
         if self.bias_correction:
-            t = hyper["t"].astype(jnp.float32)
-            mh = m / (1 - self.beta1 ** t)
-            vh = v / (1 - self.beta2 ** t)
+            c1, c2 = self._bias_correction(hyper)
+            mh = m / c1
+            vh = v / c2
         r = mh / (jnp.sqrt(vh) + self.epsilon) + wd * w.astype(jnp.float32)
         wnorm = jnp.linalg.norm(w.astype(jnp.float32))
         rnorm = jnp.linalg.norm(r)
+        ratio = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return (w - (lr * ratio * r).astype(w.dtype)), (m, v)
+
+    def _zero1_step(self, w, g, state, hyper, norm):
+        # same math as _step with the tensor-wide L2 norms routed
+        # through the cross-shard `norm` (per-element broadcast, so the
+        # ratio/where algebra stays elementwise)
+        m, v = state
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        mh, vh = m, v
+        if self.bias_correction:
+            c1, c2 = self._bias_correction(hyper)
+            mh = m / c1
+            vh = v / c2
+        r = mh / (jnp.sqrt(vh) + self.epsilon) + wd * w.astype(jnp.float32)
+        wnorm = norm(w.astype(jnp.float32))
+        rnorm = norm(r)
         ratio = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
         if self.lower_bound is not None:
             ratio = jnp.maximum(ratio, self.lower_bound)
@@ -362,6 +427,19 @@ class LARS(Optimizer):
         wf = w.astype(jnp.float32)
         wnorm = jnp.linalg.norm(wf)
         gnorm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        g = g + wd * wf
+        mom = self.momentum * state + lr * trust * g
+        return (w - mom.astype(w.dtype)), mom
+
+    def _zero1_step(self, w, g, state, hyper, norm):
+        lr, wd = hyper["lr"], hyper["wd"]
+        g = self._preprocess(g.astype(jnp.float32), hyper)
+        wf = w.astype(jnp.float32)
+        wnorm = norm(wf)
+        gnorm = norm(g)
         trust = jnp.where(
             (wnorm > 0) & (gnorm > 0),
             self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["compressed_psum", "compressed_psum_tree", "quantize_2bit",
+__all__ = ["compressed_psum", "compressed_psum_scatter",
+           "compressed_psum_tree", "quantize_2bit",
            "dequantize_2bit", "quantize_int8"]
 
 
@@ -81,6 +82,41 @@ def compressed_psum(grad, residual, axis_name, scheme="2bit",
         raise ValueError(f"unknown compression scheme {scheme!r}")
     new_residual = g - sent
     return reduced, new_residual
+
+
+def compressed_psum_scatter(bucket, residual, axis_name, scheme="2bit",
+                            threshold=0.5):
+    """ZeRO-1 companion of compressed_psum: quantize the local flat
+    bucket, reduce-SCATTER the int codes (each replica receives only its
+    1/N contiguous shard of the sum), dequantize the shard.
+
+    bucket: this device's local flat gradient bucket, length divisible
+        by the axis size (ZeRO-1 buckets are padded to N*lane).
+    residual: carried error, full bucket length — error feedback must
+        cover every element this device *sent*, not just the shard it
+        receives, so the residual stays bucket-sized and bit-identical
+        to what compressed_psum would have kept.
+    Returns (mean-reduced shard, new full residual).
+    """
+    g = bucket.astype(jnp.float32) + residual
+    n = lax.psum(1, axis_name)
+    if scheme == "2bit":
+        codes = quantize_2bit(g, threshold)
+        sent = dequantize_2bit(codes, threshold)
+        total = lax.psum_scatter(codes.astype(jnp.int32), axis_name,
+                                 scatter_dimension=0, tiled=True)
+        reduced = total.astype(jnp.float32) * threshold / n
+    elif scheme == "int8":
+        amax = lax.pmax(jnp.max(jnp.abs(g)), axis_name)
+        scale = jnp.maximum(amax / 127.0, 1e-30)
+        codes = quantize_int8(g, scale)
+        sent = codes.astype(jnp.float32) * scale
+        total = lax.psum_scatter(codes.astype(jnp.int32), axis_name,
+                                 scatter_dimension=0, tiled=True)
+        reduced = total.astype(jnp.float32) * scale / n
+    else:
+        raise ValueError(f"unknown compression scheme {scheme!r}")
+    return reduced, g - sent
 
 
 def compressed_psum_tree(grads, residuals, axis_name, scheme="2bit",

@@ -25,3 +25,28 @@ def pytest_configure(config):
         "slow: multi-process spawns, example smoke runs, heavy model "
         "tests — the fast tier is `pytest -m 'not slow'` (<8 min); "
         "the FULL suite remains the snapshot gate")
+
+
+# tier-1 regression floor: a FULL-suite run (anything that collected at
+# least the floor) must pass at least this many tests. Single-file and
+# -k subset runs collect fewer and are exempt. Raise this when the
+# suite grows — never lower it.
+TIER1_PASSED_FLOOR = 539
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if session.config.option.collectonly:
+        return
+    if getattr(session, "testscollected", 0) < TIER1_PASSED_FLOOR:
+        return  # subset run, floor does not apply
+    passed = getattr(session, "testscollected", 0) - \
+        getattr(session, "testsfailed", 0)
+    # deselected/skipped tests never ran; only count hard failures
+    # against the floor
+    if passed < TIER1_PASSED_FLOOR:
+        session.exitstatus = 1
+        rep = session.config.pluginmanager.get_plugin("terminalreporter")
+        if rep is not None:
+            rep.write_line(
+                f"tier-1 floor violated: {passed} < "
+                f"{TIER1_PASSED_FLOOR} passing tests", red=True)

@@ -239,6 +239,50 @@ def test_bucket_flatten_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_zero1_padded_sizes_lane_aligned_multiples():
+    from mxnet_tpu.multi_tensor import zero1_padded_sizes
+    # uneven buckets pad UP to the next multiple of num_shards*lane;
+    # tiny buckets still get one full quantum
+    plans = plan_buckets([(1,), (1000,), (8 * 128,)], [jnp.float32] * 3,
+                         bucket_bytes=4096)
+    padded = zero1_padded_sizes(plans, 8, lane=128)
+    for plan, tot in zip(plans, padded):
+        used = plan[-1][1] + plan[-1][2]
+        assert tot % (8 * 128) == 0
+        assert tot >= used
+        assert tot - used < 8 * 128  # minimal cover
+    # exact-fit bucket pads zero extra
+    plans2 = plan_buckets([(8 * 128,)], [jnp.float32],
+                          bucket_bytes=8 * 128 * 4)
+    assert zero1_padded_sizes(plans2, 8, lane=128) == [8 * 128]
+
+
+def test_zero1_pad_buckets_and_segments():
+    from mxnet_tpu.multi_tensor import (bucket_segments, pad_buckets,
+                                        zero1_padded_sizes)
+    rs = np.random.RandomState(0)
+    leaves = [jnp.asarray(rs.randn(*s).astype(np.float32))
+              for s in SHAPES]
+    plans = plan_buckets([l.shape for l in leaves],
+                         [l.dtype for l in leaves], bucket_bytes=64)
+    padded = zero1_padded_sizes(plans, 4, lane=8)
+    buckets = pad_buckets(flatten_buckets(leaves, plans), plans, padded)
+    segs = bucket_segments(plans, padded, len(leaves))
+    for b, s, plan, tot in zip(buckets, segs, plans, padded):
+        assert b.shape == (tot,) and s.shape == (tot,)
+        used = plan[-1][1] + plan[-1][2]
+        # padding is zeros and carries the out-of-range segment id
+        np.testing.assert_array_equal(np.asarray(b[used:]), 0.0)
+        assert (s[used:] == len(leaves)).all()
+        # real elements map to their tensor's group-local index
+        for (k, off, size, _) in plan:
+            assert (s[off:off + size] == k).all()
+    # padded buckets unflatten with the ORIGINAL plan (static offsets)
+    back = unflatten_buckets(buckets, plans, len(leaves))
+    for a, b in zip(leaves, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_compressed_psum_tree_bucketed_matches_leafwise_2bit():
     from mxnet_tpu.parallel.compression import compressed_psum_tree
     from jax.sharding import Mesh

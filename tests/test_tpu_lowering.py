@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.export  # noqa: F401  (jax.export is not an auto-imported attr)
 import jax.numpy as jnp
 
 

@@ -1,0 +1,409 @@
+"""ZeRO-1 weight-update sharding (arXiv:2004.13336): reduce-scatter
+grads, fused optimizer step on each replica's 1/N bucket shard with
+shard-sized state, all-gather updated weights. Parity contract: zero1
+matches the unsharded fused path bit-exactly for elementwise rules
+(SGD/Adam — identical per-element math, sharding only changes layout)
+and to <=1e-6 for norm-based rules (LAMB/LARS — psum-of-partials
+reduction order). Runs on the 8-virtual-device CPU mesh (conftest)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.parameter import Parameter
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+SHAPES = [(4,), (3, 5), (2, 2, 2), (7,), (1, 9)]
+
+
+def make_trainer(zero1, optimizer="sgd", opt_kwargs=None, kvstore="device",
+                 compression=None, dtype="float32", shapes=SHAPES,
+                 zero1_shards=None, seed=0, **tr_kwargs):
+    rs = np.random.RandomState(seed)
+    params = {}
+    for i, s in enumerate(shapes):
+        p = Parameter(f"p{i}", shape=s, dtype=dtype)
+        p.initialize()
+        p.set_data(rs.randn(*s).astype(np.float32))
+        params[f"p{i}"] = p
+    tr = mx.gluon.Trainer(
+        params, optimizer,
+        opt_kwargs or {"learning_rate": 0.1, "momentum": 0.9},
+        kvstore=kvstore, compression_params=compression,
+        zero1=zero1, zero1_shards=zero1_shards, **tr_kwargs)
+    return params, tr
+
+
+def set_grads(params, seed):
+    rs = np.random.RandomState(seed)
+    for p in params.values():
+        if p.grad_req == "null":
+            continue
+        p.data()._grad._data = jnp.asarray(
+            rs.randn(*p.shape)).astype(p.data()._data.dtype)
+
+
+def run_parity(optimizer, opt_kwargs, steps=4, atol=0.0, dtype="float32",
+               kvstore="device", compression=None, shapes=SHAPES):
+    outs = []
+    for zero1 in (True, False):
+        params, tr = make_trainer(shapes=shapes, zero1=zero1,
+                                  optimizer=optimizer,
+                                  opt_kwargs=opt_kwargs, kvstore=kvstore,
+                                  compression=compression, dtype=dtype)
+        for step in range(steps):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        outs.append({k: p.data().asnumpy().astype(np.float32)
+                     for k, p in params.items()})
+        if zero1:
+            assert tr._zero1_active, "zero1 did not engage"
+            assert tr._mt_updater is not None and tr._mt_updater.zero1
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0,
+                                   atol=atol, err_msg=k)
+    return outs
+
+
+# -- eager parity matrix -----------------------------------------------------
+
+def test_zero1_parity_sgd_momentum_exact():
+    run_parity("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01},
+               atol=0.0)
+
+
+def test_zero1_parity_sgd_no_momentum_exact():
+    # SGD without momentum has a None state tree — the sharded step must
+    # carry it through, not fabricate buffers
+    run_parity("sgd", {"learning_rate": 0.1}, atol=0.0)
+
+
+def test_zero1_parity_adam():
+    run_parity("adam", {"learning_rate": 0.01, "wd": 0.001}, atol=1e-6)
+
+
+def test_zero1_parity_lamb_global_norms():
+    # LAMB needs each tensor's GLOBAL norm; a shard only holds part of
+    # each tensor, so the segment-sum + psum path is what's under test
+    run_parity("lamb", {"learning_rate": 0.01, "wd": 0.01}, atol=1e-6)
+
+
+def test_zero1_parity_lars_global_norms():
+    run_parity("lars", {"learning_rate": 0.01, "wd": 0.01}, atol=1e-6)
+
+
+def test_zero1_parity_multi_precision_bf16():
+    # fp32 master weights live SHARDED inside the resident groups
+    run_parity("adam", {"learning_rate": 0.01, "multi_precision": True},
+               atol=1e-6, dtype="bfloat16")
+
+
+def test_zero1_parity_compressed_tpu_sync_exact():
+    # grads flatten UNPADDED through the kvstore reduce, so the 2-bit
+    # error-feedback residuals are keyed and valued identically to the
+    # allreduce path — parity is bit-exact, not approximate
+    run_parity("adam", {"learning_rate": 0.01}, atol=0.0,
+               kvstore="tpu_sync",
+               compression={"type": "2bit", "threshold": 0.5})
+
+
+def test_zero1_parity_tpu_sync_uncompressed_exact():
+    run_parity("sgd", {"learning_rate": 0.1, "momentum": 0.9}, atol=0.0,
+               kvstore="tpu_sync")
+
+
+def test_zero1_stale_grad_group_recomposition():
+    # freezing params mid-run changes the fused group's composition; the
+    # resident sharded state must be exported and re-imported into the
+    # new groups, not dropped
+    outs = []
+    for zero1 in (True, False):
+        params, tr = make_trainer(zero1, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9})
+        for step in range(2):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        params["p1"].grad_req = "null"
+        params["p3"].grad_req = "null"
+        frozen = {k: params[k].data().asnumpy() for k in ("p1", "p3")}
+        for step in range(2, 4):
+            set_grads(params, step)
+            tr.step(batch_size=2)
+        for k, v in frozen.items():
+            np.testing.assert_array_equal(params[k].data().asnumpy(), v)
+        outs.append({k: p.data().asnumpy() for k, p in params.items()})
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=0, atol=0,
+                                   err_msg=k)
+
+
+def test_zero1_explicit_shard_count():
+    # zero1_shards=4 on an 8-device host: shards over the first 4
+    params, tr = make_trainer(True, "adam", {"learning_rate": 0.01},
+                              zero1_shards=4)
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    assert tr._mt_updater.num_shards == 4
+    tot, per = tr._mt_updater.zero1_state_nbytes()
+    assert tot == 4 * per
+
+
+# -- the memory claim --------------------------------------------------------
+
+def test_zero1_state_bytes_shrink_n_fold():
+    params, tr = make_trainer(True, "adam", {"learning_rate": 0.01})
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    tot, per = tr._mt_updater.zero1_state_nbytes()
+    n = tr._mt_updater.num_shards
+    assert n == 8
+    assert per == tot // n
+    # every resident state leaf is genuinely sharded over the mesh, and
+    # each replica's addressable slice is 1/N of the leaf
+    zg = next(iter(tr._mt_updater._zgroups.values()))
+    for bk in zg.states:
+        for leaf in jax.tree_util.tree_leaves(bk):
+            assert len(leaf.sharding.device_set) == n
+            shard0 = leaf.addressable_shards[0].data
+            assert shard0.size == leaf.size // n
+    # full-size per-param states were never materialized on the trainer
+    assert not tr._states
+
+
+# -- checkpoint portability --------------------------------------------------
+
+def _clone_weights(src_params, dst_params):
+    for k, p in src_params.items():
+        dst_params[k].set_data(p.data().asnumpy())
+
+
+def test_zero1_checkpoint_roundtrip_changes_shard_count(tmp_path):
+    # save under N=8, resume under N=4 and with zero1 off: gather-on-save
+    # makes the file replica-count-agnostic
+    params, tr = make_trainer(True, "adam", {"learning_rate": 0.01},
+                              zero1_shards=8)
+    for step in range(3):
+        set_grads(params, step)
+        tr.step(batch_size=2)
+    fname = str(tmp_path / "zero1.states")
+    tr.save_states(fname)
+
+    # reference: keep training the saver
+    for step in range(3, 5):
+        set_grads(params, step)
+        tr.step(batch_size=2)
+    ref = {k: p.data().asnumpy() for k, p in params.items()}
+
+    for zero1, shards in ((True, 4), (False, None)):
+        params2, tr2 = make_trainer(zero1, "adam", {"learning_rate": 0.01},
+                                    zero1_shards=shards, seed=0)
+        tr2.load_states(fname)
+        # load_states restores optimizer state; weights come from the
+        # model checkpoint in real flows — clone the step-3 values
+        params3, tr3 = make_trainer(True, "adam", {"learning_rate": 0.01},
+                                    zero1_shards=8, seed=0)
+        for step in range(3):
+            set_grads(params3, step)
+            tr3.step(batch_size=2)
+        _clone_weights(params3, params2)
+        for step in range(3, 5):
+            set_grads(params2, step)
+            tr2.step(batch_size=2)
+        for k in ref:
+            np.testing.assert_allclose(
+                params2[k].data().asnumpy(), ref[k], rtol=0, atol=1e-6,
+                err_msg=f"{k} zero1={zero1} shards={shards}")
+
+
+def test_unsharded_checkpoint_loads_into_zero1(tmp_path):
+    # the reverse direction: a plain fused checkpoint resumes sharded
+    params, tr = make_trainer(False, "adam", {"learning_rate": 0.01})
+    for step in range(3):
+        set_grads(params, step)
+        tr.step(batch_size=2)
+    fname = str(tmp_path / "plain.states")
+    tr.save_states(fname)
+    for step in range(3, 5):
+        set_grads(params, step)
+        tr.step(batch_size=2)
+    ref = {k: p.data().asnumpy() for k, p in params.items()}
+
+    params2, tr2 = make_trainer(True, "adam", {"learning_rate": 0.01},
+                                seed=0)
+    tr2.load_states(fname)
+    params3, tr3 = make_trainer(False, "adam", {"learning_rate": 0.01},
+                                seed=0)
+    for step in range(3):
+        set_grads(params3, step)
+        tr3.step(batch_size=2)
+    _clone_weights(params3, params2)
+    for step in range(3, 5):
+        set_grads(params2, step)
+        tr2.step(batch_size=2)
+    for k in ref:
+        np.testing.assert_allclose(params2[k].data().asnumpy(), ref[k],
+                                   rtol=0, atol=1e-6, err_msg=k)
+
+
+# -- graceful degradation ----------------------------------------------------
+
+def test_kvstore_reduce_scatter_probe():
+    from mxnet_tpu.kvstore import DistPSKVStore
+    assert mx.kv.create("device").supports_reduce_scatter()
+    assert mx.kv.create("tpu_sync").supports_reduce_scatter()
+    # addr-less dist_sync falls back to in-process sync collectives,
+    # which CAN reduce-scatter
+    assert mx.kv.create("dist_sync").supports_reduce_scatter()
+    # async updates are stale per-replica; sharded state would diverge
+    assert not mx.kv.create("dist_async").supports_reduce_scatter()
+    # the true PS store refuses (no anonymous shard keys on the server);
+    # probe the class directly — constructing one dials a live server
+    ps = object.__new__(DistPSKVStore)
+    assert not ps.supports_reduce_scatter()
+    with pytest.raises(RuntimeError, match="reduce-scatter"):
+        ps.reduce_scatter_buckets("tag", [])
+
+
+def test_zero1_degrades_on_ps_store_with_one_warning(recwarn):
+    # stores that cannot reduce-scatter buckets (PS, dist_async) force
+    # zero1 back to the unsharded path with exactly one warning, and
+    # training must still run
+    params, tr = make_trainer(True, "sgd", {"learning_rate": 0.1},
+                              kvstore="dist_async",
+                              update_on_kvstore=False)
+    set_grads(params, 0)
+    tr.step(batch_size=2)
+    assert not tr._zero1_active
+    msgs = [w for w in recwarn.list
+            if "zero1" in str(w.message) or "reduce-scatter"
+            in str(w.message)]
+    assert len(msgs) == 1, [str(w.message) for w in recwarn.list]
+    set_grads(params, 1)
+    tr.step(batch_size=2)  # keeps training unsharded
+
+
+def test_zero1_degrades_on_update_on_kvstore():
+    params, tr = make_trainer(True, "sgd", {"learning_rate": 0.1},
+                              kvstore="dist_sync")
+    with pytest.warns(UserWarning, match="update_on_kvstore"):
+        set_grads(params, 0)
+        tr.step(batch_size=2)
+    assert not tr._zero1_active
+
+
+def test_zero1_degrades_on_unfusable_rule():
+    params, tr = make_trainer(True, "sgld", {"learning_rate": 0.01},
+                              shapes=SHAPES[:2])
+    with pytest.warns(UserWarning, match="multi-tensor"):
+        set_grads(params, 0)
+        tr.step(batch_size=2)
+    assert not tr._zero1_active
+
+
+# -- FusedTrainStep lowering -------------------------------------------------
+
+def _toy_problem():
+    rs = np.random.RandomState(2)
+    X = rs.rand(64, 10).astype(np.float32)
+    W = rs.randn(10, 3).astype(np.float32)
+    y = np.argmax(X @ W + 0.05 * rs.randn(64, 3), axis=1)
+    return X, y
+
+
+def _toy_net():
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(3))
+    net.initialize()
+    return net
+
+
+def _run_fused(opt_fn, zero1, comp=None, nsteps=12):
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    mesh = make_mesh([8], ["dp"])
+    X, y = _toy_problem()
+    net = _toy_net()
+    step = FusedTrainStep(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          opt_fn(), mesh=mesh, compression=comp,
+                          zero1=zero1)
+    xs, ys = mx.nd.array(X), mx.nd.array(y)
+    losses = [float(step(xs, ys).asscalar()) for _ in range(nsteps)]
+    step.sync_to_params()
+    ws = {n: np.asarray(p.data()._data, np.float32)
+          for n, p in net.collect_params().items()}
+    return losses, ws, step
+
+
+@pytest.mark.parametrize("name,opt_fn,atol", [
+    ("sgd", lambda: mx.optimizer.SGD(learning_rate=0.2, momentum=0.9),
+     0.0),
+    ("adam", lambda: mx.optimizer.Adam(learning_rate=0.02), 1e-6),
+    ("lamb", lambda: mx.optimizer.LAMB(learning_rate=0.02), 1e-6),
+])
+def test_fused_zero1_matches_gspmd(name, opt_fn, atol):
+    l0, w0, _ = _run_fused(opt_fn, False)
+    l1, w1, _ = _run_fused(opt_fn, True)
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=max(atol, 1e-6))
+    for n in w0:
+        np.testing.assert_allclose(w0[n], w1[n], rtol=0, atol=atol,
+                                   err_msg=f"{name}:{n}")
+
+
+def test_fused_zero1_composes_with_compression():
+    # codes ride the reduce-scatter; int codes sum exactly, so zero1
+    # matches the BUCKETED compressed-allreduce path bit for bit
+    comp = {"type": "2bit", "threshold": 0.02, "bucket_bytes": 4 << 20}
+    opt_fn = lambda: mx.optimizer.SGD(learning_rate=0.2)  # noqa: E731
+    l0, w0, _ = _run_fused(opt_fn, False, comp)
+    l1, w1, stp = _run_fused(opt_fn, True, comp)
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
+    for n in w0:
+        np.testing.assert_array_equal(w0[n], w1[n], err_msg=n)
+    assert stp._resid is not None  # error feedback is live
+
+
+def test_fused_zero1_state_bytes_and_shardings():
+    _, _, step = _run_fused(
+        lambda: mx.optimizer.Adam(learning_rate=0.02), True, nsteps=2)
+    tot, per = step.zero1_state_nbytes()
+    assert tot == 8 * per
+    # Checkpointer contract: bucket-sharded state keys + shardings exist
+    assert all(k.startswith("__zero1__") for k in step._states)
+    assert set(step._st_sh) == set(step._states)
+    for k, st in step._states.items():
+        for leaf in jax.tree_util.tree_leaves(st):
+            assert len(leaf.sharding.device_set) == 8
+
+
+def test_fused_zero1_warns_when_meshless():
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    mx.random.seed(3)
+    net = mx.gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    step = FusedTrainStep(net, mx.gluon.loss.L2Loss(),
+                          mx.optimizer.SGD(learning_rate=0.1),
+                          mesh=None, zero1=True)
+    with pytest.warns(RuntimeWarning, match="zero1"):
+        step(mx.nd.ones((2, 4)), mx.nd.ones((2, 2)))
+
+
+def test_fused_zero1_rejects_tp_sharding():
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    mesh = make_mesh([8], ["dp"])
+    mx.random.seed(3)
+    net = mx.gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    from jax.sharding import PartitionSpec as P
+    next(iter(net.collect_params().values())).sharding = P(None, "dp")
+    step = FusedTrainStep(net, mx.gluon.loss.L2Loss(),
+                          mx.optimizer.SGD(learning_rate=0.1),
+                          mesh=mesh, zero1=True)
+    with pytest.raises(ValueError, match="TP sharding"):
+        step(mx.nd.ones((8, 4)), mx.nd.ones((8, 2)))
