@@ -184,6 +184,11 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
     def _write_grad(arr, g):
         if arr._grad is None or arr._grad_req == "null":
             return
+        hook = getattr(arr, "_grad_hook", None)
+        if hook is not None and hook(arr, g):
+            # consumed (e.g. the ZeRO-2 bucket collector): the full-size
+            # grad buffer is never touched
+            return
         if arr._grad_req == "add":
             arr._grad._data = arr._grad._data + g
         else:
@@ -196,6 +201,18 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
         _add_cot(h, g)
 
     order = _global_order(heads)
+
+    # ZeRO-2 overlap: count the pending consumer nodes of every HOOKED
+    # leaf so its cotangent can be finalized (and the hook fired — which
+    # launches the bucket reduce-scatter) the moment its last consumer
+    # runs, while the rest of the backward walk is still executing.
+    # Unhooked leaves keep the cheap end-of-walk write below.
+    pending: dict = {}
+    for node in order:
+        for p in node.parents:
+            if p._node is None and getattr(p, "_grad_hook", None) is not None \
+                    and p._grad is not None and p._grad_req != "null":
+                pending[id(p)] = pending.get(id(p), 0) + 1
 
     for node in order:
         cots = []
@@ -211,14 +228,29 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
                 # write it if this intermediate has a grad buffer
                 _write_grad(arr, c)
             cots.append(c)
-        if not any_nonzero:
-            continue
-        cot_in = tuple(cots) if node.n_out > 1 else cots[0]
-        grads = node.vjp_fn(cot_in)
-        for parent, g in zip(node.parents, grads):
-            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
-                continue
-            _add_cot(parent, g)
+        if any_nonzero:
+            cot_in = tuple(cots) if node.n_out > 1 else cots[0]
+            grads = node.vjp_fn(cot_in)
+            for parent, g in zip(node.parents, grads):
+                if g is None or (hasattr(g, "dtype")
+                                 and g.dtype == jax.dtypes.float0):
+                    continue
+                _add_cot(parent, g)
+        # a processed node never contributes again — even when it was
+        # skipped as all-zero — so hooked leaves it consumed may be final
+        if pending:
+            for parent in node.parents:
+                k = id(parent)
+                n_left = pending.get(k)
+                if n_left is None:
+                    continue
+                if n_left <= 1:
+                    del pending[k]
+                    c = cotangents.pop(k, None)
+                    if c is not None:
+                        _write_grad(parent, c)
+                else:
+                    pending[k] = n_left - 1
 
     # Arrays whose cotangents were never popped have no producing node
     # on the walked tape (true leaves, incl. a head that is itself a

@@ -165,7 +165,10 @@ class Checkpointer:
             fused._pending_restore = (arrays.get("opt"), step_count)
             return
         params = fused.net.collect_params()
-        fused._tr = {n: params[n].data()._data for n in fused._tr_names}
+        # refresh_weights re-imports from the Parameters with the
+        # compiled shardings — under ZeRO-3 that means flattening the
+        # restored full-size weights back into sharded flat buckets
+        fused.refresh_weights()
         fused._aux = {n: params[n].data()._data for n in fused._aux_names}
         if "opt" in arrays:
             fused._states = jax.tree_util.tree_map(
@@ -173,11 +176,14 @@ class Checkpointer:
         if step_count is not None:
             fused._step_count = step_count
         if fused.mesh is not None and fused._compiled is not None:
-            # re-place on the mesh with the compiled shardings
-            fused._tr = {n: jax.device_put(v, fused._tr_sh[n])
-                         for n, v in fused._tr.items()}
+            # re-place on the mesh with the compiled shardings. Orbax
+            # restores tuples as lists, so rebuild the compiled step's
+            # exact state tree structure before the spec'd device_put.
             fused._aux = {n: jax.device_put(v, fused._aux_sh[n])
                           for n, v in fused._aux.items()}
+            fused._states = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(fused._st_sh),
+                jax.tree_util.tree_leaves(fused._states))
             fused._states = jax.device_put(fused._states, fused._st_sh)
 
     def wait(self):

@@ -59,6 +59,10 @@ class Parameter:
         self.sharding = sharding  # PartitionSpec for parallel/ (TPU-first)
         self._data: Optional[NDArray] = None
         self._deferred = None  # (init, ctx) when shape was unknown
+        # ZeRO-3: set by the updater when this parameter's full-size
+        # array was released (only the 1/N bucket shard stays resident);
+        # data() invokes it to gather the bucket back just in time
+        self._lazy_fetch = None
 
     # -- shape -------------------------------------------------------------
     @property
@@ -131,6 +135,9 @@ class Parameter:
 
     def data(self, ctx=None) -> NDArray:
         self._check_init()
+        if self._lazy_fetch is not None:
+            fetch, self._lazy_fetch = self._lazy_fetch, None
+            fetch(self)
         return self._data
 
     def list_data(self):
@@ -157,6 +164,9 @@ class Parameter:
             if self._data is None:
                 raise RuntimeError(f"{self.name}: set_data before init")
         req = self._grad_req
+        # explicit data wins over any released ZeRO-3 shard (the updater
+        # notices the foreign array via its identity check and re-imports)
+        self._lazy_fetch = None
         if isinstance(data, NDArray):
             # copy: fused train steps donate their input buffers, so
             # aliasing another parameter's storage here would leave this
@@ -298,7 +308,9 @@ class ParameterDict:
                 continue
             key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
                 else name
-            data[key] = _np.asarray(jax.device_get(p._data._data))
+            # p.data() (not p._data._data): a ZeRO-3-released parameter
+            # must gather its bucket before it can be serialized
+            data[key] = _np.asarray(jax.device_get(p.data()._data))
         with open(filename, "wb") as f:  # exact filename (no .npz suffix)
             _np.savez(f, **data)
 

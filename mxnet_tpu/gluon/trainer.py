@@ -27,7 +27,7 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, multi_tensor=True,
-                 zero1=False, zero1_shards=None):
+                 zero1=False, zero1_shards=None, zero=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -51,12 +51,26 @@ class Trainer:
         # one dispatch per parameter; opt out with multi_tensor=False
         self._multi_tensor = multi_tensor
         self._mt_updater = None
-        # ZeRO-1 weight-update sharding (arXiv:2004.13336): grads
-        # reduce-scatter per bucket, each replica updates its 1/N shard
-        # with shard-sized optimizer state, weights all-gather back
-        self._zero1 = bool(zero1)
+        # ZeRO weight-update sharding (arXiv:2004.13336). zero=1 shards
+        # optimizer state (grads reduce-scatter per bucket, each replica
+        # updates its 1/N shard, weights all-gather back); zero=2 also
+        # frees the full grad buffers (autograd hooks reduce-scatter
+        # each bucket as backward produces it — comm overlaps compute —
+        # and only the 1/N grad shard stays resident, including across
+        # grad_accum microbatches); zero=3 also shards the weights, with
+        # just-in-time per-bucket gathers on access. zero1=True is the
+        # back-compat alias for zero=1.
+        stage = 0 if zero in (None, False) else int(zero)
+        if stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero must be one of False/0/1/2/3; "
+                             f"got {zero!r}")
+        if zero1 and stage == 0:
+            stage = 1
+        self._zero_req = stage
+        self._zero1 = stage >= 1
         self._zero1_shards = zero1_shards
         self._zero1_active = False
+        self._zero_stage = 0
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):
@@ -78,7 +92,8 @@ class Trainer:
                 self._kvstore.init(i, p.data())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
-        self._zero1_active = self._resolve_zero1()
+        self._zero_stage = self._resolve_zero()
+        self._zero1_active = self._zero_stage >= 1
         if not (self._kvstore is not None and self._update_on_kvstore):
             skip = set()
             if self._zero1_active:
@@ -95,34 +110,63 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(
                         i, p.data())
+        if self._zero_stage >= 2:
+            # stages 2/3 need the updater alive BEFORE the first
+            # backward: its autograd hooks reduce-scatter each grad
+            # bucket as backward produces it (that is the overlap)
+            self._make_updater()
+            fused = self._fused_indices()
+            if fused:
+                self._mt_updater.register_grad_hooks(
+                    fused, self._states, kvstore=self._kvstore)
         self._init_done = True
 
-    def _resolve_zero1(self) -> bool:
-        """Whether the ZeRO-1 sharded update can actually run; degrades
-        to the unsharded fused path with ONE warning otherwise."""
-        if not self._zero1:
-            return False
+    def _make_updater(self):
+        if self._mt_updater is None:
+            self._mt_updater = _mt.MultiTensorUpdater(
+                self._optimizer, zero1=self._zero1_active,
+                num_shards=self._zero1_shards, stage=self._zero_stage)
+        return self._mt_updater
+
+    def _resolve_zero(self) -> int:
+        """The ZeRO stage that can actually run. Degrade matrix (each
+        downgrade warns ONCE):
+          update_on_kvstore or an unfusable rule  -> 0 (unsharded)
+          store cannot reduce-scatter, zero=1     -> 0 (unsharded)
+          store cannot reduce-scatter, zero=2/3   -> 1 (allreduce +
+            local shard still give a correct, if unoverlapped, sharded
+            update) when the store can at least sync flat buckets,
+            else 0."""
+        req = self._zero_req
+        if not req:
+            return 0
         import warnings
         if self._kvstore is not None and self._update_on_kvstore:
             warnings.warn(
-                "zero1=True is incompatible with update_on_kvstore "
+                f"zero={req} is incompatible with update_on_kvstore "
                 "(the store owns the optimizer); running unsharded")
-            return False
+            return 0
         if not self._multi_tensor or \
                 not _mt.MultiTensorUpdater.supports(self._optimizer):
             warnings.warn(
-                "zero1=True requires the multi-tensor fused path "
+                f"zero={req} requires the multi-tensor fused path "
                 f"(multi_tensor=True and a fusable rule; got "
                 f"{type(self._optimizer).__name__}); running unsharded")
-            return False
+            return 0
         if self._kvstore is not None and \
                 not self._kvstore.supports_reduce_scatter():
+            if req >= 2 and self._kvstore.supports_flat_pushpull():
+                warnings.warn(
+                    f"kvstore '{self._kvstore.type}' cannot "
+                    f"reduce-scatter grad buckets; zero={req} degrades "
+                    "to ZeRO-1 (bucket allreduce + local shard)")
+                return 1
             warnings.warn(
                 f"kvstore '{self._kvstore.type}' cannot reduce-scatter "
-                "grad buckets; zero1 degrades to the unsharded fused "
-                "path")
-            return False
-        return True
+                f"grad buckets; zero={req} degrades to the unsharded "
+                "fused path")
+            return 0
+        return req
 
     @property
     def learning_rate(self):
@@ -181,12 +225,8 @@ class Trainer:
         on_kv = self._kvstore is not None and self._update_on_kvstore
         fused = self._fused_indices()
         if fused:
-            if self._mt_updater is None:
-                self._mt_updater = _mt.MultiTensorUpdater(
-                    self._optimizer, zero1=self._zero1_active,
-                    num_shards=self._zero1_shards)
-            self._mt_updater.step(fused, self._states,
-                                  kvstore=self._kvstore)
+            self._make_updater().step(fused, self._states,
+                                      kvstore=self._kvstore)
         done = {i for i, _ in fused}
         for i, p in enumerate(self._params):
             if i in done or p.grad_req == "null":

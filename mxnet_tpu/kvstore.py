@@ -30,6 +30,16 @@ class KVStore:
         self._optimizer = None
         self._opt_states: Dict = {}
         self._compression = None
+        # degrade-path warnings fire once per STORE, not once per bucket
+        # (a 100-bucket model must not emit 100 identical warnings)
+        self._warned_once: set = set()
+
+    def _warn_once(self, key: str, msg: str):
+        if key in self._warned_once:
+            return
+        self._warned_once.add(key)
+        import warnings
+        warnings.warn(msg, stacklevel=3)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -183,7 +193,7 @@ class KVStore:
                           priority=priority)
         return buckets
 
-    # -- ZeRO-1 bucket collectives (multi_tensor.py zero1 path) ------------
+    # -- ZeRO bucket collectives (multi_tensor.py zero path) ---------------
     def supports_reduce_scatter(self) -> bool:
         """Whether grad buckets may be reduce-scattered so each replica
         sees only its 1/N shard after the sync. Requires the same
@@ -202,7 +212,33 @@ class KVStore:
         allreduce path ONLY by tag reuse rules — the same `__flat__`
         keys are used so a zero1 toggle mid-run inherits feedback state
         and stays bit-identical to pushpull_buckets' compression."""
+        if not self.supports_reduce_scatter():
+            # a store that advertised no reduce-scatter support must not
+            # silently run the sync reduction (AsyncKVStore used to
+            # inherit this path): fall back loudly, once per store
+            self._warn_once(
+                "reduce_scatter_fallback",
+                f"kvstore '{self.type}' does not support reduce-scatter; "
+                "falling back to plain bucket allreduce (every replica "
+                "keeps the full reduction)")
         return self.pushpull_buckets(tag, buckets, priority)
+
+    def reduce_scatter_bucket(self, tag, bi, bucket, priority=0):
+        """Single-bucket variant driven by the ZeRO-2 autograd hooks: each
+        bucket reduce-scatters the moment backward finishes producing its
+        members, overlapping comm with the rest of the backward walk. Uses
+        the same `__flat__/{tag}/{bi}` key namespace as pushpull_buckets /
+        reduce_scatter_buckets so error-feedback residuals are shared
+        bit-exactly with the allreduce path."""
+        if not self.supports_reduce_scatter():
+            self._warn_once(
+                "reduce_scatter_fallback",
+                f"kvstore '{self.type}' does not support reduce-scatter; "
+                "falling back to plain bucket allreduce (every replica "
+                "keeps the full reduction)")
+        self.pushpull(f"__flat__/{tag}/{bi}", bucket, out=bucket,
+                      priority=priority)
+        return bucket
 
     def all_gather_buckets(self, tag, buckets, priority=0):
         """Rebuild full flat buckets from updated weight shards. The
@@ -403,6 +439,12 @@ class DistPSKVStore(KVStore):
         raise RuntimeError(
             "the parameter-server store cannot reduce-scatter anonymous "
             "buckets; Trainer(zero1=True) should have degraded to the "
+            "unsharded fused path (supports_reduce_scatter() is False)")
+
+    def reduce_scatter_bucket(self, tag, bi, bucket, priority=0):
+        raise RuntimeError(
+            "the parameter-server store cannot reduce-scatter anonymous "
+            "buckets; Trainer(zero=...) should have degraded to the "
             "unsharded fused path (supports_reduce_scatter() is False)")
 
     def set_optimizer(self, optimizer):

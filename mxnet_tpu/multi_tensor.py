@@ -205,16 +205,22 @@ class _GroupExec:
 
 
 class _ZeroGroup:
-    """One ZeRO-1 parameter group: compiled executables plus the
-    RESIDENT sharded optimizer state. Unlike the unsharded path (state
-    lives per-parameter in Trainer._states), the authoritative state
-    here is one tree per flat bucket, laid out P(z1) across the update
-    mesh so each device holds 1/N of every moment/master buffer."""
+    """One ZeRO parameter group: compiled executables plus the RESIDENT
+    sharded optimizer state. Unlike the unsharded path (state lives
+    per-parameter in Trainer._states), the authoritative state here is
+    one tree per flat bucket, laid out P(z1) across the update mesh so
+    each device holds 1/N of every moment/master buffer. Stage 2 adds
+    resident 1/N GRAD shards (filled by autograd hooks as backward
+    produces each bucket); stage 3 makes the sharded WEIGHT buckets
+    authoritative, with just-in-time gathers on access."""
 
     __slots__ = ("idxs", "mp", "plans", "padded", "segs", "shard",
                  "flatten_fn", "flatpad_fn", "pad_fn", "wpad_fn",
                  "update_fn", "unflatten_fn", "states", "masters",
-                 "wshards", "wrote", "home")
+                 "wshards", "wrote", "home", "params", "reqs", "gdtype",
+                 "flat1_fns", "pad1_fns", "flatpad1_fns", "unflat1_fns",
+                 "pending", "gshards", "gfresh", "baccum", "k2bucket",
+                 "inflight")
 
     def __init__(self, idxs, mp, plans, padded, segs, shard, flatten_fn,
                  flatpad_fn, pad_fn, wpad_fn, update_fn, unflatten_fn,
@@ -234,13 +240,37 @@ class _ZeroGroup:
         self.states = states      # per bucket: sharded state tree
         self.masters = masters    # per bucket: sharded fp32 flat (mp)
         self.home = home          # SingleDeviceSharding: gather target
-        #: resident P(z1) weight buckets (non-mp) — valid while `wrote`
-        #: still matches the parameters' live arrays
+        #: resident P(z1) weight buckets — stage <= 2: an optimization
+        #: (skip the re-upload while `wrote` matches); stage 3: THE
+        #: authoritative weights (low-precision copy under mp)
         self.wshards = None
         #: the per-tensor arrays written back last step, for the
         #: identity staleness check (set_data() breaks the match and
-        #: forces a re-import)
+        #:  forces a re-import)
         self.wrote = None
+        #: group-local Parameter list / grad_req snapshot (hook + stage-3
+        #: paths address members by local index k)
+        self.params = None
+        self.reqs = None
+        self.gdtype = None
+        #: per-bucket single-bucket executables (hook flush / JIT gather)
+        self.flat1_fns = None
+        self.pad1_fns = None
+        self.flatpad1_fns = None
+        self.unflat1_fns = None
+        #: stage-2 collector: per-bucket {local k -> cotangent} awaiting
+        #: members, the resident 1/N grad shards, and per-bucket
+        #: freshness (a fresh shard already holds this round's reduction)
+        self.pending = None
+        self.gshards = None
+        self.gfresh = None
+        #: per-bucket: True when every member has grad_req == "add" (the
+        #: shard then ACCUMULATES across backward passes / microbatches)
+        self.baccum = None
+        self.k2bucket = None
+        #: stage-3 prefetch: bucket index -> in-flight gathered flat
+        #: bucket (dispatched async one bucket ahead of use)
+        self.inflight = None
 
 
 class MultiTensorUpdater:
@@ -248,18 +278,43 @@ class MultiTensorUpdater:
     fused XLA executables (one per dtype/state-structure group)."""
 
     def __init__(self, optimizer, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                 zero1: bool = False, num_shards: int = None):
+                 zero1: bool = False, num_shards: int = None,
+                 stage: int = None):
         self.optimizer = optimizer
         self.bucket_bytes = bucket_bytes
         self._cache: Dict = {}
         #: trace count — cache misses; steady state adds zero
         self.compiles = 0
-        #: ZeRO-1 weight-update sharding: shard the fused step (and all
-        #: optimizer state) over `num_shards` local devices
-        self.zero1 = bool(zero1)
+        #: ZeRO weight-update sharding (arXiv:2004.13336): stage 1
+        #: shards optimizer state, stage 2 additionally persists only
+        #: 1/N grad shards (reduce-scattered by autograd hooks during
+        #: backward), stage 3 additionally keeps the weights sharded
+        #: with just-in-time gathers. `zero1=True` is the stage-1 alias.
+        self.stage = int(stage) if stage is not None else (1 if zero1 else 0)
+        self.zero1 = self.stage >= 1
         self._num_shards = num_shards
         self._zmesh = None
         self._zgroups: Dict = {}
+        # stage >= 2 hook state: the registered fused param set, its
+        # live states dict / kvstore, and the lazily-(re)built map from
+        # param index -> (group, gid, bucket j, local k)
+        self._hook_params = None
+        self._hook_states = None
+        self._hook_kvstore = None
+        self._hook_map = None
+        self._hook_sig = None
+        #: observability: bucket flushes fired DURING backward (overlap)
+        #: vs. flushed lazily at step()
+        self.hook_flushes = 0
+        self.step_flushes = 0
+        if self.stage >= 1:
+            import weakref
+            from . import profiler as _prof
+            ref = weakref.ref(self)
+            _prof.register_memory_provider(
+                f"zero{self.stage}_updater_{id(self):x}",
+                lambda: (lambda u: None if u is None
+                         else u.zero_resident_bytes())(ref()))
 
     @property
     def cache_size(self) -> int:
@@ -279,28 +334,39 @@ class MultiTensorUpdater:
     # -- grouping ----------------------------------------------------------
     def _mp_active(self, p, state) -> bool:
         opt = self.optimizer
-        return (opt._use_mp(p.data()) and isinstance(state, tuple)
+        return (opt._use_mp(p._data) and isinstance(state, tuple)
                 and len(state) == 2 and isinstance(state[0], jax.Array))
 
-    def step(self, indexed_params, states: Dict, kvstore=None):
-        """One fused optimizer step over `indexed_params`
-        ([(index, Parameter), ...]). Mutates parameter data in place and
-        rebinds `states[index]`, exactly like the per-param loop."""
+    def _group_members(self, indexed_params, states: Dict):
+        """Partition params into fused groups. Shared by step() and the
+        stage-2 hook path so bucket/group ids (and therefore compression
+        residual keys) always agree between the two."""
         opt = self.optimizer
         groups: "OrderedDict" = OrderedDict()
         for i, p in indexed_params:
             if self.zero1 and i not in states:
                 # state lives shard-sized inside a _ZeroGroup (or is yet
                 # to be created there) — group by weight dtype + mp only
-                mp = opt._use_mp(p.data())
+                mp = opt._use_mp(p._data)
                 skey = ("__zero1__", mp)
                 state = None
             else:
                 state = states.get(i)
                 mp = self._mp_active(p, state)
                 skey = jax.tree_util.tree_structure(state)
-            key = (str(p.data()._data.dtype), mp, skey)
+            # p._data._data may be a stage-3 ShapeDtypeStruct placeholder
+            # (released weights); .dtype works on both, and crucially the
+            # grouping never forces a materializing p.data() call
+            key = (str(p._data._data.dtype), mp, skey)
             groups.setdefault(key, []).append((i, p, state))
+        return groups
+
+    def step(self, indexed_params, states: Dict, kvstore=None):
+        """One fused optimizer step over `indexed_params`
+        ([(index, Parameter), ...]). Mutates parameter data in place and
+        rebinds `states[index]`, exactly like the per-param loop."""
+        opt = self.optimizer
+        groups = self._group_members(indexed_params, states)
         # bump every update count first; identical to the interleaved
         # loop because all counts advance in lockstep (num_update is the
         # running max, reached at the first parameter either way)
@@ -308,7 +374,7 @@ class MultiTensorUpdater:
             opt._update_count(i)
         for gid, members in enumerate(groups.values()):
             if self.zero1:
-                self._apply_group_zero1(gid, members, states, kvstore)
+                self._apply_group_zero(gid, members, states, kvstore)
             else:
                 self._apply_group(gid, members, states, kvstore)
 
@@ -419,20 +485,23 @@ class MultiTensorUpdater:
     def num_shards(self) -> int:
         return int(self._zero1_mesh().devices.size)
 
-    def _apply_group_zero1(self, gid, members, states, kvstore):
-        """ZeRO-1 analogue of _apply_group: reduce(-scatter) the grad
-        buckets, update only this replica's 1/N shard of every bucket
-        (state resident sharded on the update mesh), gather the new
-        weights back to full per-tensor form."""
+    def _zero_group_for(self, gid, members, states):
+        """Find (or build, spilling any overlapping stale group) the
+        resident _ZeroGroup for this member set. Shared by step() and
+        the stage-2 hook path."""
         opt = self.optimizer
         idxs = tuple(i for (i, _, _) in members)
         _, p0, s0 = members[0]
-        wdtype = p0.data()._data.dtype
+        wdtype = p0._data._data.dtype
         mp = (self._mp_active(p0, s0) if s0 is not None
-              else opt._use_mp(p0.data()))
-        gs = [p.grad()._data for (_, p, _) in members]
+              else opt._use_mp(p0._data))
+        # keyed on weight metadata, not grads: under stage >= 2 the
+        # full-size grad buffers no longer exist (attach_grad contract:
+        # grads share the weight's shape and dtype)
         cache_key = (type(opt), mp, str(wdtype), idxs,
-                     tuple((tuple(g.shape), str(g.dtype)) for g in gs))
+                     tuple((tuple(p._data._data.shape),
+                            str(p._data._data.dtype))
+                           for (_, p, _) in members))
         zg = self._zgroups.get(cache_key)
         if zg is None:
             # group composition changed (e.g. a grad_req toggled):
@@ -441,9 +510,23 @@ class MultiTensorUpdater:
             for k2 in [k for k, g2 in self._zgroups.items()
                        if set(g2.idxs) & set(idxs)]:
                 self._export_group(self._zgroups.pop(k2), states)
+            self._hook_map = None  # bucket layout changed
             zg = self._build_zero1(members, mp, wdtype, states)
             self._zgroups[cache_key] = zg
             self.compiles += 1
+        return zg
+
+    def _apply_group_zero(self, gid, members, states, kvstore):
+        """ZeRO analogue of _apply_group: reduce(-scatter) the grad
+        buckets, update only this replica's 1/N shard of every bucket
+        (state resident sharded on the update mesh). Stage <= 2 gathers
+        the new weights back to full per-tensor form; stage 3 keeps them
+        sharded and releases the full-size parameter arrays."""
+        opt = self.optimizer
+        stage = self.stage
+        idxs = tuple(i for (i, _, _) in members)
+        zg = self._zero_group_for(gid, members, states)
+        mp = zg.mp
 
         lrs, wds, ts, rescale = opt._fused_hyper_vectors(list(idxs))
         # entry n is the padding segment's hyper: lr/wd 0, t=1 (keeps
@@ -453,35 +536,53 @@ class MultiTensorUpdater:
         ts = jnp.concatenate([ts, jnp.ones((1,), ts.dtype)])
         extras = opt._zero1_hyper_extras(lrs, wds, ts)
 
-        if kvstore is not None:
-            buckets = self._reduce_scatter(kvstore, gid,
-                                           zg.flatten_fn(gs))
-            pads = zg.pad_fn(buckets)
+        if stage >= 2:
+            # grads were reduce-scattered bucket-by-bucket as backward
+            # produced them (autograd hooks); consume the resident
+            # shards, force-flushing any bucket the hooks did not finish
+            # (manual grad writes, partial backward)
+            g_bks = self._collect_grad_shards(zg, gid, kvstore)
         else:
-            pads = zg.flatpad_fn(gs)
-        # THE scatter: pad on the source device, then place each grad
-        # bucket P(z1) so every replica receives exactly its 1/N slice
-        # (params/grads may be committed to a single device — explicit
-        # device_put is the one legal path onto the update mesh)
-        g_bks = jax.device_put(pads, [zg.shard] * len(pads))
+            gs = [p.grad()._data for (_, p, _) in members]
+            if kvstore is not None:
+                buckets = self._reduce_scatter(kvstore, gid,
+                                               zg.flatten_fn(gs))
+                pads = zg.pad_fn(buckets)
+            else:
+                pads = zg.flatpad_fn(gs)
+            # THE scatter: pad on the source device, then place each
+            # grad bucket P(z1) so every replica receives exactly its
+            # 1/N slice (params/grads may be committed to a single
+            # device — explicit device_put is the one legal path onto
+            # the update mesh)
+            g_bks = jax.device_put(pads, [zg.shard] * len(pads))
         if mp:
             zg.states, zg.masters, w_bks = zg.update_fn(
                 zg.states, zg.masters, g_bks, zg.segs,
                 lrs, wds, ts, rescale, extras)
         else:
-            ws = [p.data()._data for (_, p, _) in members]
-            if zg.wrote is not None and len(zg.wrote) == len(ws) and \
-                    all(a is b for a, b in zip(ws, zg.wrote)):
-                # weights unchanged since our last write-back: reuse the
-                # resident sharded buckets, skip the re-upload
+            if self._weights_clean(zg):
+                # weights unchanged since our last write-back (or still
+                # released, stage 3): reuse the resident sharded
+                # buckets, skip the re-upload
                 w_in = zg.wshards
             else:
+                ws = [p.data()._data for (_, p, _) in members]
                 w_in = jax.device_put(zg.wpad_fn(ws),
                                       [zg.shard] * len(zg.padded))
             zg.states, w_bks = zg.update_fn(
                 zg.states, w_in, g_bks, zg.segs, lrs, wds, ts, rescale,
                 extras)
-            zg.wshards = w_bks
+        # resident sharded weights: stage 3's authoritative copy (the
+        # low-precision one under mp); stage <= 2 keeps them only on the
+        # non-mp path as a re-upload-skipping optimization
+        zg.wshards = w_bks if (stage >= 3 or not mp) else None
+        if stage >= 3:
+            # no gather: the sharded buckets ARE the weights now. Full
+            # arrays rematerialize lazily (Parameter.data() -> one
+            # transient per-bucket gather with one-bucket lookahead).
+            self._release_group(zg)
+            return
         # the all-gather: one device_put per bucket back to the home
         # device (single-process gather — no host bounce). The arrays
         # land committed there, which matches where eager NDArray data
@@ -491,8 +592,248 @@ class MultiTensorUpdater:
             w_bks, [zg.home] * len(w_bks)))
         for k, (i, p, _) in enumerate(members):
             p.data()._data = new_ws[k]
-        if not mp:
-            zg.wrote = list(new_ws)
+        zg.wrote = list(new_ws)
+
+    def _weights_clean(self, zg) -> bool:
+        """True when the resident sharded weight buckets still reflect
+        the parameters' live values: every member either carries the
+        exact array we wrote back (identity check — set_data() breaks
+        it) or is still released (stage-3 placeholder)."""
+        if zg.wshards is None or zg.mp:
+            # mp: fp32 masters are authoritative from the first build on
+            return zg.wshards is not None and zg.mp
+        if zg.wrote is None:
+            return False
+        for k, p in enumerate(zg.params):
+            d = p._data._data
+            if isinstance(d, jax.Array) and zg.wrote[k] is not d:
+                return False
+        return True
+
+    # -- ZeRO-2: hook-driven grad bucket reduce-scatter --------------------
+    def register_grad_hooks(self, indexed_params, states: Dict,
+                            kvstore=None):
+        """Install per-parameter autograd hooks (stage >= 2): each hook
+        consumes its leaf's cotangent the moment backward finishes with
+        it; when a bucket's last member lands, the bucket reduce-scatters
+        immediately — overlapping comm with the rest of the backward
+        walk — and only the 1/N shard stays resident. The full-size grad
+        buffers are replaced by 0-size placeholders."""
+        if self.stage < 2:
+            return
+        self._hook_params = list(indexed_params)
+        self._hook_states = states
+        self._hook_kvstore = kvstore
+        self._hook_map = None
+        self._hook_sig = None
+        for i, p in self._hook_params:
+            # registration must NOT clear existing grad buffers: the
+            # trainer installs hooks lazily on the first step(), which
+            # runs AFTER the first backward already wrote real grads
+            # there. Buffers are freed the first time a hook consumes a
+            # cotangent instead (_hook_fire).
+            p._data._grad_hook = self._make_hook(i)
+
+    def _make_hook(self, i):
+        def hook(arr, g):
+            return self._hook_fire(i, arr, g)
+        return hook
+
+    def _hook_signature(self):
+        return tuple((i, id(p._data), p.grad_req)
+                     for i, p in self._hook_params)
+
+    def _ensure_hook_map(self):
+        """(Re)build param index -> (group, gid, bucket, local k) using
+        the SAME grouping as step(), so hook-time reduce-scatters use
+        identical bucket tags (and compression residual keys) as the
+        step-time path."""
+        sig = self._hook_signature()
+        if self._hook_map is not None and sig == self._hook_sig:
+            return
+        self._hook_sig = sig
+        self._hook_map = {}
+        live = [(i, p) for i, p in self._hook_params
+                if p.grad_req != "null"]
+        groups = self._group_members(live, self._hook_states)
+        for gid, members in enumerate(groups.values()):
+            zg = self._zero_group_for(gid, members, self._hook_states)
+            for k, (i, _, _) in enumerate(members):
+                self._hook_map[i] = (zg, gid, zg.k2bucket[k], k)
+
+    def _hook_fire(self, i, arr, g) -> bool:
+        """Autograd delivered leaf i's finalized cotangent. Stash it in
+        its bucket's pending set; flush (reduce-scatter + accumulate
+        into the resident shard) once the bucket is complete. Returns
+        True when consumed."""
+        if self.stage < 2 or self._hook_params is None:
+            return False
+        self._ensure_hook_map()
+        ent = self._hook_map.get(i)
+        if ent is None:
+            return False
+        zg, gid, j, k = ent
+        buf = zg.pending[j]
+        if k in buf:
+            # same leaf contributed twice between flushes (e.g. two
+            # backward passes): combine by its grad_req semantics
+            buf[k] = buf[k] + g if zg.reqs[k] == "add" else g
+        else:
+            buf[k] = g
+        gb = arr._grad
+        if gb is not None and gb._data.size:
+            # first consumption: free the full-size grad buffer — from
+            # here on this leaf's resident grad state is the 1/N shard.
+            # Under "add" the buffer may hold grads accumulated before
+            # the hook was installed; fold them in first.
+            if zg.reqs[k] == "add" and \
+                    tuple(gb._data.shape) == tuple(g.shape):
+                buf[k] = buf[k] + gb._data
+            gb._data = jnp.zeros((0,), gb._data.dtype)
+        if len(buf) == len(zg.plans[j]):
+            self._flush_bucket(zg, gid, j)
+            self.hook_flushes += 1
+        return True
+
+    def _flush_bucket(self, zg, gid, j, force=False):
+        """Reduce-scatter one grad bucket into its resident 1/N shard.
+        `force` fills members the hooks never saw from their grad
+        buffers (manual writes) or zeros (partial backward)."""
+        plan = zg.plans[j]
+        buf = zg.pending[j]
+        if not force and len(buf) < len(plan):
+            return
+        if force and not buf and zg.gfresh[j]:
+            return  # nothing new since the last flush
+        leaves = []
+        for (k, off, size, shape) in plan:
+            g = buf.get(k)
+            if g is None:
+                gb = zg.params[k]._data._grad
+                d = gb._data if gb is not None else None
+                if d is not None and tuple(d.shape) == shape:
+                    g = d  # manually written full grad
+                else:
+                    g = jnp.zeros(shape, zg.gdtype)
+            leaves.append(g)
+        buf.clear()
+        kv = self._hook_kvstore
+        if kv is not None and kv.supports_flat_pushpull():
+            # same __flat__/{gid}/{j} key as the allreduce path: the
+            # compression error-feedback residuals stay bit-identical
+            from .ndarray import NDArray
+            nd = NDArray(zg.flat1_fns[j](leaves))
+            kv.reduce_scatter_bucket(gid, j, nd)
+            flat = zg.pad1_fns[j](nd._data)
+        else:
+            flat = zg.flatpad1_fns[j](leaves)
+        shard_flat = jax.device_put(flat, zg.shard)
+        if zg.gfresh[j] and zg.baccum[j] and zg.gshards[j] is not None:
+            # grad_accum: accumulate IN THE SHARD — the full-size sum
+            # never exists (slice-then-add == add-then-slice, elementwise
+            # exact, so microbatch accumulation stays bit-identical to
+            # the unsharded sum)
+            zg.gshards[j] = zg.gshards[j] + shard_flat
+        else:
+            zg.gshards[j] = shard_flat
+        zg.gfresh[j] = True
+
+    def _collect_grad_shards(self, zg, gid, kvstore):
+        """Step-time consumption of the resident grad shards; buckets
+        the hooks did not complete are force-flushed here (falling back
+        to grad buffers / zeros)."""
+        if self._hook_kvstore is None and kvstore is not None:
+            self._hook_kvstore = kvstore
+        nbk = len(zg.plans)
+        for j in range(nbk):
+            if zg.pending[j] or not zg.gfresh[j]:
+                self._flush_bucket(zg, gid, j, force=True)
+                self.step_flushes += 1
+        out = zg.gshards
+        # hand the shards to the (donating) update executable and reset
+        # the collector for the next round
+        zg.gshards = [None] * nbk
+        zg.gfresh = [False] * nbk
+        return out
+
+    # -- ZeRO-3: sharded weights with just-in-time gathers -----------------
+    def _release_group(self, zg):
+        """Drop every member's full-size weight array, leaving a
+        ShapeDtypeStruct placeholder plus a lazy fetch that gathers the
+        parameter's bucket on first access (Parameter.data())."""
+        if zg.wrote is None or len(zg.wrote) != len(zg.params):
+            zg.wrote = [None] * len(zg.params)
+        for k, p in enumerate(zg.params):
+            d = p._data._data
+            p._data._data = jax.ShapeDtypeStruct(tuple(d.shape), d.dtype)
+            p._lazy_fetch = self._make_fetch(zg, k)
+            zg.wrote[k] = None
+        zg.inflight.clear()
+
+    def _make_fetch(self, zg, k):
+        def fetch(param):
+            self._materialize_bucket(zg, zg.k2bucket[k])
+        return fetch
+
+    def _materialize_bucket(self, zg, j):
+        """Gather bucket j's weights back to the home device and fill in
+        its members' arrays; dispatch the NEXT bucket's gather async
+        (one-bucket lookahead) so sequential layer access — fwd or bwd —
+        hides the gather latency."""
+        fut = zg.inflight.pop(j, None)
+        if fut is None:
+            fut = jax.device_put(zg.wshards[j], zg.home)
+        jn = j + 1
+        if jn < len(zg.plans) and jn not in zg.inflight and any(
+                not isinstance(zg.params[k]._data._data, jax.Array)
+                for (k, _, _, _) in zg.plans[jn]):
+            zg.inflight[jn] = jax.device_put(zg.wshards[jn], zg.home)
+        leaves = zg.unflat1_fns[j](fut)
+        for arr, (k, _, _, _) in zip(leaves, zg.plans[j]):
+            p = zg.params[k]
+            if not isinstance(p._data._data, jax.Array):
+                p._data._data = arr
+                p._lazy_fetch = None
+                zg.wrote[k] = arr
+
+    # -- resident-bytes accounting (profiler memory provider) --------------
+    def zero_resident_bytes(self):
+        """Per-replica resident training bytes by category. Sharded
+        buffers count global/N; replicated (full-size) buffers count
+        full. Stage-3 transiently materialized weights and in-flight
+        gathers count as 'transient'."""
+        n = max(1, self.num_shards)
+        w = g = o = t = 0
+        for zg in self._zgroups.values():
+            for st in zg.states:
+                for leaf in jax.tree_util.tree_leaves(st):
+                    o += leaf.nbytes // n
+            if zg.mp and zg.masters:
+                for m in zg.masters:
+                    o += m.nbytes // n
+            if zg.wshards is not None:
+                for b in zg.wshards:
+                    if b is not None:
+                        w += b.nbytes // n
+            for p in (zg.params or []):
+                d = p._data._data
+                if isinstance(d, jax.Array):
+                    if self.stage >= 3:
+                        t += d.nbytes  # transient gather, freed on step
+                    else:
+                        w += d.nbytes
+                gb = p._data._grad
+                if gb is not None and isinstance(gb._data, jax.Array):
+                    g += gb._data.nbytes
+            for sh in (zg.gshards or []):
+                if sh is not None:
+                    g += sh.nbytes // n
+            for buf in (zg.pending or []):
+                for ga in buf.values():
+                    t += ga.nbytes
+            for fut in (zg.inflight or {}).values():
+                t += fut.nbytes
+        return {"weights": w, "grads": g, "opt_state": o, "transient": t}
 
     def _reduce_scatter(self, kvstore, gid, buckets):
         """Cross-replica reduction of the UNPADDED grad buckets (keeps
@@ -500,7 +841,13 @@ class MultiTensorUpdater:
         scatter placement is done by the sharded executable's specs."""
         from .ndarray import NDArray
         nds = [NDArray(b) for b in buckets]
-        kvstore.reduce_scatter_buckets(gid, nds)
+        if kvstore.supports_reduce_scatter():
+            kvstore.reduce_scatter_buckets(gid, nds)
+        else:
+            # a zero>=2 request already degraded (with its own warning)
+            # to ZeRO-1 on this store: plain bucket allreduce, skipping
+            # the store's redundant reduce-scatter fallback warning
+            kvstore.pushpull_buckets(gid, nds)
         return [nd._data for nd in nds]
 
     def _build_zero1(self, members, mp, wdtype, states) -> _ZeroGroup:
@@ -511,9 +858,12 @@ class MultiTensorUpdater:
         idxs = [i for (i, _, _) in members]
         P = jax.sharding.PartitionSpec
         shard = jax.sharding.NamedSharding(mesh, P(ZERO1_AXIS))
-        gs = [p.grad()._data for (_, p, _) in members]
-        plans = plan_buckets([g.shape for g in gs], [g.dtype for g in gs],
-                             self.bucket_bytes)
+        # plan on weight metadata (== grad metadata by the attach_grad
+        # contract): under stage >= 2 the full grad buffers do not
+        # exist, and under stage 3 the weights may be released
+        wmeta = [p._data._data for (_, p, _) in members]
+        plans = plan_buckets([tuple(w.shape) for w in wmeta],
+                             [w.dtype for w in wmeta], self.bucket_bytes)
         padded = zero1_padded_sizes(plans, nsh)
         segs = [jax.device_put(jnp.asarray(s), shard)
                 for s in bucket_segments(plans, padded, n)]
@@ -578,10 +928,41 @@ class MultiTensorUpdater:
         ws0 = members[0][1].data()._data
         home = jax.sharding.SingleDeviceSharding(
             next(iter(ws0.devices())))
-        return _ZeroGroup(idxs, mp, plans, padded, segs, shard,
-                          flatten_fn, flatpad_fn, pad_fn, wpad_fn,
-                          update_fn, unflatten_fn, bucket_states,
-                          masters, home)
+        zg = _ZeroGroup(idxs, mp, plans, padded, segs, shard,
+                        flatten_fn, flatpad_fn, pad_fn, wpad_fn,
+                        update_fn, unflatten_fn, bucket_states,
+                        masters, home)
+        zg.params = [p for (_, p, _) in members]
+        zg.reqs = [p.grad_req for (_, p, _) in members]
+        zg.gdtype = wmeta[0].dtype
+        nbk = len(plans)
+        # single-bucket executables: the stage-2 hook flush works one
+        # bucket at a time (that IS the overlap), and the stage-3 lazy
+        # gather rebuilds one bucket's tensors at a time
+        zg.flat1_fns, zg.pad1_fns, zg.flatpad1_fns, zg.unflat1_fns = \
+            [], [], [], []
+        for plan, tot in zip(plans, padded):
+            zg.flat1_fns.append(jax.jit(
+                lambda ls, plan=plan: flatten_buckets(ls, [plan])[0]))
+            zg.pad1_fns.append(jax.jit(
+                lambda b, plan=plan, tot=tot:
+                pad_buckets([b], [plan], [tot])[0]))
+            zg.flatpad1_fns.append(jax.jit(
+                lambda ls, plan=plan, tot=tot: pad_buckets(
+                    flatten_buckets(ls, [plan]), [plan], [tot])[0]))
+            zg.unflat1_fns.append(jax.jit(
+                lambda b, plan=plan:
+                [jax.lax.slice(b, (off,), (off + size,)).reshape(shape)
+                 for (_, off, size, shape) in plan]))
+        zg.pending = [dict() for _ in range(nbk)]
+        zg.gshards = [None] * nbk
+        zg.gfresh = [False] * nbk
+        zg.baccum = [all(zg.reqs[k] == "add" for (k, _, _, _) in plan)
+                     for plan in plans]
+        zg.k2bucket = {k: j for j, plan in enumerate(plans)
+                       for (k, _, _, _) in plan}
+        zg.inflight = {}
+        return zg
 
     def _fresh_zero1_state(self, members, mp, wdtype, plans, padded,
                            shard):
@@ -664,8 +1045,17 @@ class MultiTensorUpdater:
 
     def zero1_reset(self):
         """Drop resident sharded state; the next step() re-imports from
-        the per-parameter states dict (used by Trainer.load_states)."""
+        the per-parameter states dict (used by Trainer.load_states).
+        Stage 3 materializes weights first so no parameter is left
+        pointing at a dropped group's shards."""
+        if self.stage >= 3:
+            for zg in self._zgroups.values():
+                for p in (zg.params or []):
+                    if not isinstance(p._data._data, jax.Array):
+                        p.data()  # lazy fetch -> full array
         self._zgroups.clear()
+        self._hook_map = None
+        self._hook_sig = None
 
     def zero1_state_nbytes(self) -> Tuple[int, int]:
         """(total_bytes, per_replica_bytes) of resident optimizer state

@@ -100,7 +100,7 @@ class NDArray:
     autograd tape sound without the reference's write-dependency engine."""
 
     __slots__ = ("_data", "_ctx", "_node", "_grad", "_grad_req", "_stype",
-                 "__weakref__")
+                 "_grad_hook", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, _place=False):
         if isinstance(data, NDArray):
@@ -113,6 +113,10 @@ class NDArray:
         self._grad = None
         self._grad_req = "write"
         self._stype = "default"
+        # ZeRO-2: backward() offers this leaf's cotangent to the hook the
+        # moment its last consumer node has run; a hook returning True
+        # consumes it (the full-size grad buffer is never written)
+        self._grad_hook = None
 
     # -- autograd wiring ----------------------------------------------------
     @property

@@ -45,6 +45,20 @@ def _global_put(v, sh):
         return jax.device_put(_np.asarray(v), sh)
 
 
+def _unshard(v):
+    """Gather a (possibly mesh-sharded) array to one replicated value."""
+    if not hasattr(v, "sharding") or len(v.sharding.device_set) <= 1:
+        return v
+    if v.sharding.is_fully_replicated:
+        # one shard already holds the full value — no host copy
+        return v.addressable_shards[0].data
+    if not v.is_fully_addressable:  # multi-host (TPU pod) case
+        from jax.experimental import multihost_utils
+        return jnp.asarray(
+            multihost_utils.process_allgather(v, tiled=True))
+    return jnp.asarray(_np.asarray(v))  # gather sharded dims
+
+
 def _param_shardings(params, names, mesh):
     """NamedSharding per parameter: its Parameter.sharding spec, else
     replicated."""
@@ -160,7 +174,7 @@ class FusedTrainStep:
     def __init__(self, net, loss_fn, trainer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", donate: bool = True,
                  n_model_inputs: int = 1, grad_accum: int = 1,
-                 compression=None, zero1: bool = False):
+                 compression=None, zero1: bool = False, zero=None):
         from ..gluon.trainer import Trainer
         self.net = net
         self.loss_fn = loss_fn
@@ -169,7 +183,8 @@ class FusedTrainStep:
             self._trainer = trainer
             if compression is None:
                 compression = trainer._compression_params
-            zero1 = zero1 or trainer._zero1
+            if zero is None and trainer._zero_req:
+                zero = trainer._zero_req
         else:
             self.optimizer = trainer
             self._trainer = None
@@ -182,12 +197,26 @@ class FusedTrainStep:
         # allreduce with error feedback (reference:
         # src/kvstore/gradient_compression.cc; see parallel/compression)
         self.compression = dict(compression) if compression else None
-        # ZeRO-1 weight-update sharding (arXiv:2004.13336): grads
-        # reduce-scatter per flat bucket, each replica updates its 1/N
-        # shard with shard-sized optimizer state, weights all-gather
-        # back — all inside the one compiled step so XLA schedules the
-        # collectives into the backward
-        self.zero1 = bool(zero1)
+        # ZeRO weight-update sharding (arXiv:2004.13336), all inside the
+        # one compiled step so XLA schedules the collectives into the
+        # backward. zero=1: grads reduce-scatter per flat bucket, each
+        # replica updates its 1/N shard with shard-sized optimizer
+        # state, weights all-gather back. zero=2 additionally carries
+        # only SHARD-sized gradient accumulators through the grad_accum
+        # scan (each microbatch psum_scatters immediately — the comm
+        # overlaps the next microbatch's compute and the full-size grad
+        # sum never exists). zero=3 additionally keeps the weights as
+        # sharded flat buckets; the step all-gathers them transiently at
+        # entry and emits updated SHARDS, so full-size weights exist
+        # only inside the executable. zero1=True is the zero=1 alias.
+        stage = 0 if zero in (None, False) else int(zero)
+        if stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero must be one of False/0/1/2/3; "
+                             f"got {zero!r}")
+        if zero1 and stage == 0:
+            stage = 1
+        self.zero_stage = stage
+        self.zero1 = stage >= 1
         self._compiled = None
         self._params = None
         self._tr = None
@@ -195,6 +224,15 @@ class FusedTrainStep:
         self._states = None
         self._resid = None
         self._step_count = 0
+        self._zero3 = False  # _build_zero1 flips: _tr holds flat shards
+        self._zero1_groups = None
+        import weakref
+        from .. import profiler as _prof
+        ref = weakref.ref(self)
+        _prof.register_memory_provider(
+            f"fused_step_{id(self):x}",
+            lambda ref=ref: (lambda s: s.fused_resident_bytes()
+                             if s is not None else None)(ref()))
 
     # -- state pull/push ----------------------------------------------------
     def _init_state(self, args):
@@ -228,23 +266,47 @@ class FusedTrainStep:
     def sync_to_params(self):
         """Write device weights back into the Parameters (checkpointing /
         eval through the normal Gluon path). Mesh-sharded weights are
-        gathered to a single replicated array so eager code can use them."""
-        def unshard(v):
-            if not hasattr(v, "sharding") or \
-                    len(v.sharding.device_set) <= 1:
-                return v
-            if v.sharding.is_fully_replicated:
-                # one shard already holds the full value — no host copy
-                return v.addressable_shards[0].data
-            if not v.is_fully_addressable:  # multi-host (TPU pod) case
-                from jax.experimental import multihost_utils
-                return jnp.asarray(
-                    multihost_utils.process_allgather(v, tiled=True))
-            return jnp.asarray(_np.asarray(v))  # gather sharded dims
-        for n in self._tr_names:
-            self._params[n].data()._data = unshard(self._tr[n])
+        gathered to a single replicated array so eager code can use them;
+        ZeRO-3 flat weight shards gather and unflatten per bucket — the
+        checkpoint is full-size and replica-count portable."""
+        if self._zero3:
+            from .. import multi_tensor as _mt
+            for gi, g in enumerate(self._zero1_groups):
+                fulls = [_unshard(self._tr[f"__zero3__{gi}_{j}"])
+                         for j in range(len(g.plans))]
+                for n, w in zip(g.names, _mt.unflatten_buckets(
+                        fulls, g.plans, len(g.names))):
+                    self._params[n].data()._data = w
+        else:
+            for n in self._tr_names:
+                self._params[n].data()._data = _unshard(self._tr[n])
         for n in self._aux_names:
-            self._params[n].data()._data = unshard(self._aux[n])
+            self._params[n].data()._data = _unshard(self._aux[n])
+
+    def refresh_weights(self):
+        """Re-import weights from the net's Parameters into the step's
+        device buffers (after set_data / checkpoint restore). Inverse of
+        sync_to_params; under ZeRO-3 the full-size parameters flatten
+        back into sharded flat buckets."""
+        params = self._params if self._params is not None \
+            else self.net.collect_params()
+        if self._zero3:
+            from .. import multi_tensor as _mt
+            new_tr = {}
+            for gi, g in enumerate(self._zero1_groups):
+                w_bks = _mt.pad_buckets(_mt.flatten_buckets(
+                    [params[n].data()._data for n in g.names], g.plans),
+                    g.plans, g.padded)
+                for j, b in enumerate(w_bks):
+                    k = f"__zero3__{gi}_{j}"
+                    new_tr[k] = _global_put(b, self._tr_sh[k])
+            self._tr = new_tr
+        else:
+            self._tr = {n: params[n].data()._data
+                        for n in self._tr_names}
+            if self.mesh is not None and self._compiled is not None:
+                self._tr = {n: _global_put(v, self._tr_sh[n])
+                            for n, v in self._tr.items()}
 
     # -- compilation ---------------------------------------------------------
     def _build(self, args):
@@ -317,7 +379,7 @@ class FusedTrainStep:
                     self.dp_axis in self.mesh.axis_names and \
                     self.mesh.shape[self.dp_axis] > 1:
                 self._build_zero1(args, local_grads, tr_names,
-                                  aux_names)
+                                  aux_names, loss_of=loss_of)
                 return
             import warnings
             warnings.warn(
@@ -464,16 +526,23 @@ class FusedTrainStep:
         self._tr_names = tr_names
         self._aux_names = aux_names
 
-    def _build_zero1(self, args, local_grads, tr_names, aux_names):
-        """ZeRO-1 variant: the step runs inside shard_map over the dp
-        axis; grads flatten into contiguous buckets and reduce-scatter
-        (psum_scatter), each replica runs the fused optimizer math on
-        its 1/N contiguous shard with SHARD-SIZED optimizer state, and
-        the updated weight shards all-gather back into full weights.
-        Optimizer state memory drops N-fold; the wire cost equals one
-        allreduce (reduce-scatter + all-gather). Composes with gradient
-        compression: codes ride the reduce-scatter, error feedback keeps
-        the full local residual. Pure data parallelism only."""
+    def _build_zero1(self, args, local_grads, tr_names, aux_names,
+                     loss_of=None):
+        """ZeRO variant (stages 1-3): the step runs inside shard_map
+        over the dp axis; grads flatten into contiguous buckets and
+        reduce-scatter (psum_scatter), each replica runs the fused
+        optimizer math on its 1/N contiguous shard with SHARD-SIZED
+        optimizer state. Stage 1/2: the updated weight shards all-gather
+        back into full weights (optimizer state memory drops N-fold; the
+        wire cost equals one allreduce). Stage 2 additionally replaces
+        the grad_accum scan's full-size fp32 accumulators with
+        shard-sized ones (per-microbatch reduce-scatter overlapped with
+        compute). Stage 3 keeps the weights sharded across steps:
+        transient in-step all-gathers materialize them, and the update
+        emits shards — weight memory drops N-fold too. Composes with
+        gradient compression: codes ride the reduce-scatter, error
+        feedback keeps the full local residual. Pure data parallelism
+        only."""
         from ..base import shard_map
         from .. import multi_tensor as _mt
         from .compression import compressed_psum_scatter
@@ -581,47 +650,128 @@ class FusedTrainStep:
         state_keys = [_skey(gi, j) for gi, g in enumerate(grp_list)
                       for j in range(len(g.plans))]
 
-        def step(tr, aux, states, hyper, key, resid, *batch):
-            # distinct dropout keys per dp shard
-            key = jax.random.fold_in(key, lax.axis_index(dp))
-            loss, new_aux, grads = local_grads(tr, aux, key, batch)
-            loss = lax.pmean(loss, dp)
-            new_aux = {n: lax.pmean(v, dp)
-                       if jnp.issubdtype(v.dtype, jnp.inexact)
-                       else lax.pmax(v, dp) for n, v in new_aux.items()}
-            rank = lax.axis_index(dp)
-            new_tr, new_states, new_resid = {}, {}, {}
+        z3 = self.zero_stage >= 3
+
+        def _sk3(gi, j):
+            return f"__zero3__{gi}_{j}"
+
+        def _reduce_shards(grads, resid):
+            """Flatten local grads into buckets and reduce-scatter each:
+            every rank keeps only its 1/N shard of the reduced grads."""
+            red, new_resid = {}, {}
             for gi, g in enumerate(grp_list):
                 g_bks = _mt.pad_buckets(_mt.flatten_buckets(
                     [grads[n] for n in g.names], g.plans),
                     g.plans, g.padded)
-                w_bks = _mt.pad_buckets(_mt.flatten_buckets(
-                    [tr[n] for n in g.names], g.plans),
-                    g.plans, g.padded)
-                full = []
-                for j, (gb, wb) in enumerate(zip(g_bks, w_bks)):
+                for j, gb in enumerate(g_bks):
                     sk = _skey(gi, j)
-                    ssz = g.padded[j] // ndp
                     if scheme is not None:
-                        red, nres = compressed_psum_scatter(
+                        red[sk], nres = compressed_psum_scatter(
                             gb, resid[sk][0], dp, scheme, threshold)
                         new_resid[sk] = nres[None]
                     else:
-                        red = lax.psum_scatter(
+                        red[sk] = lax.psum_scatter(
                             gb, dp, scatter_dimension=0,
                             tiled=True) / ndp
-                    w_sh = lax.dynamic_slice(wb, (rank * ssz,), (ssz,))
+            return red, new_resid
+
+        # zero>=2 + grad_accum: the scan carries SHARD-sized gradient
+        # accumulators — each microbatch reduce-scatters immediately
+        # (the collective overlaps the next microbatch's compute) and
+        # the full-size grad sum never exists. Compression keeps the
+        # accumulate-then-quantize path: its error-feedback residual is
+        # full-size resident anyway, and quantizing every microbatch
+        # would break parity with the unsharded compressed step.
+        accum = self.grad_accum
+        shard_carry = self.zero_stage >= 2 and accum > 1 \
+            and scheme is None
+
+        def sharded_accum_grads(tr, aux, key, batch):
+            micro = tuple(
+                b.reshape(accum, b.shape[0] // accum, *b.shape[1:])
+                for b in batch)
+            keys = jax.random.split(key, accum)
+
+            def body(carry, xs):
+                aux_c, racc, lacc = carry
+                key_i, mb = xs
+                (l, new_aux_c), g = jax.value_and_grad(
+                    loss_of, has_aux=True)(tr, aux_c, key_i, mb)
+                red, _ = _reduce_shards(g, None)
+                racc = {k: a + red[k].astype(a.dtype)
+                        for k, a in racc.items()}
+                return (new_aux_c, racc, lacc + l), None
+
+            r0 = {_skey(gi, j): jnp.zeros((g.padded[j] // ndp,),
+                                          jnp.float32)
+                  for gi, g in enumerate(grp_list)
+                  for j in range(len(g.plans))}
+            (new_aux, rsum, lsum), _ = lax.scan(
+                body, (aux, r0, jnp.float32(0.0)), (keys, micro))
+            return (lsum / accum, new_aux,
+                    {k: v / accum for k, v in rsum.items()})
+
+        def step(tr, aux, states, hyper, key, resid, *batch):
+            # distinct dropout keys per dp shard
+            key = jax.random.fold_in(key, lax.axis_index(dp))
+            rank = lax.axis_index(dp)
+            if z3:
+                # transient gather: full-size weights exist only inside
+                # the executable (XLA frees each bucket's gather after
+                # its last use); the resident weights are the shards
+                wsh = tr
+                tr = {}
+                for gi, g in enumerate(grp_list):
+                    fulls = [lax.all_gather(wsh[_sk3(gi, j)], dp,
+                                            axis=0, tiled=True)
+                             for j in range(len(g.plans))]
+                    for n, w in zip(g.names, _mt.unflatten_buckets(
+                            fulls, g.plans, len(g.names))):
+                        tr[n] = w
+            if shard_carry:
+                loss, new_aux, red = sharded_accum_grads(
+                    tr, aux, key, batch)
+                new_resid = {}
+            else:
+                loss, new_aux, grads = local_grads(tr, aux, key, batch)
+                red, new_resid = _reduce_shards(grads, resid)
+            loss = lax.pmean(loss, dp)
+            new_aux = {n: lax.pmean(v, dp)
+                       if jnp.issubdtype(v.dtype, jnp.inexact)
+                       else lax.pmax(v, dp) for n, v in new_aux.items()}
+            new_tr, new_states = {}, {}
+            for gi, g in enumerate(grp_list):
+                if not z3:
+                    w_bks = _mt.pad_buckets(_mt.flatten_buckets(
+                        [tr[n] for n in g.names], g.plans),
+                        g.plans, g.padded)
+                full = []
+                for j in range(len(g.plans)):
+                    sk = _skey(gi, j)
+                    ssz = g.padded[j] // ndp
+                    if z3:
+                        # the shard_map local view IS this rank's slice
+                        w_sh = wsh[_sk3(gi, j)]
+                    else:
+                        w_sh = lax.dynamic_slice(
+                            w_bks[j], (rank * ssz,), (ssz,))
                     seg = lax.dynamic_slice(g.segs[j], (rank * ssz,),
                                             (ssz,))
                     nw, nst = _mt.zero1_update_shard(
-                        opt, w_sh, red, states[sk], hyper, seg,
+                        opt, w_sh, red[sk], states[sk], hyper, seg,
                         len(g.names) + 1, dp)
                     new_states[sk] = nst
-                    full.append(lax.all_gather(nw, dp, axis=0,
-                                               tiled=True))
-                for n, w in zip(g.names, _mt.unflatten_buckets(
-                        full, g.plans, len(g.names))):
-                    new_tr[n] = w
+                    if z3:
+                        # the update's output IS the new resident
+                        # shard — updated weights never all-gather
+                        new_tr[_sk3(gi, j)] = nw
+                    else:
+                        full.append(lax.all_gather(nw, dp, axis=0,
+                                                   tiled=True))
+                if not z3:
+                    for n, w in zip(g.names, _mt.unflatten_buckets(
+                            full, g.plans, len(g.names))):
+                        new_tr[n] = w
             out = (loss, new_tr, new_aux, new_states)
             return out + ((new_resid,) if scheme is not None else ())
 
@@ -629,8 +779,11 @@ class FusedTrainStep:
             _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp)
             for a in args)
         st_spec = {k: P(dp) for k in state_keys}
-        in_specs = (P(), P(), st_spec, P(), P())
-        out_specs = (P(), P(), P(), st_spec)
+        z3_keys = [_sk3(gi, j) for gi, g in enumerate(grp_list)
+                   for j in range(len(g.plans))]
+        tr_spec = {k: P(dp) for k in z3_keys} if z3 else P()
+        in_specs = (tr_spec, P(), st_spec, P(), P())
+        out_specs = (P(), tr_spec, P(), st_spec)
         if scheme is not None:
             in_specs = in_specs + (st_spec,)
             out_specs = out_specs + (st_spec,)
@@ -652,8 +805,21 @@ class FusedTrainStep:
             donate = (0, 2)
         self._compiled = jax.jit(
             fn, donate_argnums=donate if self.donate else ())
-        self._tr = {n: _global_put(v, repl)
-                    for n, v in self._tr.items()}
+        if z3:
+            # weights live as 1/N flat bucket shards from here on;
+            # full-size arrays exist only transiently inside the step
+            # (and in sync_to_params gathers)
+            new_tr = {}
+            for gi, g in enumerate(grp_list):
+                w_bks = _mt.pad_buckets(_mt.flatten_buckets(
+                    [self._tr[n] for n in g.names], g.plans),
+                    g.plans, g.padded)
+                for j, b in enumerate(w_bks):
+                    new_tr[_sk3(gi, j)] = _global_put(b, shard)
+            self._tr = new_tr
+        else:
+            self._tr = {n: _global_put(v, repl)
+                        for n, v in self._tr.items()}
         self._aux = {n: _global_put(v, repl)
                      for n, v in self._aux.items()}
         if scheme is not None:
@@ -665,8 +831,10 @@ class FusedTrainStep:
         self._batch_sh = tuple(
             NamedSharding(mesh, spec) for spec in batch_specs)
         # checkpoint restore reads these to re-place restored state;
-        # zero1 state keys are bucket ids, sharded over dp
-        self._tr_sh = {n: repl for n in tr_names}
+        # zero1 state keys (and zero3 weight keys) are bucket ids,
+        # sharded over dp
+        self._tr_sh = ({k: shard for k in z3_keys} if z3
+                       else {n: repl for n in tr_names})
         self._aux_sh = {n: repl for n in aux_names}
         self._st_sh = {k: jax.tree_util.tree_map(lambda _: shard,
                                                  self._states[k])
@@ -674,6 +842,7 @@ class FusedTrainStep:
         self._tr_names = tr_names
         self._aux_names = aux_names
         self._zero1_groups = grp_list
+        self._zero3 = z3
 
     def zero1_state_nbytes(self):
         """(total, per_replica) optimizer-state bytes after _build —
@@ -682,6 +851,32 @@ class FusedTrainStep:
             self._states))
         ndp = self.mesh.shape[self.dp_axis]
         return tot, tot // ndp
+
+    def fused_resident_bytes(self):
+        """Per-replica resident training bytes by category (profiler
+        memory-provider contract). Sharded buffers count global/N;
+        replicated buffers count full size. Grads are transient inside
+        the executable (0 resident); the compression residual, the only
+        grad-shaped state that survives the step, counts as grads."""
+        ndp = self.mesh.shape.get(self.dp_axis, 1) \
+            if self.mesh is not None else 1
+
+        def per_replica(v):
+            sh = getattr(v, "sharding", None)
+            if sh is None or getattr(sh, "is_fully_replicated", True):
+                return v.nbytes
+            return v.nbytes // ndp
+
+        out = {"weights": 0, "grads": 0, "opt_state": 0, "transient": 0}
+        for store, cat in ((self._tr, "weights"), (self._aux, "weights"),
+                           (self._states, "opt_state"),
+                           (self._resid, "grads")):
+            if store is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(store):
+                if hasattr(leaf, "nbytes"):
+                    out[cat] += per_replica(leaf)
+        return out
 
     # -- execution ------------------------------------------------------------
     def __call__(self, *args) -> NDArray:

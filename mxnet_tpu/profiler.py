@@ -13,12 +13,59 @@ from typing import Dict, List, Optional
 import jax
 
 __all__ = ["set_config", "set_state", "scope", "Timer", "dump",
-           "start_device_trace", "stop_device_trace", "summary"]
+           "start_device_trace", "stop_device_trace", "summary",
+           "register_memory_provider", "unregister_memory_provider",
+           "resident_bytes"]
 
 _CONFIG = {"filename": "profile.json", "aggregate_stats": True}
 _STATE = {"running": False}
 _EVENTS: List[dict] = []
 _AGG: Dict[str, List[float]] = {}
+
+# -- resident-bytes accounting (ZeRO memory claims are asserted, not
+# hand-computed): training components (Trainer's multi-tensor updater,
+# FusedTrainStep) register a provider that reports CURRENT per-replica
+# resident bytes by category. Providers return None to drop themselves
+# (the usual pattern is a closure over a weakref to the owner).
+_MEM_PROVIDERS: Dict[str, object] = {}
+
+MEM_CATEGORIES = ("weights", "grads", "opt_state", "transient")
+
+
+def register_memory_provider(name: str, fn):
+    """Register `fn() -> {"weights": int, "grads": int, "opt_state": int,
+    "transient": int} | None` reporting per-replica resident bytes.
+    Returning None unregisters the provider (dead weakref)."""
+    _MEM_PROVIDERS[name] = fn
+
+
+def unregister_memory_provider(name: str):
+    _MEM_PROVIDERS.pop(name, None)
+
+
+def resident_bytes() -> Dict[str, Dict[str, int]]:
+    """Per-provider snapshot of per-replica resident training bytes,
+    plus a cross-provider "total" entry. Sharded buffers count as
+    global_bytes / num_shards; replicated buffers count full size."""
+    out: Dict[str, Dict[str, int]] = {}
+    total = {k: 0 for k in MEM_CATEGORIES}
+    for name in list(_MEM_PROVIDERS):
+        try:
+            rep = _MEM_PROVIDERS[name]()
+        except Exception:
+            rep = None
+        if rep is None:
+            _MEM_PROVIDERS.pop(name, None)
+            continue
+        row = {k: int(rep.get(k, 0)) for k in MEM_CATEGORIES}
+        row["total"] = sum(row.values())
+        out[name] = row
+        for k in MEM_CATEGORIES:
+            total[k] += row[k]
+    total_row = dict(total)
+    total_row["total"] = sum(total.values())
+    out["total"] = total_row
+    return out
 
 
 def set_config(**kwargs):
@@ -84,6 +131,17 @@ def summary() -> str:
     if fb:
         lines.append("kernel fallbacks: " + ", ".join(
             f"{k}={v}" for k, v in sorted(fb.items())))
+    mem = resident_bytes()
+    if len(mem) > 1:  # more than the always-present "total" row
+        lines.append(f"{'resident bytes/replica':<28}"
+                     + "".join(f"{c:>12}" for c in MEM_CATEGORIES)
+                     + f"{'total':>12}")
+        for name, row in sorted(mem.items()):
+            if name == "total" and len(mem) == 2:
+                continue  # single provider: total row is redundant
+            lines.append(f"{name:<28}"
+                         + "".join(f"{row[c]:>12}" for c in MEM_CATEGORIES)
+                         + f"{row['total']:>12}")
     return "\n".join(lines)
 
 
