@@ -26,6 +26,22 @@ from bench import REFERENCE_IMG_PER_SEC, BudgetGuard
 _guard = None
 
 
+def _mirror_to_telemetry(guard, prefix):
+    """Publish the BudgetGuard headline numbers through the telemetry
+    registry and write the full snapshot JSON next to the bench's JSON
+    line (every bench emits through telemetry.dump_json too)."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        telemetry.enable()
+    for k, v in guard.best.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            telemetry.set_gauge(f"bench_{k}", float(v), bench=prefix)
+    path = os.environ.get("BENCH_TELEMETRY_JSON",
+                          f"/tmp/{prefix}_telemetry.json")
+    guard.best["telemetry_json"] = telemetry.dump_json(path)
+    guard.emit()
+
+
 def main():
     global _guard
     _guard = guard = BudgetGuard("dataloader_images_per_sec",
@@ -113,6 +129,15 @@ def main():
         "worker_table": table,
     })
     guard.emit()
+
+    # one instrumented epoch feeds the dataloader telemetry (data-wait
+    # histogram, queue depth, worker wait) before the snapshot dump
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    telemetry.reset()
+    if guard.remaining() > 15.0:
+        one_epoch(workers)
+    _mirror_to_telemetry(guard, "dataloader_bench")
 
 
 if __name__ == "__main__":

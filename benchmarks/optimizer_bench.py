@@ -26,7 +26,27 @@ from bench import BudgetGuard
 #: the PR's acceptance floor: fused path must be >= 3x the loop
 SPEEDUP_FLOOR = 3.0
 
+#: disabled-telemetry overhead ceiling on the fused step (ISSUE 4
+#: acceptance: <= 2% — i.e. ratio <= 1.02)
+TM_OVERHEAD_CEILING = float(os.environ.get("BENCH_TM_CEILING", "1.02"))
+
 _guard = None
+
+
+def _mirror_to_telemetry(guard, prefix):
+    """Publish the BudgetGuard headline numbers through the telemetry
+    registry and write the full snapshot JSON next to the bench's JSON
+    line (every bench emits through telemetry.dump_json too)."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        telemetry.enable()
+    for k, v in guard.best.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            telemetry.set_gauge(f"bench_{k}", float(v), bench=prefix)
+    path = os.environ.get("BENCH_TELEMETRY_JSON",
+                          f"/tmp/{prefix}_telemetry.json")
+    guard.best["telemetry_json"] = telemetry.dump_json(path)
+    guard.emit()
 
 
 def _make_trainer(mx, jnp, shapes, multi_tensor, optimizer="sgd",
@@ -98,6 +118,16 @@ def main():
         "fused_cache_size": results["fused_cache_size"],
     })
     guard.emit()
+
+    # a couple of instrumented steps populate the step-time breakdown
+    # before the snapshot dump (the gauges mirror the headline figures)
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    telemetry.reset()
+    for _ in range(2):
+        tr.step(batch_size=32)
+    mx.nd.waitall()
+    _mirror_to_telemetry(guard, "optimizer_bench")
 
 
 def _fused_step_ms(mx, jax, mesh, zero1, zero=None, batch=256,
@@ -213,6 +243,7 @@ def main_zero1():
             round(results["zero1"] / results["unsharded"], 3),
     })
     guard.emit()
+    _mirror_to_telemetry(guard, "optimizer_bench_zero1")
 
 
 def _eager_zero_run(mx, stage, shapes, steps):
@@ -325,11 +356,124 @@ def main_zero(stage):
         f"zero{stage}_latency_ratio": round(fused_z / fused_base, 3),
     })
     guard.emit()
+    _mirror_to_telemetry(guard, f"optimizer_bench_zero{stage}")
+
+
+#: telemetry's public hot helpers — the ones instrumented call sites
+#: invoke on the fused-step path
+_TM_HOT = ("phase", "mark_phase", "step_done", "inc", "set_gauge",
+           "observe")
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+def main_telemetry_overhead():
+    """`--telemetry-overhead`: cost of DISABLED telemetry on the fused
+    train step. Interleaved A/B rounds over one compiled FusedTrainStep:
+    A runs the instrumented code as shipped (telemetry disabled, so
+    every hot site is one module-flag check and phase() yields
+    immediately); B additionally monkeypatches the public hot helpers
+    to true no-ops — as close to "instrumentation deleted" as a
+    measurement gets without a second build. min-of-rounds cancels
+    scheduler noise. The asserted ceiling (1.02x) is a tripwire: new
+    instrumentation that does dict/string work BEFORE checking _ENABLED
+    fails this bench instead of silently taxing every training step."""
+    global _guard
+    _guard = guard = BudgetGuard("telemetry_disabled_overhead_ratio",
+                                 "x").install()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    telemetry.disable()
+    telemetry.reset()
+
+    batch = int(os.environ.get("BENCH_TM_BATCH", "64"))
+    hidden = int(os.environ.get("BENCH_TM_HIDDEN", "256"))
+    reps = int(os.environ.get("BENCH_TM_REPS", "30"))
+    rounds = int(os.environ.get("BENCH_TM_ROUNDS", "5"))
+
+    rs = np.random.RandomState(3)
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(hidden, activation="relu"))
+    net.add(mx.gluon.nn.Dense(hidden, activation="relu"))
+    net.add(mx.gluon.nn.Dense(16))
+    net.initialize()
+    step = FusedTrainStep(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.Adam(learning_rate=1e-3),
+                          mesh=None)
+    xs = mx.nd.array(rs.rand(batch, 128).astype(np.float32))
+    ys = mx.nd.array(rs.randint(0, 16, batch))
+    for _ in range(5):  # warmup: compile + allocator steady state
+        step(xs, ys)
+    jax.block_until_ready(step._tr)
+
+    def timed():
+        jax.block_until_ready(step._tr)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            step(xs, ys)
+        jax.block_until_ready(step._tr)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    saved = {n: getattr(telemetry, n) for n in _TM_HOT}
+    null = _NullCtx()
+    noops = {
+        "phase": lambda name, device=False: null,
+        "mark_phase": lambda *a, **k: None,
+        "step_done": lambda *a, **k: None,
+        "inc": lambda *a, **k: None,
+        "set_gauge": lambda *a, **k: None,
+        "observe": lambda *a, **k: None,
+    }
+
+    a_ms, b_ms = [], []
+    for _ in range(rounds):
+        if a_ms and guard.remaining() < 15.0:
+            break
+        a_ms.append(timed())  # A: shipped disabled path
+        for name, fn in noops.items():
+            setattr(telemetry, name, fn)
+        try:
+            b_ms.append(timed())  # B: helpers are true no-ops
+        finally:
+            for name, fn in saved.items():
+                setattr(telemetry, name, fn)
+
+    ratio = min(a_ms) / min(b_ms)
+    guard.best.update({
+        "value": round(ratio, 4),
+        # >= 1.0 means "within the ceiling" (lower ratio is better)
+        "vs_baseline": round(TM_OVERHEAD_CEILING / max(ratio, 1e-9), 3),
+        "phase": "done",
+        "reps": reps, "rounds": len(b_ms),
+        "disabled_ms_per_step": round(min(a_ms), 4),
+        "noop_ms_per_step": round(min(b_ms), 4),
+        "overhead_pct": round((ratio - 1.0) * 100.0, 2),
+        "ceiling": TM_OVERHEAD_CEILING,
+    })
+    _mirror_to_telemetry(guard, "telemetry_overhead")
+    assert ratio <= TM_OVERHEAD_CEILING, (
+        f"disabled-telemetry overhead {ratio:.4f}x exceeds the "
+        f"{TM_OVERHEAD_CEILING}x ceiling")
 
 
 if __name__ == "__main__":
     try:
-        if "--zero" in sys.argv:
+        if "--telemetry-overhead" in sys.argv:
+            main_telemetry_overhead()
+        elif "--zero" in sys.argv:
             _stage = int(sys.argv[sys.argv.index("--zero") + 1])
             main_zero1() if _stage == 1 else main_zero(_stage)
         elif "--zero1" in sys.argv:

@@ -40,6 +40,7 @@ from . import callback
 from . import checkpoint
 from . import monitor
 from . import profiler
+from . import telemetry
 from . import tracing
 from . import parallel
 from . import io

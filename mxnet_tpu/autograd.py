@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from . import telemetry as _tm
 
 _STATE = threading.local()
 
@@ -49,9 +52,26 @@ def _mode(recording: Optional[bool], training: Optional[bool]):
         s.recording, s.training = prev
 
 
+@contextlib.contextmanager
 def record(train_mode: bool = True):
-    """with autograd.record(): ops are taped; also flips train mode."""
-    return _mode(True, train_mode)
+    """with autograd.record(): ops are taped; also flips train mode.
+
+    The outermost record() block is the eager forward pass: while
+    telemetry is enabled its wall time resolves into the
+    step_time_breakdown{phase=forward} histogram. Nested records add no
+    extra marks."""
+    if not _tm._ENABLED:
+        with _mode(True, train_mode):
+            yield
+        return
+    outermost = not _state().recording
+    t0 = time.perf_counter()
+    with _mode(True, train_mode):
+        try:
+            yield
+        finally:
+            if outermost:
+                _tm.mark_phase("forward", time.perf_counter() - t0, t0=t0)
 
 
 def pause(train_mode: bool = False):
@@ -166,6 +186,16 @@ def backward(heads, head_grads=None, retain_graph: bool = False):
     (leaves from attach_grad, plus any array grad() gave a temporary
     buffer — including intermediates) according to its grad_req.
     """
+    if not _tm._ENABLED:
+        return _backward_impl(heads, head_grads, retain_graph)
+    t0 = time.perf_counter()
+    try:
+        return _backward_impl(heads, head_grads, retain_graph)
+    finally:
+        _tm.mark_phase("backward", time.perf_counter() - t0, t0=t0)
+
+
+def _backward_impl(heads, head_grads=None, retain_graph: bool = False):
     from .ndarray import NDArray  # late import (cycle)
 
     heads, head_grads = _normalize_heads(heads, head_grads)

@@ -13,6 +13,7 @@ becomes ONE tape node via jax.vjp — the CachedOp-backward analogue.
 from __future__ import annotations
 
 import contextlib
+import time as _time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -347,6 +348,10 @@ class HybridBlock(Block):
         entry = self._jit_cache.get(cache_key)
         fresh = entry is None
         if fresh:
+            # the fresh-call wall time IS the compile cost for this
+            # shape signature: trace + XLA build + first run all happen
+            # inside this call (jit compiles lazily on first execution)
+            t0_compile = _time.perf_counter()
             entry = self._build(tuple(tensor_pos), args, training, params)
             self._jit_cache[cache_key] = entry
 
@@ -364,7 +369,7 @@ class HybridBlock(Block):
             _tracing.record_compile(self.name or type(self).__name__,
                                     entry)
         else:
-            _tracing.record_hit()
+            _tracing.record_hit(self.name or type(self).__name__)
 
         if autograd.is_recording():
             f = lambda tr_, *ins: entry.jit_fn(tr_, aux, rng, *ins)
@@ -410,6 +415,10 @@ class HybridBlock(Block):
         if node is not None:
             node.outputs = outs
             node.out_avals = [_typeof(r) for r in out_flat]
+        if fresh:
+            _tracing.record_compile_seconds(
+                self.name or type(self).__name__,
+                _time.perf_counter() - t0_compile)
         return jax.tree_util.tree_unflatten(entry.out_treedef, outs)
 
     def _build(self, tensor_pos, proto_args, training, params):

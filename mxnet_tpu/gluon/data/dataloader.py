@@ -21,11 +21,13 @@ from __future__ import annotations
 import pickle
 import queue
 import threading
+import time
 import weakref
 from typing import Optional
 
 import numpy as _np
 
+from ... import telemetry as _tm
 from ...ndarray import NDArray, array
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
@@ -195,8 +197,24 @@ class DataLoader:
     def __iter__(self):
         it = self._iter_impl()
         if self._pin:  # double-buffered device feed
-            return iter(DevicePrefetcher(it))
-        return it
+            it = iter(DevicePrefetcher(it))
+        return self._timed_iter(it)
+
+    @staticmethod
+    def _timed_iter(it):
+        """Consumer-facing wrapper: the time the training loop spends
+        blocked in next() — after any prefetch overlap — is the step's
+        true data-wait, recorded as step_time_breakdown{phase=data}."""
+        while True:
+            enabled = _tm._ENABLED
+            t0 = time.perf_counter() if enabled else 0.0
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            if enabled:
+                _tm.mark_phase("data", time.perf_counter() - t0, t0=t0)
+            yield item
 
     # -- process workers (reference: the fork's multiprocessing.Pool) ------
     def _get_pool(self):
@@ -241,7 +259,14 @@ class DataLoader:
                 break
         while window:  # ordered: results yielded in submission order
             res = window.popleft()
-            out = res.get(self._timeout)  # worker errors re-raise here
+            if _tm._ENABLED:
+                _tm.set_gauge("dataloader_queue_depth", len(window) + 1)
+                t0 = time.perf_counter()
+                out = res.get(self._timeout)
+                _tm.observe("dataloader_worker_wait_seconds",
+                            time.perf_counter() - t0)
+            else:
+                out = res.get(self._timeout)  # worker errors re-raise here
             submit()
             yield _tree_to_nd(out)
 
@@ -285,7 +310,15 @@ class DataLoader:
                 break
         while window:
             ev, slot = window.popleft()
-            if not ev.wait(self._timeout):
+            if _tm._ENABLED:
+                _tm.set_gauge("dataloader_queue_depth", len(window) + 1)
+                t0 = time.perf_counter()
+                done = ev.wait(self._timeout)
+                _tm.observe("dataloader_worker_wait_seconds",
+                            time.perf_counter() - t0)
+            else:
+                done = ev.wait(self._timeout)
+            if not done:
                 raise TimeoutError("DataLoader worker timed out")
             item = slot[0]
             if isinstance(item, Exception):

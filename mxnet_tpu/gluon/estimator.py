@@ -20,7 +20,8 @@ from .trainer import Trainer
 __all__ = ["Estimator", "EventHandler", "TrainBegin", "TrainEnd",
            "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
            "StoppingHandler", "MetricHandler", "LoggingHandler",
-           "CheckpointHandler", "EarlyStoppingHandler"]
+           "CheckpointHandler", "EarlyStoppingHandler",
+           "TelemetryHandler"]
 
 
 class EventHandler:
@@ -115,6 +116,38 @@ class LoggingHandler(EventHandler):
             parts.append(f"{name}={val:.4f}"
                          if isinstance(val, float) else f"{name}={val}")
         return " ".join(parts)
+
+
+class TelemetryHandler(EventHandler):
+    """Logs the telemetry step-time breakdown table every `interval`
+    batches (and once at train end). With `enable=True` turns telemetry
+    on at train begin; otherwise it only reports when something else
+    already enabled it — and stays silent while telemetry is disabled."""
+
+    def __init__(self, interval: int = 50, printer=print,
+                 enable: bool = False):
+        self.interval = max(1, int(interval))
+        self._print = printer
+        self._enable = enable
+
+    def train_begin(self, estimator):
+        from .. import telemetry
+        if self._enable:
+            telemetry.enable()
+
+    def batch_end(self, estimator):
+        from .. import telemetry
+        if not telemetry.enabled():
+            return
+        if estimator.global_batch % self.interval == 0:
+            self._print(f"[telemetry @ batch {estimator.global_batch}]\n"
+                        + telemetry.breakdown_table())
+
+    def train_end(self, estimator):
+        from .. import telemetry
+        if telemetry.enabled():
+            self._print("[telemetry: final]\n"
+                        + telemetry.breakdown_table())
 
 
 class CheckpointHandler(EventHandler):

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from .. import multi_tensor as _mt
 from .. import optimizer as opt
+from .. import telemetry as _tm
 from ..kvstore import KVStore, create as kv_create
 from ..ndarray import NDArray
 from ..sparse import RowSparseNDArray
@@ -204,6 +205,8 @@ class Trainer:
         self._init_states()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update()
+        if _tm._ENABLED:
+            _tm.step_done(batch_size)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)
@@ -236,12 +239,14 @@ class Trainer:
                 grad = self._row_sparse_grad(p)
             if on_kv:
                 # optimizer runs on the store; pull refreshed weights back
-                self._kvstore.push(i, grad)
-                self._kvstore.pull(i, out=p.data())
+                with _tm.phase("grad_comm"):
+                    self._kvstore.push(i, grad)
+                    self._kvstore.pull(i, out=p.data())
             else:
                 if self._kvstore is not None:
                     # sync-only store: allreduce grads, update locally
-                    self._kvstore.pushpull(i, grad, out=grad)
+                    with _tm.phase("grad_comm"):
+                        self._kvstore.pushpull(i, grad, out=grad)
                 if i not in self._states:
                     # zero1 skipped this param's full-size state at
                     # init expecting it on the fused path; it fell back
@@ -249,8 +254,9 @@ class Trainer:
                     self._states[i] = \
                         self._optimizer.create_state_multi_precision(
                             i, p.data())
-                self._states[i] = self._optimizer.update(
-                    i, p.data(), grad, self._states[i])
+                with _tm.phase("optimizer"):
+                    self._states[i] = self._optimizer.update(
+                        i, p.data(), grad, self._states[i])
 
     # -- io -----------------------------------------------------------------
     def save_states(self, fname):

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _tm
 from .ndarray import NDArray
 from .sparse import RowSparseNDArray
 
@@ -40,6 +41,51 @@ class KVStore:
         self._warned_once.add(key)
         import warnings
         warnings.warn(msg, stacklevel=3)
+
+    # -- telemetry byte accounting -----------------------------------------
+    def _nbytes(self, value) -> int:
+        if isinstance(value, list):
+            return sum(self._nbytes(v) for v in value)
+        if isinstance(value, RowSparseNDArray):
+            return (int(value.indices._data.nbytes)
+                    + int(value.data._data.nbytes))
+        data = value._data if isinstance(value, NDArray) else value
+        return int(getattr(data, "nbytes", 0))
+
+    def _wire_nbytes(self, value, compressed: bool) -> int:
+        """Bytes the payload occupies ON the wire: with 2-bit/int8
+        gradient compression the quantized representation travels, so
+        wire = ceil(n_elem * bits / 8); sparse values and uncompressed
+        directions move at their logical size."""
+        if not compressed:
+            return self._nbytes(value)
+        if isinstance(value, list):
+            return sum(self._wire_nbytes(v, compressed) for v in value)
+        if isinstance(value, RowSparseNDArray):
+            return self._nbytes(value)  # sparse path is never quantized
+        data = value._data if isinstance(value, NDArray) else value
+        n = int(getattr(data, "size", 0))
+        bits = 2 if self._compression.get("type", "2bit") == "2bit" else 8
+        return (n * bits + 7) // 8
+
+    def _count_bytes(self, op: str, value):
+        """Feed the `comm_bytes_{pushed,reduced,gathered}` telemetry
+        counter families (labels: store type, kind=logical|wire). Only
+        the base data-plane primitives call this — bucket helpers
+        delegate to pushpull and are counted there, so nothing is
+        double-counted. Compression applies to the gradient direction
+        (pushed/reduced); weight pulls travel uncompressed."""
+        if not _tm._ENABLED:
+            return
+        logical = self._nbytes(value)
+        compressed = (self._compression is not None
+                      and op in ("pushed", "reduced"))
+        wire = self._wire_nbytes(value, compressed)
+        fam = _tm.counter(
+            f"comm_bytes_{op}",
+            "bytes moved by kvstore collectives (logical vs wire)")
+        fam.labels(store=self.type, kind="logical").inc(logical)
+        fam.labels(store=self.type, kind="wire").inc(wire)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -120,6 +166,7 @@ class KVStore:
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        self._count_bytes("pushed", value)
         agg = self._aggregate(value, key)
         self._apply_aggregate(key, agg)
 
@@ -143,6 +190,8 @@ class KVStore:
             return
         src = self._store[key]
         outs = out if isinstance(out, list) else [out]
+        if _tm._ENABLED:
+            self._count_bytes("gathered", [src] * len(outs))
         for o in outs:
             o._data = jax.device_put(src._data, o.ctx.jax_device) \
                 if o.ctx != src.ctx else src._data
@@ -155,6 +204,7 @@ class KVStore:
                 self.pushpull(k, value[i],
                               out[i] if out is not None else None, priority)
             return
+        self._count_bytes("reduced", value)
         agg = self._aggregate(value, key)
         if self._optimizer is not None:
             # agg is already aggregated+compressed: applying it via
@@ -246,6 +296,7 @@ class KVStore:
         sharded executable's output layout IS the gathered bucket), so
         this is the identity; a multi-process store must override with a
         real all-gather."""
+        self._count_bytes("gathered", buckets)
         return buckets
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
@@ -332,6 +383,7 @@ class AsyncKVStore(KVStore):
         if self._optimizer is None or not isinstance(value, list):
             super().push(key, value, priority)
             return
+        self._count_bytes("pushed", value)
         for i, v in enumerate(value):
             # one stale update per replica, no aggregation
             if self._compression is not None:
@@ -382,6 +434,7 @@ class DistPSKVStore(KVStore):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        self._count_bytes("pushed", value)
         agg = self._aggregate(value, key)  # local replica sum (+comp.)
         self._client.push(key, _np_of(agg))
 
@@ -394,6 +447,9 @@ class DistPSKVStore(KVStore):
         arr = jnp.asarray(val)
         self._store[key] = NDArray(arr)
         outs = out if isinstance(out, list) else [out]
+        if _tm._ENABLED:
+            self._count_bytes(
+                "gathered", [NDArray(arr)] * max(1, len(outs)))
         for o in outs:
             if o is not None:
                 o._data = jax.device_put(arr, o.ctx.jax_device)

@@ -43,6 +43,7 @@ class Monitor:
         self._step = 0
         self._active = False
         self._records: List[Tuple[str, float]] = []
+        self._block = None
 
     # -- gluon hook installation -------------------------------------------
     def install(self, block):
@@ -70,6 +71,7 @@ class Monitor:
             for cname, child in blk._children.items():
                 walk(child, f"{prefix}.{cname}" if prefix else cname)
         walk(block, "")
+        self._block = block  # toc() walks params for weight/grad stats
         return self
 
     def tic(self):
@@ -83,9 +85,40 @@ class Monitor:
             return []
         self._active = False
         recs = list(self._records)
+        recs.extend(self._param_stats())
         if self.sort:
             recs.sort(key=lambda kv: kv[0])
         return recs
+
+    def _param_stats(self) -> List[Tuple[str, float]]:
+        """Weight and gradient stats for pattern-matched parameters
+        (reference Monitor records aux/arg arrays + grads, not just
+        executor outputs)."""
+        if self._block is None:
+            return []
+        out: List[Tuple[str, float]] = []
+        try:
+            params = self._block.collect_params()
+        except Exception:
+            return []
+        for name, p in params.items():
+            if not self.pattern.match(name):
+                continue
+            try:
+                out.append((f"{name}_weight",
+                            self.stat_func(p.data().asnumpy())))
+            except Exception:
+                pass  # deferred / released params have no host value
+            if p.grad_req == "null":
+                continue
+            try:
+                g = p.grad()
+                if g is not None and g._data.size:
+                    out.append((f"{name}_grad",
+                                self.stat_func(g.asnumpy())))
+            except Exception:
+                pass
+        return out
 
     def toc_print(self):
         for name, stat in self.toc():

@@ -31,6 +31,7 @@ identical to the loop it replaces.
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Dict, List, Sequence, Tuple
 
@@ -38,6 +39,8 @@ import numpy as _np
 
 import jax
 import jax.numpy as jnp
+
+from . import telemetry as _tm
 
 __all__ = ["MultiTensorUpdater", "plan_buckets", "flatten_buckets",
            "unflatten_buckets", "DEFAULT_BUCKET_BYTES",
@@ -406,17 +409,20 @@ class MultiTensorUpdater:
 
         if bucketed:
             buckets = exe.flatten_fn(gs)
-            gs = self._sync_buckets(kvstore, gid, buckets)
+            with _tm.phase("grad_comm"):
+                gs = self._sync_buckets(kvstore, gid, buckets)
 
         if mp:
-            new_ws, new_states, low_ws = exe.update_fn(
-                states_in, ws, gs, lrs, wds, ts, rescale)
+            with _tm.phase("optimizer"):
+                new_ws, new_states, low_ws = exe.update_fn(
+                    states_in, ws, gs, lrs, wds, ts, rescale)
             for k, (i, p, _) in enumerate(members):
                 p.data()._data = low_ws[k]
                 states[i] = (new_ws[k], new_states[k])
         else:
-            new_ws, new_states = exe.update_fn(
-                states_in, ws, gs, lrs, wds, ts, rescale)
+            with _tm.phase("optimizer"):
+                new_ws, new_states = exe.update_fn(
+                    states_in, ws, gs, lrs, wds, ts, rescale)
             for k, (i, p, _) in enumerate(members):
                 p.data()._data = new_ws[k]
                 states[i] = new_states[k]
@@ -544,22 +550,24 @@ class MultiTensorUpdater:
             g_bks = self._collect_grad_shards(zg, gid, kvstore)
         else:
             gs = [p.grad()._data for (_, p, _) in members]
-            if kvstore is not None:
-                buckets = self._reduce_scatter(kvstore, gid,
-                                               zg.flatten_fn(gs))
-                pads = zg.pad_fn(buckets)
-            else:
-                pads = zg.flatpad_fn(gs)
-            # THE scatter: pad on the source device, then place each
-            # grad bucket P(z1) so every replica receives exactly its
-            # 1/N slice (params/grads may be committed to a single
-            # device — explicit device_put is the one legal path onto
-            # the update mesh)
-            g_bks = jax.device_put(pads, [zg.shard] * len(pads))
+            with _tm.phase("grad_comm"):
+                if kvstore is not None:
+                    buckets = self._reduce_scatter(kvstore, gid,
+                                                   zg.flatten_fn(gs))
+                    pads = zg.pad_fn(buckets)
+                else:
+                    pads = zg.flatpad_fn(gs)
+                # THE scatter: pad on the source device, then place each
+                # grad bucket P(z1) so every replica receives exactly its
+                # 1/N slice (params/grads may be committed to a single
+                # device — explicit device_put is the one legal path onto
+                # the update mesh)
+                g_bks = jax.device_put(pads, [zg.shard] * len(pads))
         if mp:
-            zg.states, zg.masters, w_bks = zg.update_fn(
-                zg.states, zg.masters, g_bks, zg.segs,
-                lrs, wds, ts, rescale, extras)
+            with _tm.phase("optimizer"):
+                zg.states, zg.masters, w_bks = zg.update_fn(
+                    zg.states, zg.masters, g_bks, zg.segs,
+                    lrs, wds, ts, rescale, extras)
         else:
             if self._weights_clean(zg):
                 # weights unchanged since our last write-back (or still
@@ -570,9 +578,10 @@ class MultiTensorUpdater:
                 ws = [p.data()._data for (_, p, _) in members]
                 w_in = jax.device_put(zg.wpad_fn(ws),
                                       [zg.shard] * len(zg.padded))
-            zg.states, w_bks = zg.update_fn(
-                zg.states, w_in, g_bks, zg.segs, lrs, wds, ts, rescale,
-                extras)
+            with _tm.phase("optimizer"):
+                zg.states, w_bks = zg.update_fn(
+                    zg.states, w_in, g_bks, zg.segs, lrs, wds, ts,
+                    rescale, extras)
         # resident sharded weights: stage 3's authoritative copy (the
         # low-precision one under mp); stage <= 2 keeps them only on the
         # non-mp path as a re-upload-skipping optimization
@@ -588,10 +597,11 @@ class MultiTensorUpdater:
         # land committed there, which matches where eager NDArray data
         # already lives; explicit device_put remains the path back onto
         # any mesh.
-        new_ws = zg.unflatten_fn(jax.device_put(
-            w_bks, [zg.home] * len(w_bks)))
-        for k, (i, p, _) in enumerate(members):
-            p.data()._data = new_ws[k]
+        with _tm.phase("weight_gather"):
+            new_ws = zg.unflatten_fn(jax.device_put(
+                w_bks, [zg.home] * len(w_bks)))
+            for k, (i, p, _) in enumerate(members):
+                p.data()._data = new_ws[k]
         zg.wrote = list(new_ws)
 
     def _weights_clean(self, zg) -> bool:
@@ -717,6 +727,7 @@ class MultiTensorUpdater:
                     g = jnp.zeros(shape, zg.gdtype)
             leaves.append(g)
         buf.clear()
+        t0 = time.perf_counter() if _tm._ENABLED else 0.0
         kv = self._hook_kvstore
         if kv is not None and kv.supports_flat_pushpull():
             # same __flat__/{gid}/{j} key as the allreduce path: the
@@ -728,6 +739,8 @@ class MultiTensorUpdater:
         else:
             flat = zg.flatpad1_fns[j](leaves)
         shard_flat = jax.device_put(flat, zg.shard)
+        if _tm._ENABLED:
+            _tm.mark_phase("grad_comm", time.perf_counter() - t0, t0=t0)
         if zg.gfresh[j] and zg.baccum[j] and zg.gshards[j] is not None:
             # grad_accum: accumulate IN THE SHARD — the full-size sum
             # never exists (slice-then-add == add-then-slice, elementwise

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
 from .. import random as _random
+from .. import telemetry as _tm
 from ..ndarray import NDArray
 from .mesh import current_mesh, use_mesh
 
@@ -895,9 +896,17 @@ class FusedTrainStep:
         key = _random.next_key()
         raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
                for a in args]
-        if self.mesh is not None:
-            raw = [_global_put(r, sh)
-                   for r, sh in zip(raw, self._batch_sh)]
+        with _tm.phase("data"):
+            if self.mesh is not None:
+                raw = [_global_put(r, sh)
+                       for r, sh in zip(raw, self._batch_sh)]
+        # one executable = fwd + bwd + grad psum + optimizer: the
+        # internal phases are fused away by XLA, so telemetry records
+        # the synced whole-step device span (pid 1 in the chrome trace)
+        timed = _tm._ENABLED
+        if timed:
+            import time as _time
+            t0 = _time.perf_counter()
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):
             if self._resid is not None:
@@ -908,4 +917,15 @@ class FusedTrainStep:
             else:
                 loss, self._tr, self._aux, self._states = self._compiled(
                     self._tr, self._aux, self._states, hyper, key, *raw)
+        if timed:
+            jax.block_until_ready(loss)
+            dt = _time.perf_counter() - t0
+            _tm.mark_phase("fused_step", dt, t0=t0, device=True)
+            # host-side view of the same span: the eager phases land on
+            # pid 0, so the fused step needs a host event there too for
+            # a complete per-step host timeline
+            _tm.mark_phase("fused_step_host", dt, t0=t0)
+            nb = raw[0].shape[0] if raw and getattr(
+                raw[0], "ndim", 0) else None
+            _tm.step_done(nb)
         return NDArray(loss)

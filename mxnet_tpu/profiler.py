@@ -12,6 +12,8 @@ from typing import Dict, List, Optional
 
 import jax
 
+from . import telemetry as _tm
+
 __all__ = ["set_config", "set_state", "scope", "Timer", "dump",
            "start_device_trace", "stop_device_trace", "summary",
            "register_memory_provider", "unregister_memory_provider",
@@ -92,6 +94,8 @@ def scope(name: str, sync: bool = False):
     _EVENTS.append({"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dt,
                     "pid": 0, "tid": 0})
     _AGG.setdefault(name, []).append(dt)
+    if _tm._ENABLED:
+        _tm.observe("profiler_scope_seconds", dt / 1e6, scope=name)
 
 
 class Timer:
@@ -108,6 +112,7 @@ class Timer:
 
 
 def start_device_trace(logdir="/tmp/jax-trace"):
+    _tm.note_device_trace(logdir)  # export_chrome_trace merges it later
     jax.profiler.start_trace(logdir)
 
 
@@ -116,8 +121,26 @@ def stop_device_trace():
 
 
 def dump(finished=True):
+    """Write the chrome-trace JSON to _CONFIG["filename"].
+
+    Honors the config + its own argument (reference semantics):
+    `aggregate_stats` (set_config) adds the per-scope aggregate table
+    and the resident-bytes snapshot to the dumped JSON; `finished=True`
+    stops the profiling session, `finished=False` leaves it running for
+    further dumps. Collected events/aggregates stay readable either way
+    (summary()/dumps()); `dumps(reset=True)` clears them."""
+    payload: dict = {"traceEvents": list(_EVENTS)}
+    if _CONFIG.get("aggregate_stats"):
+        payload["aggregateStats"] = {
+            name: {"calls": len(durs),
+                   "mean_us": sum(durs) / len(durs),
+                   "total_us": sum(durs)}
+            for name, durs in sorted(_AGG.items())}
+        payload["residentBytes"] = resident_bytes()
     with open(_CONFIG["filename"], "w") as f:
-        json.dump({"traceEvents": _EVENTS}, f)
+        json.dump(payload, f)
+    if finished:
+        set_state("stop")
     return _CONFIG["filename"]
 
 

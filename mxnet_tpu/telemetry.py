@@ -1,0 +1,558 @@
+"""Unified training telemetry: one process-wide metrics runtime for the
+whole stack (SURVEY observability; MLPerf TPU-pod scaling,
+arXiv:1909.09756, shows the step-time breakdown — input pipeline vs
+compute vs collective — is the prerequisite for every scaling decision;
+EQuARX, arXiv:2506.17615, motivates first-class wire-byte accounting
+once compressed collectives exist).
+
+Before this module, `profiler.py` (host scopes + resident bytes),
+`tracing.py` (compile-cache stats), `monitor.py` (tensor stats) and
+`kernels/dispatch.py` (fallback counts) were four disconnected islands
+and nothing instrumented the Trainer/KVStore/DataLoader hot paths. Now
+they all publish into ONE registry:
+
+- `Counter` / `Gauge` / `Histogram` metric families with Prometheus
+  label semantics. Histograms use fixed log2 buckets (power-of-two
+  upper bounds) with p50/p95/p99 read-out — O(1) memory per family,
+  no reservoir.
+- Phase marks: `with telemetry.phase("forward"): ...` resolves into the
+  `step_time_breakdown` histogram family (labels: phase = data /
+  forward / backward / grad_comm / optimizer / weight_gather) plus a
+  chrome-trace host event. Trainer.step, FusedTrainStep, autograd,
+  KVStore, the DataLoader and the multi-tensor updater all mark their
+  phases; `step_done(samples)` feeds a rolling `samples_per_sec`
+  speedometer.
+- `snapshot()` merges the registry with the pull-based providers:
+  `profiler.resident_bytes()`, `kernels.dispatch.fallback_counts()`,
+  and `tracing.cache_stats()` (compile counts + seconds, per block).
+- Exposition: `to_prometheus()` (text format), `dump_json(path)`,
+  `breakdown_table()` (human table), and `export_chrome_trace(path)` —
+  one chrome://tracing-loadable JSON merging host phase events, host
+  profiler scopes, and any `jax.profiler` device-trace session that
+  `profiler.start_device_trace` registered.
+
+Cost contract: the WHOLE layer is disabled by default and near-zero
+cost while disabled — every instrumented hot path checks the single
+module-level `_ENABLED` flag before doing any dict or string work
+(benchmarks/optimizer_bench.py --telemetry-overhead asserts <= 2%).
+Enable with `telemetry.enable()` or MXNET_TPU_TELEMETRY=1.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["enable", "disable", "enabled", "reset",
+           "Counter", "Gauge", "Histogram",
+           "counter", "gauge", "histogram",
+           "inc", "set_gauge", "observe",
+           "phase", "mark_phase", "step_done",
+           "snapshot", "to_prometheus", "dump_json", "breakdown_table",
+           "export_chrome_trace", "note_device_trace",
+           "STEP_PHASES"]
+
+#: THE flag. Instrumented call sites across the stack guard with
+#: `if telemetry._ENABLED:` (one module-attribute load + branch) so the
+#: disabled path never touches the registry, builds a label tuple, or
+#: formats a string.
+_ENABLED = os.environ.get("MXNET_TPU_TELEMETRY", "0") == "1"
+
+#: canonical per-step timeline phases (step_time_breakdown labels)
+STEP_PHASES = ("data", "forward", "backward", "grad_comm", "optimizer",
+               "weight_gather")
+
+_lock = threading.RLock()
+_REGISTRY: "OrderedDict[str, _Family]" = OrderedDict()
+
+#: chrome-trace host events ("X" spans); bounded so a long run cannot
+#: grow without limit — oldest events drop first
+_TRACE_CAP = 200_000
+_TRACE_EVENTS: deque = deque(maxlen=_TRACE_CAP)
+
+#: jax.profiler device-trace logdirs registered by
+#: profiler.start_device_trace (merged by export_chrome_trace)
+_DEVICE_TRACE_DIRS: List[str] = []
+
+#: rolling speedometer window: (perf_counter at step end, samples)
+_SPEED_WINDOW: deque = deque(maxlen=64)
+
+#: chrome pid layout: host phases / profiler scopes on pid 0, device
+#: spans (sync-measured or parsed jax traces) on pid >= 1
+HOST_PID = 0
+DEVICE_PID = 1
+
+
+def enable():
+    """Turn telemetry on for this process."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def reset():
+    """Clear every metric, trace event, and the speedometer window.
+    Keeps the enabled/disabled state and registered device-trace dirs."""
+    with _lock:
+        _REGISTRY.clear()
+        _TRACE_EVENTS.clear()
+        _SPEED_WINDOW.clear()
+
+
+# -- metric model -----------------------------------------------------------
+
+def _label_key(labels: dict) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _label_suffix(key: Tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+
+
+class _Child:
+    __slots__ = ("label_key",)
+
+    def __init__(self, label_key: Tuple):
+        self.label_key = label_key
+
+
+class Counter(_Child):
+    """Monotonically increasing value (one label set of a family)."""
+    __slots__ = ("value",)
+
+    def __init__(self, label_key=()):
+        super().__init__(label_key)
+        self.value = 0.0
+
+    def inc(self, value=1.0):
+        if value < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        self.value += value
+
+
+class Gauge(_Child):
+    """Last-write-wins value (one label set of a family)."""
+    __slots__ = ("value",)
+
+    def __init__(self, label_key=()):
+        super().__init__(label_key)
+        self.value = 0.0
+
+    def set(self, value):
+        self.value = float(value)
+
+    def inc(self, value=1.0):
+        self.value += value
+
+    def dec(self, value=1.0):
+        self.value -= value
+
+
+#: log2 bucket exponent clamp: 2^-30 (~1ns in seconds, ~1 byte) up to
+#: 2^50 (~1 PB, ~13 days) covers every quantity we record
+_EXP_MIN, _EXP_MAX = -30, 50
+
+
+class Histogram(_Child):
+    """Fixed log2-bucket histogram: bucket e counts observations in
+    (2^(e-1), 2^e]. O(#occupied buckets) memory, exact count/sum/min/
+    max, and percentile read-out by geometric interpolation inside the
+    hit bucket (clamped to the observed min/max)."""
+    __slots__ = ("buckets", "count", "sum", "min", "max", "zeros")
+
+    def __init__(self, label_key=()):
+        super().__init__(label_key)
+        self.buckets: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.zeros = 0  # observations <= 0 (no log2 bucket)
+
+    def observe(self, value):
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self.zeros += 1
+            return
+        # frexp: v = m * 2^e with m in [0.5, 1) -> v in (2^(e-1), 2^e]
+        m, e = math.frexp(v)
+        if m == 0.5:  # exact power of two belongs to the lower bucket
+            e -= 1
+        e = min(max(e, _EXP_MIN), _EXP_MAX)
+        self.buckets[e] = self.buckets.get(e, 0) + 1
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]; geometric interpolation within the log2 bucket
+        that contains the q-th observation."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = self.zeros
+        if target <= seen:
+            return max(0.0, self.min)
+        for e in sorted(self.buckets):
+            n = self.buckets[e]
+            if seen + n >= target:
+                lo, hi = 2.0 ** (e - 1), 2.0 ** e
+                frac = (target - seen) / n
+                val = lo * (hi / lo) ** frac
+                return min(max(val, self.min), self.max)
+            seen += n
+        return self.max
+
+    def stats(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0}
+        return {"count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "mean": self.sum / self.count,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99)}
+
+
+class _Family:
+    """One named metric family holding children per label set."""
+    __slots__ = ("name", "kind", "help", "child_cls", "children")
+
+    def __init__(self, name: str, kind: str, child_cls, help: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.child_cls = child_cls
+        self.children: "OrderedDict[Tuple, _Child]" = OrderedDict()
+
+    def labels(self, **labels):
+        key = _label_key(labels)
+        ch = self.children.get(key)
+        if ch is None:
+            with _lock:
+                ch = self.children.get(key)
+                if ch is None:
+                    ch = self.child_cls(key)
+                    self.children[key] = ch
+        return ch
+
+
+def _family(name: str, kind: str, child_cls, help: str = "") -> _Family:
+    fam = _REGISTRY.get(name)
+    if fam is None:
+        with _lock:
+            fam = _REGISTRY.get(name)
+            if fam is None:
+                fam = _Family(name, kind, child_cls, help)
+                _REGISTRY[name] = fam
+    if fam.kind != kind:
+        raise TypeError(f"metric {name!r} already registered as "
+                        f"{fam.kind}, not {kind}")
+    return fam
+
+
+def counter(name: str, help: str = "") -> _Family:
+    """Get-or-create a counter family; use .labels(**kv).inc(v)."""
+    return _family(name, "counter", Counter, help)
+
+
+def gauge(name: str, help: str = "") -> _Family:
+    return _family(name, "gauge", Gauge, help)
+
+
+def histogram(name: str, help: str = "") -> _Family:
+    return _family(name, "histogram", Histogram, help)
+
+
+# -- fast-path helpers (each one checks _ENABLED first) ---------------------
+
+def inc(name: str, value=1.0, **labels):
+    if not _ENABLED:
+        return
+    counter(name).labels(**labels).inc(value)
+
+
+def set_gauge(name: str, value, **labels):
+    if not _ENABLED:
+        return
+    gauge(name).labels(**labels).set(value)
+
+
+def observe(name: str, value, **labels):
+    if not _ENABLED:
+        return
+    histogram(name).labels(**labels).observe(value)
+
+
+# -- per-step timeline ------------------------------------------------------
+
+def mark_phase(name: str, seconds: float, t0: Optional[float] = None,
+               device: bool = False):
+    """Record one resolved phase span: observes the
+    `step_time_breakdown{phase=name}` histogram (seconds) and appends a
+    chrome-trace event (host pid, or the device pid for spans measured
+    with a device sync)."""
+    if not _ENABLED:
+        return
+    histogram("step_time_breakdown").labels(phase=name).observe(seconds)
+    start = t0 if t0 is not None else time.perf_counter() - seconds
+    _TRACE_EVENTS.append({
+        "name": name, "ph": "X", "ts": start * 1e6,
+        "dur": seconds * 1e6,
+        "pid": DEVICE_PID if device else HOST_PID,
+        "tid": threading.get_ident() % 1_000_000})
+
+
+@contextlib.contextmanager
+def phase(name: str, device: bool = False):
+    """Lightweight phase mark: times the body and resolves it into the
+    step_time_breakdown histogram family + a chrome host event. No-op
+    (and no timestamping) while telemetry is disabled."""
+    if not _ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        mark_phase(name, time.perf_counter() - t0, t0=t0, device=device)
+
+
+def step_done(samples: Optional[int] = None):
+    """Mark one optimizer step complete. Feeds `steps_total` and — when
+    `samples` (the global batch size) is given — the rolling
+    `samples_per_sec` speedometer gauge (window of the last 64 steps)."""
+    if not _ENABLED:
+        return
+    now = time.perf_counter()
+    inc("steps_total")
+    if samples:
+        _SPEED_WINDOW.append((now, int(samples)))
+        if len(_SPEED_WINDOW) >= 2:
+            t_first = _SPEED_WINDOW[0][0]
+            dt = now - t_first
+            if dt > 0:
+                # samples of every step but the window anchor (its
+                # duration lies before the window)
+                n = sum(s for _, s in list(_SPEED_WINDOW)[1:])
+                set_gauge("samples_per_sec", n / dt)
+
+
+# -- snapshot / exposition --------------------------------------------------
+
+def snapshot() -> dict:
+    """One dict of everything: the metric registry plus the pull-based
+    providers (profiler resident bytes, kernel fallback counts, compile
+    cache stats) and the derived step-time breakdown. Empty dict while
+    disabled — the disabled path records nothing, so there is nothing
+    to report."""
+    if not _ENABLED:
+        return {}
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    with _lock:
+        for fam in _REGISTRY.values():
+            for key, ch in fam.children.items():
+                label = fam.name + _label_suffix(key)
+                if fam.kind == "counter":
+                    out["counters"][label] = ch.value
+                elif fam.kind == "gauge":
+                    out["gauges"][label] = ch.value
+                else:
+                    out["histograms"][label] = ch.stats()
+        breakdown = {}
+        fam = _REGISTRY.get("step_time_breakdown")
+        if fam is not None:
+            for key, ch in fam.children.items():
+                labels = dict(key)
+                breakdown[labels.get("phase", "?")] = ch.stats()
+    out["step_time_breakdown"] = breakdown
+    sps = _REGISTRY.get("samples_per_sec")
+    out["samples_per_sec"] = (
+        sps.labels().value if sps is not None else 0.0)
+    # pull-based providers — late imports keep this module import-clean
+    try:
+        from .kernels.dispatch import fallback_counts
+        out["kernel_fallbacks"] = fallback_counts()
+    except Exception:
+        out["kernel_fallbacks"] = {}
+    try:
+        from . import profiler as _prof
+        out["resident_bytes"] = _prof.resident_bytes()
+    except Exception:
+        out["resident_bytes"] = {}
+    try:
+        from . import tracing as _tracing
+        out["compile"] = _tracing.cache_stats()
+    except Exception:
+        out["compile"] = {}
+    return out
+
+
+def to_prometheus() -> str:
+    """Prometheus text exposition of the registry (counters/gauges as
+    `name{labels} value`; histograms as `_count`/`_sum` plus log2
+    `_bucket{le=...}` cumulative series). Empty string while disabled."""
+    if not _ENABLED:
+        return ""
+    lines: List[str] = []
+    with _lock:
+        for fam in _REGISTRY.values():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, ch in fam.children.items():
+                if fam.kind in ("counter", "gauge"):
+                    lines.append(
+                        f"{fam.name}{_label_suffix(key)} {ch.value:g}")
+                    continue
+                base = dict(key)
+                cum = ch.zeros
+                for e in sorted(ch.buckets):
+                    cum += ch.buckets[e]
+                    le = dict(base, le=f"{2.0 ** e:g}")
+                    lines.append(
+                        f"{fam.name}_bucket{_label_suffix(_label_key(le))}"
+                        f" {cum}")
+                le = dict(base, le="+Inf")
+                lines.append(
+                    f"{fam.name}_bucket{_label_suffix(_label_key(le))}"
+                    f" {ch.count}")
+                sfx = _label_suffix(key)
+                lines.append(f"{fam.name}_sum{sfx} {ch.sum:g}")
+                lines.append(f"{fam.name}_count{sfx} {ch.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def dump_json(path: Optional[str] = None) -> str:
+    """JSON dump of snapshot(). With `path`, writes the file and
+    returns the path; without, returns the JSON string."""
+    payload = json.dumps(snapshot(), indent=1, sort_keys=True,
+                         default=str)
+    if path is None:
+        return payload
+    with open(path, "w") as f:
+        f.write(payload)
+    return path
+
+
+def breakdown_table() -> str:
+    """Human-readable step-time breakdown (the TelemetryHandler log
+    line): per phase count / mean / p50 / p95 / p99 in ms plus the
+    rolling samples/sec."""
+    snap = snapshot()
+    if not snap:
+        return "telemetry disabled"
+    lines = [f"{'phase':<16}{'count':>8}{'mean_ms':>10}{'p50_ms':>10}"
+             f"{'p95_ms':>10}{'p99_ms':>10}{'total_s':>10}"]
+    order = {p: i for i, p in enumerate(STEP_PHASES)}
+    rows = sorted(snap["step_time_breakdown"].items(),
+                  key=lambda kv: order.get(kv[0], 99))
+    for name, st in rows:
+        if not st.get("count"):
+            continue
+        lines.append(
+            f"{name:<16}{st['count']:>8}"
+            f"{st['mean'] * 1e3:>10.2f}{st['p50'] * 1e3:>10.2f}"
+            f"{st['p95'] * 1e3:>10.2f}{st['p99'] * 1e3:>10.2f}"
+            f"{st['sum']:>10.2f}")
+    sps = snap.get("samples_per_sec", 0.0)
+    if sps:
+        lines.append(f"samples/sec: {sps:.1f}")
+    return "\n".join(lines)
+
+
+# -- chrome-trace export ----------------------------------------------------
+
+def note_device_trace(logdir: str):
+    """Register a jax.profiler trace session's logdir so
+    export_chrome_trace can merge its device events. Called by
+    profiler.start_device_trace; recorded even while telemetry is
+    disabled (the export decision happens later)."""
+    if logdir not in _DEVICE_TRACE_DIRS:
+        _DEVICE_TRACE_DIRS.append(logdir)
+
+
+def _device_trace_events() -> List[dict]:
+    """Parse chrome-format trace files a jax.profiler session left
+    under the registered logdirs (TensorBoard layout writes
+    `*.trace.json.gz`; xplane-only dumps yield nothing here — the
+    sync-measured device spans on DEVICE_PID still cover those runs).
+    Device pids are offset by DEVICE_PID + 1 so they can never collide
+    with the host pid."""
+    import glob
+    import gzip
+    events: List[dict] = []
+    for d in _DEVICE_TRACE_DIRS:
+        paths = []
+        for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+            paths.extend(glob.glob(os.path.join(d, pat), recursive=True))
+        for p in sorted(set(paths)):
+            try:
+                if p.endswith(".gz"):
+                    with gzip.open(p, "rt") as f:
+                        blob = json.load(f)
+                else:
+                    with open(p) as f:
+                        blob = json.load(f)
+            except Exception:
+                continue
+            for ev in blob.get("traceEvents", []):
+                ev = dict(ev)
+                ev["pid"] = DEVICE_PID + 1 + int(ev.get("pid", 0))
+                events.append(ev)
+    return events
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write ONE chrome://tracing-loadable JSON merging:
+
+    - host phase events recorded by `phase`/`mark_phase` (pid 0),
+    - host `profiler.scope` spans (pid 0),
+    - device spans: sync-measured executable spans (pid 1, recorded by
+      FusedTrainStep with `device=True`) and any chrome-format trace a
+      registered `jax.profiler` session produced (pids >= 2).
+
+    Works with whatever has been recorded so far; events only exist
+    for spans that ran while telemetry was enabled."""
+    events: List[dict] = [
+        {"ph": "M", "pid": HOST_PID, "name": "process_name",
+         "args": {"name": "host: telemetry phases + profiler scopes"}},
+        {"ph": "M", "pid": DEVICE_PID, "name": "process_name",
+         "args": {"name": "device: sync-measured executable spans"}},
+    ]
+    events.extend(_TRACE_EVENTS)
+    try:
+        from . import profiler as _prof
+        events.extend(dict(ev, pid=HOST_PID) for ev in _prof._EVENTS)
+    except Exception:
+        pass
+    dev = _device_trace_events()
+    if dev:
+        pids = sorted({ev.get("pid") for ev in dev})
+        for pid in pids:
+            events.append({"ph": "M", "pid": pid, "name": "process_name",
+                           "args": {"name": "device: jax.profiler trace"}})
+        events.extend(dev)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path

@@ -24,38 +24,78 @@ from typing import Optional
 import jax
 
 __all__ = ["cache_stats", "reset_cache_stats", "record_hit",
-           "record_compile", "lower_text", "jaxpr_text", "dump_dir",
-           "maybe_dump"]
+           "record_compile", "record_compile_seconds", "lower_text",
+           "jaxpr_text", "dump_dir", "maybe_dump"]
 
 _lock = threading.Lock()
 _stats = {"compiles": 0, "hits": 0}
+#: per-block breakdown: {block_name: {"compiles": n, "hits": n,
+#: "compile_seconds": s}} — the telemetry snapshot surfaces this via
+#: cache_stats()["per_block"]
+_per_block: dict = {}
+_compile_seconds = 0.0
 
 
 def cache_stats() -> dict:
     """Compile-cache statistics across all HybridBlocks: `compiles` =
     distinct (shape, dtype, mode) entries built, `hits` = calls served
-    from cache, `hit_rate` in [0, 1]."""
+    from cache, `hit_rate` in [0, 1]. The global keys keep their
+    original shape; `compile_seconds` (wall time spent building fresh
+    entries) and `per_block` ({name: {compiles, hits,
+    compile_seconds}}) ride along."""
     with _lock:
         total = _stats["compiles"] + _stats["hits"]
         return {**_stats,
-                "hit_rate": (_stats["hits"] / total) if total else 0.0}
+                "hit_rate": (_stats["hits"] / total) if total else 0.0,
+                "compile_seconds": _compile_seconds,
+                "per_block": {k: dict(v) for k, v in _per_block.items()}}
 
 
 def reset_cache_stats():
+    global _compile_seconds
     with _lock:
         _stats["compiles"] = 0
         _stats["hits"] = 0
+        _per_block.clear()
+        _compile_seconds = 0.0
 
 
-def record_hit():
+def _block_slot(name):
+    ent = _per_block.get(name)
+    if ent is None:
+        ent = _per_block[name] = {"compiles": 0, "hits": 0,
+                                  "compile_seconds": 0.0}
+    return ent
+
+
+def record_hit(name: Optional[str] = None):
     with _lock:
         _stats["hits"] += 1
+        if name is not None:
+            _block_slot(name)["hits"] += 1
+
+
+def record_compile_seconds(name: str, seconds: float):
+    """Wall time one fresh cache entry took to trace+compile+first-run;
+    feeds the global and per-block accumulators plus the
+    `compile_seconds_total`/`compiles_total` telemetry metrics."""
+    global _compile_seconds
+    with _lock:
+        _compile_seconds += seconds
+        _block_slot(name)["compile_seconds"] += seconds
+    from . import telemetry as _tm
+    if _tm._ENABLED:
+        _tm.observe("compile_seconds", seconds, block=name)
 
 
 def record_compile(name: str, entry) -> None:
     with _lock:
         _stats["compiles"] += 1
+        _block_slot(name)["compiles"] += 1
         n = _stats["compiles"]
+    from . import telemetry as _tm
+    if _tm._ENABLED:
+        _tm.inc("compiles_total", 1, block=name)
     d = dump_dir()
     if d:
         try:
