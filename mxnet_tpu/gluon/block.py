@@ -523,6 +523,16 @@ class HybridSequential(HybridBlock, Sequential):
     def __init__(self, prefix=None, params=None):
         HybridBlock.__init__(self, prefix, params)
 
+    def pipeline_stages(self, pp, sample, cost_model="flops"):
+        """Cut this chain of shape-preserving blocks into `pp` balanced
+        pipeline stages (parallel.pipeline.pipeline_stages): the
+        returned StagedPipeline carries stage-stacked params and a
+        stage_fn for the gpipe/one_f_one_b schedules and for
+        FusedTrainStep(pipeline=M)."""
+        from ..parallel.pipeline import pipeline_stages
+        return pipeline_stages(self, pp, sample=sample,
+                               cost_model=cost_model)
+
 
 class Lambda(Block):
     def __init__(self, function):

@@ -28,7 +28,8 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, multi_tensor=True,
-                 zero1=False, zero1_shards=None, zero=None):
+                 zero1=False, zero1_shards=None, zero=None,
+                 pipeline=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -72,6 +73,16 @@ class Trainer:
         self._zero1_shards = zero1_shards
         self._zero1_active = False
         self._zero_stage = 0
+        # pipeline-parallel microbatch request: like compression_params
+        # and zero, this rides into FusedTrainStep (which inherits it as
+        # pipeline=M and runs the 1F1B schedule over the mesh's pp
+        # axis). The eager Trainer path itself has no pipeline engine —
+        # a non-None value only takes effect through the fused step.
+        if pipeline is not None and int(pipeline) < 1:
+            raise ValueError(f"pipeline must be a positive microbatch "
+                             f"count; got {pipeline!r}")
+        self._pipeline_req = int(pipeline) if pipeline is not None \
+            else None
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):

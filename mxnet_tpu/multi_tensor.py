@@ -44,7 +44,8 @@ from . import telemetry as _tm
 
 __all__ = ["MultiTensorUpdater", "plan_buckets", "flatten_buckets",
            "unflatten_buckets", "DEFAULT_BUCKET_BYTES",
-           "zero1_padded_sizes", "bucket_segments", "zero1_update_shard"]
+           "zero1_padded_sizes", "bucket_segments", "zero1_update_shard",
+           "is_elementwise_rule"]
 
 #: bucket size for flattened-gradient collectives (~4 MB, the sweet spot
 #: between per-tensor launch overhead and collective latency hiding)
@@ -171,6 +172,17 @@ def zero1_update_shard(opt, w, g, state, hyper, seg, num_segments: int,
     get exact global per-tensor norms through the seg/psum helper."""
     return opt._zero1_step(w, g, state, hyper,
                            _tensorwise_norm(seg, num_segments, axis_name))
+
+
+def is_elementwise_rule(opt) -> bool:
+    """True when `opt`'s update math is purely elementwise — i.e. it did
+    NOT override Optimizer._zero1_step to consume per-tensor norms
+    (LAMB/LARS do). Elementwise rules can run on arbitrary contiguous
+    slices of flattened/stacked weights with no norm bookkeeping, which
+    is what the pipeline ZeRO path (flat per-stage shards, no segment
+    ids) requires."""
+    from .optimizer import Optimizer
+    return type(opt)._zero1_step is Optimizer._zero1_step
 
 
 class _FlatWeight:

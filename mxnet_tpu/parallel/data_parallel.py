@@ -175,7 +175,8 @@ class FusedTrainStep:
     def __init__(self, net, loss_fn, trainer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", donate: bool = True,
                  n_model_inputs: int = 1, grad_accum: int = 1,
-                 compression=None, zero1: bool = False, zero=None):
+                 compression=None, zero1: bool = False, zero=None,
+                 pipeline=None, pp_axis: str = "pp"):
         from ..gluon.trainer import Trainer
         self.net = net
         self.loss_fn = loss_fn
@@ -186,6 +187,8 @@ class FusedTrainStep:
                 compression = trainer._compression_params
             if zero is None and trainer._zero_req:
                 zero = trainer._zero_req
+            if pipeline is None:
+                pipeline = trainer._pipeline_req
         else:
             self.optimizer = trainer
             self._trainer = None
@@ -218,6 +221,19 @@ class FusedTrainStep:
             stage = 1
         self.zero_stage = stage
         self.zero1 = stage >= 1
+        # pipeline-parallel: pipeline=M runs the 1F1B microbatch
+        # schedule (M microbatches, O(num_stages) activation stash,
+        # recompute-vjp) over the mesh's `pp_axis` inside the one
+        # compiled step; the net is auto-staged with
+        # parallel.pipeline.pipeline_stages. No pp axis → sequential
+        # semantics with a one-time warning (degrade matrix like ZeRO).
+        if pipeline is not None and int(pipeline) < 1:
+            raise ValueError(f"pipeline must be a positive microbatch "
+                             f"count; got {pipeline!r}")
+        self.pipeline = int(pipeline) if pipeline is not None else None
+        self.pp_axis = pp_axis
+        self._pp_staged = None
+        self._pp_mask = None
         self._compiled = None
         self._params = None
         self._tr = None
@@ -270,6 +286,11 @@ class FusedTrainStep:
         gathered to a single replicated array so eager code can use them;
         ZeRO-3 flat weight shards gather and unflatten per bucket — the
         checkpoint is full-size and replica-count portable."""
+        if self._pp_staged is not None:
+            self._pp_staged.unstack_into_net(
+                {n: _unshard(self._tr[n])
+                 for n in self._pp_staged.param_names})
+            return
         if self._zero3:
             from .. import multi_tensor as _mt
             for gi, g in enumerate(self._zero1_groups):
@@ -291,6 +312,11 @@ class FusedTrainStep:
         back into sharded flat buckets."""
         params = self._params if self._params is not None \
             else self.net.collect_params()
+        if self._pp_staged is not None:
+            restacked = self._pp_staged.restack()
+            self._tr = {n: _global_put(restacked[n], self._tr_sh[n])
+                        for n in self._pp_staged.param_names}
+            return
         if self._zero3:
             from .. import multi_tensor as _mt
             new_tr = {}
@@ -311,6 +337,18 @@ class FusedTrainStep:
 
     # -- compilation ---------------------------------------------------------
     def _build(self, args):
+        if self.pipeline is not None:
+            from .mesh import has_axis
+            if has_axis(self.mesh, self.pp_axis):
+                self._build_pipeline(args)
+                return
+            import warnings
+            warnings.warn(
+                f"pipeline={self.pipeline} requested but the mesh has "
+                f"no {self.pp_axis!r} axis of size > 1 — running the "
+                "plain fused step (sequential semantics); build a "
+                "hybrid_mesh(dp=..., pp=...) to pipeline",
+                RuntimeWarning, stacklevel=3)
         with use_mesh(self.mesh):
             entry = self.net.trace_entry(
                 list(args[:self.n_model_inputs]), training=True)
@@ -845,6 +883,386 @@ class FusedTrainStep:
         self._zero1_groups = grp_list
         self._zero3 = z3
 
+    def _build_pipeline(self, args):
+        """Pipeline-parallel variant: the net is auto-staged over the
+        mesh's pp axis (parallel.pipeline.pipeline_stages — balanced
+        contiguous block runs, identity-padded to a uniform slot count)
+        and ONE shard_map'd executable runs the full 1F1B microbatch
+        schedule: M microbatches tick through the stages via ppermute,
+        each stage stashes only O(num_stages) activations and
+        recomputes its forward from the stashed input during the
+        backward half (recompute-vjp). Gradients come out stage-stacked
+        and feed the same fused optimizer rules:
+
+          * plain dp: per-leaf pmean over dp, per-slot vmap'd _step (so
+            norm-based rules like LAMB keep exact per-block norms);
+          * zero=1|2: each stage's dp group reduce-scatters its FLAT
+            stacked grads, updates a 1/ndp shard with SHARD-SIZED
+            state, all-gathers weights (elementwise rules only —
+            norm-based rules degrade to unsharded with a warning);
+            zero=2 + grad_accum carries shard-sized accumulators;
+            zero=3 clamps to 2 (stacked weights must stay resident for
+            restacking);
+          * compression: 2-bit/int8 codes ride the dp collective with
+            per-(stage, rank) error-feedback residuals.
+
+        Degrade matrix mirrors ZeRO's: no pp axis → _build warned and
+        ran the sequential-semantics plain step; no dp axis → single
+        data shard, dp collectives dropped."""
+        from ..base import shard_map
+        from .. import multi_tensor as _mt
+        from . import pipeline as _pl
+        from .compression import (compressed_psum_scatter,
+                                  compressed_psum_tree)
+        from .mesh import axis_size
+        import warnings
+
+        mesh = self.mesh
+        dp = self.dp_axis
+        ppx = self.pp_axis
+        npp = axis_size(mesh, ppx)
+        ndp = axis_size(mesh, dp)
+        M = int(self.pipeline)
+        accum = self.grad_accum
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+
+        if self.n_model_inputs != 1 or len(args) != 2:
+            raise ValueError(
+                "pipeline=M needs exactly (x, y) batches with one "
+                f"model input; got n_model_inputs={self.n_model_inputs}"
+                f", {len(args)} args")
+        for n in self._tr_names:
+            if self._params[n].sharding is not None:
+                raise ValueError(
+                    "pipeline stages shard over the pp axis; parameter "
+                    f"{n!r} carries a TP sharding — drop one of them")
+        if self._aux_names:
+            raise ValueError(
+                "pipeline=M requires a stateless net (no aux params "
+                f"like BatchNorm running stats); got {self._aux_names}")
+
+        x0 = args[0]
+        x0 = x0 if isinstance(x0, NDArray) else NDArray(jnp.asarray(x0))
+        with use_mesh(None):
+            staged = _pl.pipeline_stages(self.net, npp, sample=x0)
+        self._pp_staged = staged
+        names = staged.param_names
+        s = staged.num_slots
+        xr = x0._data
+        yr = args[1]._data if isinstance(args[1], NDArray) \
+            else jnp.asarray(args[1])
+        B = xr.shape[0]
+        if B % (ndp * accum * M) != 0:
+            raise ValueError(
+                f"pipeline batch: global batch {B} must divide by "
+                f"dp({ndp}) x grad_accum({accum}) x microbatches({M})")
+        mbsz = B // (ndp * accum * M)
+
+        stage = self.zero_stage
+        if stage >= 3:
+            warnings.warn(
+                "pipeline + zero=3 is clamped to zero=2: stage-stacked "
+                "weights must stay resident for checkpoint restacking; "
+                "grads and optimizer state still shard over dp",
+                RuntimeWarning, stacklevel=3)
+            stage = 2
+        if stage >= 1 and not _mt.is_elementwise_rule(opt):
+            warnings.warn(
+                f"pipeline + zero={stage} needs an elementwise update "
+                f"rule; {type(opt).__name__} uses per-tensor norms — "
+                "running the update unsharded (per-slot vmap keeps its "
+                "norms exact)", RuntimeWarning, stacklevel=3)
+            stage = 0
+        if (stage >= 1 or self.compression is not None) and ndp <= 1:
+            if stage >= 1:
+                warnings.warn(
+                    f"pipeline + zero={stage} requested but the mesh "
+                    f"has no {dp!r} axis of size > 1 — nothing to "
+                    "shard over; running unsharded",
+                    RuntimeWarning, stacklevel=3)
+            if self.compression is not None:
+                warnings.warn(
+                    "gradient compression requested but the mesh has "
+                    f"no {dp!r} axis of size > 1 — training "
+                    "uncompressed", RuntimeWarning, stacklevel=3)
+            stage = 0
+            self.compression = None
+        scheme = threshold = None
+        if self.compression is not None:
+            scheme = self.compression.get("type", "2bit")
+            threshold = float(self.compression.get("threshold", 0.5))
+
+        # loss dtype probe (the 1F1B accumulator matches it — bf16
+        # pipelines don't silently upcast)
+        def _mb_loss(key_):
+            def mb_loss(out_raw, y_raw):
+                with autograd._mode(False, True), _random.trace_key(
+                        jax.random.fold_in(key_, 7)):
+                    l = loss_fn(NDArray(out_raw), NDArray(y_raw))
+                    l = l.mean()
+                return l._data
+            return mb_loss
+
+        mb_x = jax.ShapeDtypeStruct((mbsz,) + xr.shape[1:], xr.dtype)
+        mb_y = jax.ShapeDtypeStruct((mbsz,) + yr.shape[1:], yr.dtype)
+        ld = jax.eval_shape(_mb_loss(jax.random.PRNGKey(0)),
+                            mb_x, mb_y).dtype
+
+        stacked = {n: staged.params[n] for n in names}
+        mask = staged.params["__mask__"]
+
+        # optimizer state. zero=0: full stacked state sharded over pp,
+        # updated with a per-slot vmap. zero>=1: per-name FLAT padded
+        # buckets (pad to ndp x 128 lanes) sharded (pp, dp) — only the
+        # 1/ndp shard of each stage's state is ever resident
+        pad_q = ndp * _mt.ZERO1_LANE
+        flat_meta = {}  # name -> (numel, padded, ssz)
+        for n in names:
+            numel = int(_np.prod(stacked[n].shape[1:]))  # s * prod(shape)
+            padded = -(-numel // pad_q) * pad_q
+            flat_meta[n] = (numel, padded, padded // ndp)
+
+        states = {}
+        if stage == 0:
+            for i, n in enumerate(names):
+                states[n] = opt.create_state(i, NDArray(stacked[n]))
+                opt.idx2name[i] = n
+        else:
+            for i, n in enumerate(names):
+                numel, padded, ssz = flat_meta[n]
+                probe = jax.eval_shape(
+                    lambda i=i, n=n, ssz=ssz: opt.create_state(
+                        i, _mt._FlatWeight(jax.ShapeDtypeStruct(
+                            (ssz,), jnp.dtype(stacked[n].dtype)))))
+                leaves, treedef = jax.tree_util.tree_flatten(probe)
+                states[n] = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.zeros((npp, ndp * l.shape[0]),
+                                        l.dtype) for l in leaves])
+                opt.idx2name[i] = n
+        # a checkpoint saved FROM a pipeline step restored before the
+        # first call already carries stage-stacked (or flat-sharded)
+        # state under the canonical names — keep it instead of zeros
+        if set(self._states.keys()) == set(names) and all(
+                jax.tree_util.tree_structure(self._states[n]) ==
+                jax.tree_util.tree_structure(states[n]) and all(
+                    tuple(a.shape) == tuple(b.shape)
+                    for a, b in zip(
+                        jax.tree_util.tree_leaves(self._states[n]),
+                        jax.tree_util.tree_leaves(states[n])))
+                for n in names):
+            states = {n: jax.tree_util.tree_map(
+                jnp.asarray, self._states[n]) for n in names}
+
+        def _pad_flat(v, padded):
+            f = v.reshape(-1)
+            return jnp.pad(f, (0, padded - f.shape[0])) \
+                if padded > f.shape[0] else f
+
+        def _reduce_dp(grads, resid):
+            """dp gradient sync in the requested flavor. Returns
+            (update-ready grads, new residuals): full stacked leaves
+            for stage 0, 1/ndp flat shards for zero>=1."""
+            new_resid = {}
+            if stage == 0:
+                if scheme is not None:
+                    # local resid view under P(dp, ppx) is (1, 1, ...)
+                    grads, new_resid = compressed_psum_tree(
+                        grads, {n: resid[n][0, 0] for n in names}, dp,
+                        scheme, threshold)
+                    new_resid = {n: v[None, None] for n, v in
+                                 new_resid.items()}
+                elif ndp > 1:
+                    grads = {n: lax.pmean(g, dp)
+                             for n, g in grads.items()}
+                return grads, new_resid
+            red = {}
+            for n in names:
+                numel, padded, ssz = flat_meta[n]
+                gf = _pad_flat(grads[n], padded)
+                if scheme is not None:
+                    red[n], nres = compressed_psum_scatter(
+                        gf, resid[n][0, 0], dp, scheme, threshold)
+                    new_resid[n] = nres[None, None]
+                else:
+                    red[n] = lax.psum_scatter(
+                        gf, dp, scatter_dimension=0, tiled=True) / ndp
+            return red, new_resid
+
+        shard_accum = stage >= 2 and accum > 1 and scheme is None
+
+        def body(tr, mask_l, states_l, hyper, key, resid, xb, yb):
+            # local views: tr leaves (1, s, *shape) -> (s, *shape);
+            # zero states (1, ssz) -> (ssz,); mask (1, s) -> (s,)
+            params = {n: tr[n][0] for n in names}
+            params["__mask__"] = mask_l[0]
+            states_ = {n: jax.tree_util.tree_map(lambda v: v[0],
+                                                 states_l[n])
+                       for n in names}
+            if ndp > 1:
+                key = jax.random.fold_in(key, lax.axis_index(dp))
+            key = jax.random.fold_in(key, lax.axis_index(ppx))
+            rank = lax.axis_index(dp) if ndp > 1 else 0
+            stage_fn = staged.make_stage_fn(jax.random.fold_in(key, 1))
+            mb_loss = _mb_loss(key)
+
+            def run_pipe(xc, yc):
+                """One 1F1B sweep over M microbatches; returns the mean
+                microbatch loss and the mean local grads (stacked)."""
+                mbs = xc.reshape(M, mbsz, *xc.shape[1:])
+                ybs = yc.reshape(M, mbsz, *yc.shape[1:])
+                loss_sum, grads = _pl._1f1b_local(
+                    params, mbs, ybs, stage_fn, mb_loss, ppx,
+                    loss_dtype=ld)
+                loss_sum = lax.psum(loss_sum, ppx)  # lives on last stage
+                grads = {n: grads[n] / M for n in names}
+                return loss_sum / M, grads
+
+            if accum <= 1:
+                loss, grads = run_pipe(xb, yb)
+                red, new_resid = _reduce_dp(grads, resid)
+            else:
+                xm = xb.reshape(accum, xb.shape[0] // accum,
+                                *xb.shape[1:])
+                ym = yb.reshape(accum, yb.shape[0] // accum,
+                                *yb.shape[1:])
+
+                def acc_body(carry, xs):
+                    gacc, lacc = carry
+                    xc, yc = xs
+                    l, g = run_pipe(xc, yc)
+                    if shard_accum:
+                        # reduce-scatter every chunk immediately: the
+                        # carry is 1/ndp-sized and the full grad sum
+                        # never exists (ZeRO-2 semantics)
+                        g, _ = _reduce_dp(g, None)
+                    gacc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(a.dtype), gacc, g)
+                    return (gacc, lacc + l.astype(jnp.float32)), None
+
+                if shard_accum:
+                    g0 = {n: jnp.zeros((flat_meta[n][2],), jnp.float32)
+                          for n in names}
+                else:
+                    g0 = {n: jnp.zeros(stacked[n].shape[1:],
+                                       jnp.float32) for n in names}
+                (gsum, lsum), _ = lax.scan(
+                    acc_body, (g0, jnp.float32(0.0)), (xm, ym))
+                loss = (lsum / accum).astype(ld)
+                grads = {n: v / accum for n, v in gsum.items()}
+                if shard_accum:
+                    red, new_resid = grads, {}
+                else:
+                    red, new_resid = _reduce_dp(grads, resid)
+
+            if ndp > 1:
+                loss = lax.pmean(loss, dp)
+
+            new_tr, new_states = {}, {}
+            if stage == 0:
+                # per-slot vmap: norm-based rules see each block's own
+                # tensor, exactly like the unpipelined per-name loop
+                def upd(w, g, st):
+                    return opt._step(w, g, st, hyper)
+                for n in names:
+                    nw, nst = jax.vmap(upd)(params[n], red[n],
+                                            states_[n])
+                    new_tr[n] = nw[None]
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda v: v[None], nst)
+            else:
+                for n in names:
+                    numel, padded, ssz = flat_meta[n]
+                    wf = _pad_flat(params[n], padded)
+                    w_sh = lax.dynamic_slice(wf, (rank * ssz,), (ssz,))
+                    nw, nst = opt._step(w_sh, red[n], states_[n],
+                                        hyper)
+                    full = lax.all_gather(nw, dp, axis=0, tiled=True)
+                    new_tr[n] = full[:numel].reshape(
+                        stacked[n].shape[1:])[None]
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda v: v[None], nst)
+            out = (loss.astype(jnp.float32), new_tr, new_states)
+            return out + ((new_resid,) if scheme is not None else ())
+
+        pspec = {n: P(ppx, *([None] * (stacked[n].ndim - 1)))
+                 for n in names}
+        st_spec = {n: jax.tree_util.tree_map(
+            lambda _: P(ppx) if stage == 0 else P(ppx, dp), states[n])
+            for n in names}
+        # stage-0 state leaves mirror the stacked weight's rank
+        if stage == 0:
+            st_spec = {n: jax.tree_util.tree_map(
+                lambda v: P(ppx, *([None] * (v.ndim - 1))), states[n])
+                for n in names}
+        dpn = dp if ndp > 1 else None
+        batch_specs = (split_batch_spec(xr.ndim, 0, dpn),
+                       split_batch_spec(yr.ndim, 0, dpn))
+        in_specs = (pspec, P(ppx), st_spec, P(), P())
+        out_specs = (P(), pspec, st_spec)
+        resid_spec = None
+        if scheme is not None:
+            if stage == 0:
+                resid_spec = {n: P(dp, ppx,
+                                   *([None] * (stacked[n].ndim - 1)))
+                              for n in names}
+            else:
+                resid_spec = {n: P(dp, ppx) for n in names}
+            in_specs = in_specs + (resid_spec,)
+            out_specs = out_specs + (resid_spec,)
+
+            def fn_step(tr, mask_l, states_l, hyper, key, resid,
+                        *batch):
+                return body(tr, mask_l, states_l, hyper, key, resid,
+                            *batch)
+        else:
+            def fn_step(tr, mask_l, states_l, hyper, key, *batch):
+                return body(tr, mask_l, states_l, hyper, key, None,
+                            *batch)
+
+        # check_rep=False: the dead-tick lax.cond branches and the
+        # ppermute broadcast produce values the static replication
+        # checker cannot type, and the loss/weights ARE replicated
+        # where the specs say so
+        fn = shard_map(fn_step, mesh=mesh,
+                       in_specs=in_specs + batch_specs,
+                       out_specs=out_specs, check_rep=False)
+        donate = (0, 2, 5) if scheme is not None else (0, 2)
+        self._compiled = jax.jit(
+            fn, donate_argnums=donate if self.donate else ())
+
+        def _nsh(spec):
+            return NamedSharding(mesh, spec)
+
+        self._tr = {n: _global_put(stacked[n], _nsh(pspec[n]))
+                    for n in names}
+        self._pp_mask = _global_put(mask, _nsh(P(ppx)))
+        self._states = {
+            n: jax.tree_util.tree_map(
+                lambda v, sp: _global_put(v, _nsh(sp)),
+                states[n], st_spec[n]) for n in names}
+        if scheme is not None:
+            self._resid = {}
+            for n in names:
+                if stage == 0:
+                    shape = (ndp,) + tuple(stacked[n].shape)
+                else:
+                    shape = (ndp, npp, flat_meta[n][1])
+                self._resid[n] = jax.device_put(
+                    jnp.zeros(shape, jnp.float32),
+                    _nsh(resid_spec[n]))
+        self._batch_sh = tuple(_nsh(sp) for sp in batch_specs)
+        self._tr_sh = {n: _nsh(pspec[n]) for n in names}
+        self._aux_sh = {}
+        self._st_sh = {n: jax.tree_util.tree_map(
+            lambda sp: _nsh(sp), st_spec[n],
+            is_leaf=lambda v: isinstance(v, P)) for n in names}
+        self._tr_names = names
+        self._aux_names = []
+        self._aux = {}
+        self.zero_stage = stage
+        self._pp_nstages = npp
+
     def zero1_state_nbytes(self):
         """(total, per_replica) optimizer-state bytes after _build —
         per_replica is total/N, the ZeRO-1 memory claim."""
@@ -866,7 +1284,13 @@ class FusedTrainStep:
             sh = getattr(v, "sharding", None)
             if sh is None or getattr(sh, "is_fully_replicated", True):
                 return v.nbytes
-            return v.nbytes // ndp
+            try:
+                # exact per-device residency regardless of WHICH axes
+                # shard the array (dp flat buckets, pp stage stacks,
+                # dp x pp state): one shard's bytes
+                return max(s.data.nbytes for s in v.addressable_shards)
+            except Exception:
+                return v.nbytes // ndp
 
         out = {"weights": 0, "grads": 0, "opt_state": 0, "transient": 0}
         for store, cat in ((self._tr, "weights"), (self._aux, "weights"),
@@ -909,7 +1333,17 @@ class FusedTrainStep:
             t0 = _time.perf_counter()
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):
-            if self._resid is not None:
+            if self._pp_mask is not None:
+                cargs = (self._tr, self._pp_mask, self._states, hyper,
+                         key)
+                if self._resid is not None:
+                    (loss, self._tr, self._states,
+                     self._resid) = self._compiled(
+                        *cargs, self._resid, *raw)
+                else:
+                    loss, self._tr, self._states = self._compiled(
+                        *cargs, *raw)
+            elif self._resid is not None:
                 (loss, self._tr, self._aux, self._states,
                  self._resid) = self._compiled(
                     self._tr, self._aux, self._states, hyper, key,
@@ -921,6 +1355,11 @@ class FusedTrainStep:
             jax.block_until_ready(loss)
             dt = _time.perf_counter() - t0
             _tm.mark_phase("fused_step", dt, t0=t0, device=True)
+            if self._pp_staged is not None:
+                # attribute the device span to fill/steady/drain and
+                # publish the (n-1)/(M+n-1) bubble_ratio gauge
+                _tm.record_pipeline_step(self._pp_nstages,
+                                         self.pipeline, dt, t0=t0)
             # host-side view of the same span: the eager phases land on
             # pid 0, so the fused step needs a host event there too for
             # a complete per-step host timeline

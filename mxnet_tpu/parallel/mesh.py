@@ -15,7 +15,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "P",
            "current_mesh", "set_mesh", "use_mesh", "local_mesh",
-           "hybrid_mesh"]
+           "hybrid_mesh", "axis_size", "has_axis"]
 
 P = PartitionSpec
 
@@ -68,6 +68,21 @@ def make_mesh(axis_shapes: Sequence[int], axis_names: Sequence[str],
         f"have {len(devices)}"
     arr = _np.asarray(devices[:n]).reshape(shapes)
     return Mesh(arr, tuple(axis_names))
+
+
+def axis_size(mesh: Optional[Mesh], name: str, default: int = 1) -> int:
+    """Size of mesh axis `name`, or `default` when the mesh is None or
+    has no such axis — the common probe for degrade matrices
+    (FusedTrainStep zero/pipeline/compression paths)."""
+    if mesh is None or name not in mesh.axis_names:
+        return default
+    return int(mesh.shape[name])
+
+
+def has_axis(mesh: Optional[Mesh], name: str) -> bool:
+    """True when `mesh` has a `name` axis of size > 1 — i.e. the axis
+    actually parallelizes something."""
+    return axis_size(mesh, name) > 1
 
 
 def local_mesh(dp: int = -1) -> Mesh:

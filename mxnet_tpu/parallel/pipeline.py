@@ -1,4 +1,5 @@
-"""Pipeline parallelism — GPipe microbatch schedule over a `pp` mesh axis.
+"""Pipeline parallelism — GPipe / 1F1B microbatch schedules over a `pp`
+mesh axis, plus auto-staging of a HybridSequential into balanced stages.
 
 Reference parity: MXNet's model-parallel examples place layer groups on
 different GPUs and rely on the dependency engine to overlap them
@@ -15,11 +16,17 @@ Constraints (classic GPipe):
   * all stages share one parameter treedef (stacked leading dim = pp).
 
 `gpipe(...)` is differentiable — reverse-mode flows back through the
-scan/ppermute schedule, so it drops into FusedTrainStep loss functions.
+scan/ppermute schedule. `one_f_one_b(...)` computes loss AND grads in
+one pass with an O(num_stages) activation stash; `pipeline_stages(...)`
+cuts a HybridSequential into balanced stages that drop straight into
+either schedule (and into `FusedTrainStep(pipeline=M)`).
 """
 from __future__ import annotations
 
 from functools import partial
+from typing import List, Optional, Sequence
+
+import numpy as _np
 
 import jax
 import jax.numpy as jnp
@@ -29,12 +36,51 @@ from jax.sharding import PartitionSpec as P
 from .mesh import current_mesh
 
 __all__ = ["stack_stage_params", "gpipe", "sequential_apply",
-           "one_f_one_b"]
+           "one_f_one_b", "pipeline_stages", "StagedPipeline",
+           "bubble_ratio", "stash_slots"]
+
+
+def bubble_ratio(num_stages: int, num_microbatches: int) -> float:
+    """Fraction of schedule ticks lost to fill+drain bubbles:
+    (n-1)/(M+n-1) — the classic GPipe/1F1B pipeline inefficiency."""
+    n, M = int(num_stages), int(num_microbatches)
+    return (n - 1) / (M + n - 1) if M + n - 1 > 0 else 0.0
+
+
+def stash_slots(num_stages: int) -> int:
+    """Activation-stash slots per stage under the 1F1B schedule:
+    2n-1, bounded by the STAGE count — independent of the microbatch
+    count M (GPipe under plain AD stashes all M)."""
+    return 2 * int(num_stages) - 1
 
 
 def stack_stage_params(params_list):
     """Stack per-stage parameter pytrees (identical treedefs) into one
-    pytree whose leaves carry a leading `pp` dimension."""
+    pytree whose leaves carry a leading `pp` dimension.
+
+    Raises a ValueError naming the first mismatched stage when the
+    per-stage treedefs or leaf shapes/dtypes differ (instead of the
+    cryptic tree_map arity error jax would produce)."""
+    if not params_list:
+        raise ValueError("stack_stage_params: empty stage list")
+    ref_leaves, ref_treedef = jax.tree_util.tree_flatten(params_list[0])
+    for i, p in enumerate(params_list[1:], start=1):
+        leaves, treedef = jax.tree_util.tree_flatten(p)
+        if treedef != ref_treedef:
+            raise ValueError(
+                f"stack_stage_params: stage {i} parameter tree "
+                f"structure {treedef} does not match stage 0's "
+                f"{ref_treedef}; every stage must share one treedef "
+                "so leaves can stack on a leading pp dimension")
+        for k, (a, b) in enumerate(zip(ref_leaves, leaves)):
+            if jnp.shape(a) != jnp.shape(b) or \
+                    jnp.asarray(a).dtype != jnp.asarray(b).dtype:
+                raise ValueError(
+                    f"stack_stage_params: stage {i} leaf {k} has "
+                    f"shape/dtype {jnp.shape(b)}/"
+                    f"{jnp.asarray(b).dtype} but stage 0 has "
+                    f"{jnp.shape(a)}/{jnp.asarray(a).dtype}; stages "
+                    "must be structurally identical to stack")
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs, axis=0), *params_list)
 
@@ -63,13 +109,39 @@ def _vary(x, axis_name):
         return x  # already varying over axis_name
 
 
+def _bcast_from_last(x, axis_name, n):
+    """Broadcast the LAST stage's value to every pp shard with a
+    recursive-doubling ppermute chain (ceil(log2 n) hops), replacing the
+    old full-size psum: no fake zero-contributions ride the wire and no
+    reduction work is spent adding them. jax requires unique ppermute
+    sources, so the multicast is staged — after round r the suffix of
+    min(2^r, n) stages holds the value."""
+    if n <= 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    span = 1
+    while span < n:
+        pairs = [(s, s - span) for s in range(n - span, n)
+                 if s - span >= 0]
+        recv = jax.lax.ppermute(x, axis_name, pairs)
+        newly = jnp.logical_and(idx >= n - 2 * span, idx < n - span)
+        x = jnp.where(newly, recv, x)
+        span *= 2
+    return x
+
+
 def _gpipe_local(params, mbatches, stage_fn, axis_name):
     """Per-device schedule body (runs inside shard_map).
 
     params: this stage's parameters (leading pp dim already split away).
     mbatches: (M, mb, ...) full microbatched input, replicated; only
-    stage 0 reads it. Returns (M, mb, ...) outputs via a final psum
-    (only the last stage contributes non-zeros).
+    stage 0 reads it. Returns (M, mb, ...) outputs, broadcast from the
+    last stage with a ppermute chain (see _bcast_from_last).
+
+    Dead ticks — a stage before its first microbatch arrives (fill) or
+    after its last has left (drain) — skip the stage compute through a
+    lax.cond, so XLA executes nothing for them instead of computing a
+    garbage activation that a select then throws away.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -82,10 +154,13 @@ def _gpipe_local(params, mbatches, stage_fn, axis_name):
 
     def tick(carry, t):
         state, outputs = carry
+        m = t - idx  # the microbatch this stage works on this tick
+        live = jnp.logical_and(m >= 0, m < M)
         feed = jax.lax.dynamic_index_in_dim(
             mbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
         inp = jnp.where(idx == 0, feed, state)
-        out = stage_fn(params, inp)
+        out = jax.lax.cond(live, lambda i: stage_fn(params, i),
+                           jnp.zeros_like, inp)
         j = jnp.clip(t - (n - 1), 0, M - 1)
         upd = jax.lax.dynamic_update_index_in_dim(outputs, out, j, 0)
         take = jnp.logical_and(idx == n - 1, t >= n - 1)
@@ -95,12 +170,13 @@ def _gpipe_local(params, mbatches, stage_fn, axis_name):
 
     (_, outputs), _ = jax.lax.scan(
         tick, (state0, out0), jnp.arange(M + n - 1))
-    # broadcast the last stage's results to every pp shard
-    return jax.lax.psum(outputs, axis_name)
+    # ship the last stage's results to every pp shard (ppermute chain,
+    # not a psum of mostly-zeros)
+    return _bcast_from_last(outputs, axis_name, n)
 
 
 def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
-                axis_name):
+                axis_name, loss_dtype=None):
     """Per-device 1F1B schedule body (runs inside shard_map).
 
     One scan tick = one forward micro-step AND one backward micro-step
@@ -113,6 +189,16 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
     (recompute-vjp), the standard trade on TPU where HBM, not FLOPs,
     is the binding constraint.
 
+    Dead half-ticks (a stage with no forward microbatch in range, or no
+    backward cotangent yet) skip their compute through lax.cond —
+    during fill/drain XLA executes the cheap zero branch instead of a
+    masked-out stage forward or vjp.
+
+    Loss accumulates in `loss_dtype` (default: whatever `loss_fn`
+    returns — probed by the caller), NOT hardcoded fp32, and the
+    loss-seeded cotangent is cast to the activation dtype ONCE where it
+    is created, so bf16-activation pipelines keep a bf16 steady state.
+
     Returns (loss_sum, grad_acc): loss summed over microbatches on the
     last stage (zeros elsewhere), grads for this stage's params.
     """
@@ -124,14 +210,19 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
     perm_down = [(i + 1, i) for i in range(n - 1)]
 
     mb_shape = mbatches.shape[1:]
-    state0 = _vary(jnp.zeros(mb_shape, mbatches.dtype), axis_name)
-    cot0 = _vary(jnp.zeros(mb_shape, mbatches.dtype), axis_name)
-    stash0 = _vary(jnp.zeros((S,) + mb_shape, mbatches.dtype), axis_name)
+    act_dtype = mbatches.dtype
+    if loss_dtype is None:
+        loss_dtype = jax.eval_shape(
+            loss_fn, jax.ShapeDtypeStruct(mb_shape, act_dtype),
+            jax.ShapeDtypeStruct(ybatches.shape[1:],
+                                 ybatches.dtype)).dtype
+    state0 = _vary(jnp.zeros(mb_shape, act_dtype), axis_name)
+    cot0 = _vary(jnp.zeros(mb_shape, act_dtype), axis_name)
+    stash0 = _vary(jnp.zeros((S,) + mb_shape, act_dtype), axis_name)
     grad0 = jax.tree_util.tree_map(
         lambda p: _vary(jnp.zeros_like(p), axis_name), params)
 
-    def mb_loss(out, y):
-        return loss_fn(out, y)
+    is_last = idx == n - 1
 
     def tick(carry, t):
         state, cot_in, stash, grads, loss_acc = carry
@@ -143,19 +234,30 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
         feed = jax.lax.dynamic_index_in_dim(mbatches, m_f_c, 0,
                                             keepdims=False)
         inp = jnp.where(idx == 0, feed, state)
-        out = stage_fn(params, inp)
+        out = jax.lax.cond(valid_f, lambda i: stage_fn(params, i),
+                           jnp.zeros_like, inp)
         # stash the stage INPUT for recompute in the backward half
         upd = jax.lax.dynamic_update_index_in_dim(
             stash, inp, m_f_c % S, 0)
         stash = jnp.where(valid_f, upd, stash)
 
-        # last stage: loss + its cotangent for the just-forwarded mb
+        # last stage: loss + its cotangent for the just-forwarded mb.
+        # Other stages (and dead ticks) take the free branch.
         y_f = jax.lax.dynamic_index_in_dim(ybatches, m_f_c, 0,
                                            keepdims=False)
-        lval, dout_loss = jax.value_and_grad(mb_loss)(out, y_f)
-        is_last = idx == n - 1
-        loss_acc = loss_acc + jnp.where(
-            jnp.logical_and(is_last, valid_f), lval, 0.0)
+
+        def loss_half(oy):
+            o, y = oy
+            lval, dout = jax.value_and_grad(loss_fn)(o, y)
+            # single cast point: the loss cotangent joins the pipeline
+            # in the ACTIVATION dtype (bf16 stays bf16 downstream)
+            return lval.astype(loss_dtype), dout.astype(act_dtype)
+
+        lval, dout_loss = jax.lax.cond(
+            jnp.logical_and(is_last, valid_f), loss_half,
+            lambda oy: (jnp.zeros((), loss_dtype),
+                        jnp.zeros_like(oy[0])), (out, y_f))
+        loss_acc = loss_acc + lval
 
         # ---- backward half: stage idx backprops m_b = t - 2(n-1) + idx
         m_b = t - 2 * (n - 1) + idx
@@ -165,11 +267,19 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
                                              keepdims=False)
         # cotangent: from the loss (last stage, same-tick mb) or from
         # the next stage via the previous tick's ppermute
-        cot = jnp.where(is_last, dout_loss.astype(cot_in.dtype), cot_in)
-        _, vjp = jax.vjp(stage_fn, params, inp_b)
-        dparams, dinp = vjp(cot)
+        cot = jnp.where(is_last, dout_loss, cot_in)
+
+        def bwd_half(ic):
+            i, c = ic
+            _, vjp = jax.vjp(stage_fn, params, i)
+            return vjp(c)
+
+        dparams, dinp = jax.lax.cond(
+            valid_b, bwd_half,
+            lambda ic: (jax.tree_util.tree_map(jnp.zeros_like, params),
+                        jnp.zeros_like(ic[0])), (inp_b, cot))
         grads = jax.tree_util.tree_map(
-            lambda g, d: g + jnp.where(valid_b, d, 0.0), grads, dparams)
+            lambda g, d: g + d, grads, dparams)
 
         # shift: activations up, cotangents down
         state = jax.lax.ppermute(out, axis_name, perm_up)
@@ -178,7 +288,7 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
 
     total_ticks = M + 2 * (n - 1)
     init = (state0, cot0, stash0, grad0,
-            _vary(jnp.zeros((), jnp.float32), axis_name))
+            _vary(jnp.zeros((), loss_dtype), axis_name))
     (_, _, _, grads, loss_acc), _ = jax.lax.scan(
         tick, init, jnp.arange(total_ticks))
     return loss_acc, grads
@@ -198,7 +308,9 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     stage_fn: (stage_params, h) -> h, shape/dtype-preserving.
     loss_fn: (out_mb, y_mb) -> scalar mean loss for one microbatch.
     Returns (mean microbatch loss, grads pytree stacked like
-    `stacked_params` with the leading pp dim).
+    `stacked_params` with the leading pp dim). The loss accumulates in
+    the dtype `loss_fn` actually returns (probed with eval_shape), so a
+    bf16 loss pipeline never silently upcasts.
 
     Reference analogue: upstream MXNet has no pipeline engine — this is
     the TPU-first design the SURVEY §2 checklist promises (bubble ratio
@@ -213,6 +325,9 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     mb = B // num_microbatches
     mbatches = x.reshape(num_microbatches, mb, *x.shape[1:])
     ybatches = y.reshape(num_microbatches, mb, *y.shape[1:])
+    loss_dtype = jax.eval_shape(
+        loss_fn, jax.ShapeDtypeStruct(mbatches.shape[1:], mbatches.dtype),
+        jax.ShapeDtypeStruct(ybatches.shape[1:], ybatches.dtype)).dtype
 
     if mesh is None or pp_axis not in mesh.axis_names:
         def total(params):
@@ -220,7 +335,7 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
                 mbx, mby_ = mby
                 out = sequential_apply(stage_fn, params, mbx)
                 return acc + loss_fn(out, mby_), ()
-            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+            acc, _ = jax.lax.scan(body, jnp.zeros((), loss_dtype),
                                   (mbatches, ybatches))
             return acc / num_microbatches
         loss, grads = jax.value_and_grad(total)(stacked_params)
@@ -237,7 +352,8 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     def body(params, mbs, ybs):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         loss_sum, grads = _1f1b_local(params, mbs, ybs, stage_fn,
-                                      loss_fn, pp_axis)
+                                      loss_fn, pp_axis,
+                                      loss_dtype=loss_dtype)
         # loss lives on the last stage only; share it with every shard
         loss_sum = jax.lax.psum(loss_sum, pp_axis)
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
@@ -245,7 +361,7 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(param_specs, P(), P()),
-                   out_specs=(P(), param_specs))
+                   out_specs=(P(), param_specs), check_rep=False)
     loss_sum, grads = fn(stacked_params, mbatches, ybatches)
     # per-microbatch cotangents were seeded unscaled; match the
     # sequential reference's mean-over-microbatches loss
@@ -285,6 +401,294 @@ def gpipe(stage_fn, stacked_params, x, num_microbatches, mesh=None,
         return _gpipe_local(params, mbs, stage_fn, pp_axis)
 
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(param_specs, P()), out_specs=P())
+                   in_specs=(param_specs, P()), out_specs=P(),
+                   check_rep=False)
     out = fn(stacked_params, mbatches)
     return out.reshape(B, *out.shape[2:])
+
+
+# -- auto-staging a HybridSequential ---------------------------------------
+
+def _balanced_partition(costs: Sequence[float], k: int) -> List[List[int]]:
+    """Contiguous split of `costs` into k non-empty runs minimizing the
+    max run cost (dynamic program; block counts are small)."""
+    L = len(costs)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + float(c))
+    INF = float("inf")
+    best = [[INF] * (L + 1) for _ in range(k + 1)]
+    cut = [[0] * (L + 1) for _ in range(k + 1)]
+    best[0][0] = 0.0
+    for st in range(1, k + 1):
+        for i in range(st, L - (k - st) + 1):
+            for j in range(st - 1, i):
+                c = max(best[st - 1][j], prefix[i] - prefix[j])
+                if c < best[st][i]:
+                    best[st][i] = c
+                    cut[st][i] = j
+    bounds = [L]
+    i = L
+    for st in range(k, 0, -1):
+        i = cut[st][i]
+        bounds.append(i)
+    bounds.reverse()
+    return [list(range(bounds[s], bounds[s + 1])) for s in range(k)]
+
+
+class StagedPipeline:
+    """A HybridSequential cut into `pp` balanced stages, ready for the
+    pipeline schedules.
+
+    Attributes:
+      num_stages, num_slots: pp and the per-stage block-slot count
+        (max stage length; shorter stages are identity-padded).
+      assignment: list of block-index runs, one per stage.
+      param_names: canonical per-block parameter names (block 0's).
+      params: stacked trainable params + the `__mask__` leaf — pytree
+        with leading dim pp, drop-in for gpipe/one_f_one_b. Slot j of
+        stage i computes block assignment[i][j]; padded slots carry a
+        COPY of the stage's last real block's params and a 0 mask, so
+        they compute something well-defined whose output a select
+        discards — the schedule stays uniform across stages and their
+        grads are exactly zero.
+      stage_fn: (stage_params, h) -> h built from the blocks'
+        hybridized (traced) forms; `make_stage_fn(key)` rebinds the
+        dropout key (folded per slot).
+      costs: the per-block cost-model values the partition balanced.
+    """
+
+    def __init__(self, net, blocks, assignment, entry, param_names,
+                 block_params, costs, sample_aval):
+        self.net = net
+        self.blocks = blocks
+        self.assignment = assignment
+        self.num_stages = len(assignment)
+        self.num_slots = max(len(a) for a in assignment)
+        self._entry = entry
+        self.param_names = list(param_names)
+        self._block_params = block_params  # per block: {name: Parameter}
+        self.costs = list(costs)
+        self.sample_aval = sample_aval
+        # (stage, slot) -> block index for REAL slots
+        self.slot_map = {}
+        for i, run in enumerate(assignment):
+            for j, b in enumerate(run):
+                self.slot_map[(i, j)] = b
+        self.mask = jnp.asarray(
+            [[1.0 if (i, j) in self.slot_map else 0.0
+              for j in range(self.num_slots)]
+             for i in range(self.num_stages)], jnp.float32)
+        self.params = self.restack()
+
+    # -- param shuttling ---------------------------------------------------
+    def _slot_block(self, i, j):
+        """Block index backing slot (i, j): the real block, or — for an
+        identity-padded slot — the stage's last real block (its params
+        are copied so the padded compute is well-defined; the mask
+        discards its output and zeroes its grads)."""
+        return self.slot_map.get((i, j), self.assignment[i][-1])
+
+    def restack(self):
+        """(Re-)read the net's Parameters into the stacked pytree
+        (leading dims [pp, num_slots]) including the `__mask__` leaf."""
+        stacked = {}
+        for k in self.param_names:
+            stacked[k] = jnp.stack([
+                jnp.stack([
+                    self._block_params[self._slot_block(i, j)][k]
+                    .data()._data
+                    for j in range(self.num_slots)], axis=0)
+                for i in range(self.num_stages)], axis=0)
+        stacked["__mask__"] = self.mask
+        return stacked
+
+    def unstack_into_net(self, stacked):
+        """Write stacked weights back into the net's Parameters (only
+        real slots; padded copies are dropped)."""
+        for (i, j), b in self.slot_map.items():
+            for k in self.param_names:
+                self._block_params[b][k].data()._data = \
+                    jnp.asarray(stacked[k])[i, j]
+
+    # -- the stage function ------------------------------------------------
+    def make_stage_fn(self, key=None):
+        """stage_fn(stage_params, h) running this stage's block slots in
+        order through block 0's traced form; `key` seeds per-slot
+        dropout (folded by slot index). Padded slots run but their
+        output is discarded by the `__mask__` select."""
+        entry = self._entry
+        names = self.param_names
+        s = self.num_slots
+        if key is None:
+            key = jax.random.PRNGKey(0)
+
+        def stage_fn(p, h):
+            m = p["__mask__"]
+            for j in range(s):
+                pj = {k: p[k][j] for k in names}
+                flat, _ = entry.raw_fn(pj, {},
+                                       jax.random.fold_in(key, j), h)
+                h = jnp.where(m[j] != 0, flat[0], h)
+            return h
+        return stage_fn
+
+    @property
+    def stage_fn(self):
+        return self.make_stage_fn()
+
+    def param_bytes(self):
+        return sum(int(_np.prod(v.shape)) * v.dtype.itemsize
+                   for k, v in self.params.items() if k != "__mask__")
+
+
+def pipeline_stages(net, pp: int, sample=None, cost_model: str = "flops"):
+    """Cut a HybridSequential of shape-preserving blocks into `pp`
+    balanced stages and return a StagedPipeline.
+
+    Balancing uses a per-block cost model: `cost_model="flops"` traces
+    block 0 and reads XLA's FLOPs estimate (all stackable blocks share
+    one traced form, hence one estimate); when the backend reports no
+    FLOPs it falls back to per-block parameter bytes. The partition is
+    the contiguous split minimizing the max stage cost; stages shorter
+    than the longest are identity-padded (see StagedPipeline.params).
+
+    Requirements (clear errors otherwise): at least `pp` blocks, all of
+    one class with identical parameter names/shapes/dtypes (so stage
+    params stack), no aux params (BatchNorm running stats), and each
+    block must map (mb, ...) -> (mb, ...) preserving shape and dtype.
+    `sample` (an example input batch) is required to trace the blocks
+    and finish any deferred parameter initialization.
+    """
+    from ..gluon.block import HybridBlock, Sequential
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    if isinstance(net, Sequential) or hasattr(net, "_children"):
+        blocks = list(net._children.values())
+    else:
+        blocks = list(net)
+    L = len(blocks)
+    if pp < 1 or L < pp:
+        raise ValueError(
+            f"pipeline_stages: need at least pp={pp} blocks to cut "
+            f"into {pp} stages; the net has {L}")
+    if sample is None:
+        raise ValueError(
+            "pipeline_stages needs a sample input batch to trace the "
+            "blocks (pass sample=x)")
+    if not isinstance(sample, NDArray):
+        sample = NDArray(jnp.asarray(sample))
+    for b in blocks:
+        if not isinstance(b, HybridBlock):
+            raise ValueError(
+                f"pipeline_stages: block {type(b).__name__} is not a "
+                "HybridBlock — stages are built from hybridized "
+                "(traced) forms")
+        if type(b) is not type(blocks[0]):
+            raise ValueError(
+                f"pipeline_stages: mixed block classes "
+                f"{type(blocks[0]).__name__} vs {type(b).__name__}; "
+                "stage params stack across blocks, so all blocks must "
+                "share one class/config (wrap heterogeneous layers "
+                "into one repeated block)")
+
+    # finish deferred init with one eager forward through the chain
+    all_params = net.collect_params() if hasattr(net, "collect_params") \
+        else None
+    if all_params is not None and any(
+            p._data is None for p in all_params.values()):
+        with autograd.pause():
+            h = sample
+            for b in blocks:
+                h = b(h)
+
+    block_params = []
+    names0 = None
+    for bi, b in enumerate(blocks):
+        bp = dict(b.collect_params().items())
+        for k, p in bp.items():
+            if p.grad_req == "null":
+                raise ValueError(
+                    f"pipeline_stages: block {bi} has aux parameter "
+                    f"{k!r} (grad_req='null', e.g. BatchNorm running "
+                    "stats) — pipeline stages must be stateless; use "
+                    "LayerNorm-style blocks")
+            if p._data is None:
+                raise ValueError(
+                    f"pipeline_stages: block {bi} parameter {k!r} is "
+                    "uninitialized; call net.initialize() and pass a "
+                    "sample input")
+        keys = sorted(bp)
+        if names0 is None:
+            names0 = keys
+            shapes0 = {k: (tuple(bp[k].data()._data.shape),
+                           bp[k].data()._data.dtype) for k in keys}
+        else:
+            if keys != names0:
+                raise ValueError(
+                    f"pipeline_stages: block {bi} parameters {keys} "
+                    f"do not match block 0's {names0}; blocks must be "
+                    "structurally identical to stack")
+            for k in keys:
+                got = (tuple(bp[k].data()._data.shape),
+                       bp[k].data()._data.dtype)
+                if got != shapes0[k]:
+                    raise ValueError(
+                        f"pipeline_stages: block {bi} parameter {k!r} "
+                        f"has shape/dtype {got} but block 0 has "
+                        f"{shapes0[k]}")
+        block_params.append(bp)
+
+    entry = blocks[0].trace_entry([sample], training=True)
+    if entry.aux_names:
+        raise ValueError(
+            f"pipeline_stages: block 0 traces with aux params "
+            f"{entry.aux_names}; pipeline stages must be stateless")
+    raw = sample._data
+    out_sds = jax.eval_shape(
+        lambda tr, h: entry.raw_fn(tr, {}, jax.random.PRNGKey(0), h)[0],
+        {k: block_params[0][k].data()._data for k in names0}, raw)
+    if len(out_sds) != 1 or out_sds[0].shape != raw.shape or \
+            out_sds[0].dtype != raw.dtype:
+        raise ValueError(
+            f"pipeline_stages: blocks must be shape/dtype-preserving "
+            f"(got {[(o.shape, str(o.dtype)) for o in out_sds]} for "
+            f"input {raw.shape}/{raw.dtype}) — classic GPipe "
+            "constraint, satisfied by transformer blocks")
+
+    costs = _block_costs(blocks, block_params, entry, raw, cost_model)
+    assignment = _balanced_partition(costs, pp)
+    return StagedPipeline(net, blocks, assignment, entry, names0,
+                          block_params, costs,
+                          jax.ShapeDtypeStruct(raw.shape, raw.dtype))
+
+
+def _block_costs(blocks, block_params, entry, raw, cost_model):
+    """Per-block partition weights. "flops": XLA's traced-FLOPs
+    estimate of the block executable (identical-by-construction blocks
+    share one trace); fallback — and `cost_model="bytes"` — is each
+    block's parameter bytes."""
+    bytes_costs = [
+        max(1.0, sum(
+            float(_np.prod(p.data()._data.shape)) *
+            p.data()._data.dtype.itemsize
+            for p in bp.values()))
+        for bp in block_params]
+    if cost_model != "flops":
+        return bytes_costs
+    try:
+        names = sorted(block_params[0])
+        tr0 = {k: block_params[0][k].data()._data for k in names}
+        lowered = jax.jit(
+            lambda tr, h: entry.raw_fn(tr, {}, jax.random.PRNGKey(0),
+                                       h)[0]).lower(tr0, raw)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        if flops > 0:
+            return [flops] * len(blocks)
+    except Exception:
+        pass
+    return bytes_costs

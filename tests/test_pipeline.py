@@ -1,4 +1,8 @@
-"""GPipe pipeline parallelism ≡ sequential stage application (SURVEY §4)."""
+"""Pipeline parallelism: GPipe / 1F1B schedules ≡ sequential stage
+application (SURVEY §4), auto-staging of HybridSequential, and the
+FusedTrainStep(pipeline=M) training path incl. ZeRO composition."""
+import warnings
+
 import numpy as np
 import pytest
 
@@ -6,8 +10,10 @@ import jax
 import jax.numpy as jnp
 
 from mxnet_tpu.parallel import make_mesh, set_mesh
+from mxnet_tpu.parallel.mesh import hybrid_mesh, local_mesh
 from mxnet_tpu.parallel.pipeline import (
-    gpipe, sequential_apply, stack_stage_params)
+    bubble_ratio, gpipe, one_f_one_b, pipeline_stages, sequential_apply,
+    stack_stage_params, stash_slots)
 
 pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
                                 reason="needs 8 virtual devices")
@@ -152,3 +158,393 @@ def test_1f1b_under_jit(pp_mesh):
         np.testing.assert_allclose(np.asarray(grads[k]),
                                    np.asarray(grads_ref[k]),
                                    rtol=1e-4, atol=1e-5)
+
+
+# -- schedule-equivalence fuzz grids ----------------------------------------
+# random (num_stages, M, mb, dtype) including M < n and M not a
+# multiple of the in-flight slot count; each case builds its own pp mesh
+
+_FUZZ_GRID = [
+    (2, 3, 2, "float32"),   # M not a multiple of n
+    (4, 2, 2, "float32"),   # M < n (pipeline mostly bubble)
+    (3, 5, 1, "float32"),   # mb=1, n does not divide M
+    (8, 4, 2, "float32"),   # all 8 devices, M < n
+    (4, 8, 3, "bfloat16"),  # bf16 end to end
+]
+
+
+def _fuzz_case(n, M, mb, dtype, seed):
+    rs = np.random.RandomState(seed)
+    d = 6
+    params = stack_stage_params(
+        [{"w1": jnp.asarray(rs.randn(d, 10) * 0.3, dtype),
+          "b1": jnp.asarray(rs.randn(10) * 0.1, dtype),
+          "w2": jnp.asarray(rs.randn(10, d) * 0.3, dtype),
+          "b2": jnp.asarray(rs.randn(d) * 0.1, dtype)}
+         for _ in range(n)])
+    x = jnp.asarray(rs.rand(M * mb, d), dtype)
+    y = jnp.asarray(rs.rand(M * mb, d), dtype)
+    return params, x, y
+
+
+@pytest.mark.parametrize("n,M,mb,dtype", _FUZZ_GRID)
+def test_fuzz_gpipe_equals_sequential(n, M, mb, dtype):
+    params, x, _ = _fuzz_case(n, M, mb, dtype, seed=n * 100 + M)
+    mesh = make_mesh([n], ["pp"])
+    ref = sequential_apply(_stage_fn, params, x)
+    out = gpipe(_stage_fn, params, x, M, mesh=mesh)
+    assert out.dtype == ref.dtype
+    tol = 1e-5 if dtype == "float32" else 5e-2
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=tol)
+
+
+@pytest.mark.parametrize("n,M,mb,dtype", _FUZZ_GRID)
+def test_fuzz_1f1b_equals_sequential(n, M, mb, dtype):
+    params, x, y = _fuzz_case(n, M, mb, dtype, seed=n * 10 + M)
+    mesh = make_mesh([n], ["pp"])
+    loss, grads = one_f_one_b(_stage_fn, params, x, y, _mse_loss, M,
+                              mesh=mesh)
+    loss_ref, grads_ref = one_f_one_b(_stage_fn, params, x, y,
+                                      _mse_loss, M, mesh=None)
+    if dtype == "float32":
+        assert np.allclose(float(loss), float(loss_ref), atol=1e-5)
+        for k in grads_ref:
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(grads_ref[k]),
+                                       rtol=1e-4, atol=1e-5), k
+    else:
+        # bf16 end to end: schedule vs sequential differ only by
+        # accumulation order, bounded by bf16 resolution
+        assert abs(float(loss) - float(loss_ref)) < 0.05
+        for k in grads_ref:
+            np.testing.assert_allclose(
+                np.asarray(grads[k], np.float32),
+                np.asarray(grads_ref[k], np.float32),
+                rtol=0.2, atol=0.08), k
+
+
+def test_1f1b_bf16_keeps_loss_and_cotangent_dtype(pp_mesh):
+    # the loss accumulator matches the loss dtype (not hardcoded fp32)
+    # and cotangents ride the pipeline in the activation dtype
+    params, x, y = _fuzz_case(4, 4, 2, "bfloat16", seed=21)
+    loss, grads = one_f_one_b(_stage_fn, params, x, y, _mse_loss, 4,
+                              mesh=pp_mesh)
+    assert loss.dtype == jnp.bfloat16
+    assert grads["w1"].dtype == jnp.bfloat16
+    loss_f, grads_f = one_f_one_b(_stage_fn, params, x, y, _mse_loss, 4,
+                                  mesh=None)
+    assert loss_f.dtype == jnp.bfloat16
+
+
+def test_stack_stage_params_mismatch_errors():
+    # shape mismatch names the stage index
+    with pytest.raises(ValueError, match="stage 1"):
+        stack_stage_params([{"w": jnp.zeros((2, 3))},
+                            {"w": jnp.zeros((3, 3))}])
+    # dtype mismatch too
+    with pytest.raises(ValueError, match="stage 2"):
+        stack_stage_params([{"w": jnp.zeros((2,))},
+                            {"w": jnp.zeros((2,))},
+                            {"w": jnp.zeros((2,), jnp.bfloat16)}])
+    # treedef mismatch
+    with pytest.raises(ValueError, match="stage 1.*structure"):
+        stack_stage_params([{"w": jnp.zeros((2,))},
+                            {"v": jnp.zeros((2,))}])
+    with pytest.raises(ValueError, match="empty"):
+        stack_stage_params([])
+
+
+def test_bubble_math_helpers():
+    assert bubble_ratio(4, 8) == pytest.approx(3 / 11)
+    assert bubble_ratio(1, 8) == 0.0
+    assert stash_slots(4) == 7   # O(num_stages), not O(M)
+    assert stash_slots(1) == 1
+
+
+# -- auto-staging a HybridSequential ----------------------------------------
+
+def _dense_chain(n_blocks, d=8, seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import HybridSequential
+    net = HybridSequential()
+    for _ in range(n_blocks):
+        net.add(nn.Dense(d, activation="tanh", in_units=d,
+                         flatten=False))
+    mx.random.seed(seed)
+    net.initialize()
+    return net
+
+
+def test_pipeline_stages_balanced_and_equivalent():
+    from mxnet_tpu.ndarray import NDArray
+    net = _dense_chain(6)
+    x = NDArray(jnp.asarray(np.random.RandomState(0).rand(8, 8),
+                            jnp.float32))
+    ref = net(x)._data
+    staged = pipeline_stages(net, 4, sample=x)
+    # 6 blocks over 4 stages: contiguous, non-empty, max 2 slots,
+    # short stages identity-padded via the mask
+    assert [b for run in staged.assignment for b in run] == list(range(6))
+    assert all(run for run in staged.assignment)
+    assert staged.num_slots == 2
+    assert staged.mask.shape == (4, 2)
+    assert float(staged.mask.sum()) == 6.0
+    out = sequential_apply(staged.stage_fn, staged.params, x._data)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6)
+    mesh = make_mesh([4], ["pp"])
+    # restack() commits leaves to the default device; detach so the
+    # 4-device pp mesh can place them
+    host = jax.tree_util.tree_map(lambda a: jnp.asarray(np.asarray(a)),
+                                  staged.params)
+    out_p = gpipe(staged.stage_fn, host, x._data, 4, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_pipeline_stages_padded_slots_get_zero_grads():
+    from mxnet_tpu.ndarray import NDArray
+    net = _dense_chain(3)
+    x = NDArray(jnp.asarray(np.random.RandomState(1).rand(8, 8),
+                            jnp.float32))
+    staged = pipeline_stages(net, 2, sample=x)   # stages of 2 and 1
+    y = jnp.asarray(np.random.RandomState(2).rand(8, 8), jnp.float32)
+    mesh = make_mesh([2], ["pp"])
+    host = jax.tree_util.tree_map(lambda a: jnp.asarray(np.asarray(a)),
+                                  staged.params)
+    _, grads = one_f_one_b(staged.stage_fn, host, x._data, y,
+                           _mse_loss, 2, mesh=mesh)
+    pad_i, pad_j = [(i, j) for i in range(2) for j in range(2)
+                    if (i, j) not in staged.slot_map][0]
+    for k in staged.param_names:
+        g = np.asarray(grads[k])
+        assert np.all(g[pad_i, pad_j] == 0.0), k  # masked slot: no grad
+        assert np.any(g != 0.0), k                # real slots learn
+
+
+def test_hybrid_sequential_pipeline_stages_method():
+    from mxnet_tpu.ndarray import NDArray
+    net = _dense_chain(4)
+    x = NDArray(jnp.asarray(np.random.RandomState(3).rand(4, 8),
+                            jnp.float32))
+    staged = net.pipeline_stages(2, sample=x)
+    assert staged.num_stages == 2 and staged.num_slots == 2
+    out = sequential_apply(staged.stage_fn, staged.params, x._data)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(net(x)._data),
+                               atol=1e-6)
+
+
+def test_pipeline_stages_clear_errors():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import HybridSequential
+    from mxnet_tpu.ndarray import NDArray
+    import mxnet_tpu as mx
+    x = NDArray(jnp.zeros((4, 8), jnp.float32))
+
+    net = _dense_chain(2)
+    with pytest.raises(ValueError, match="at least pp=4 blocks"):
+        pipeline_stages(net, 4, sample=x)
+    with pytest.raises(ValueError, match="sample"):
+        pipeline_stages(_dense_chain(4), 2)
+
+    mixed = HybridSequential()
+    mixed.add(nn.Dense(8, in_units=8, flatten=False))
+    mixed.add(nn.Activation("tanh"))
+    mx.random.seed(0)
+    mixed.initialize()
+    with pytest.raises(ValueError, match="mixed block classes"):
+        pipeline_stages(mixed, 2, sample=x)
+
+    hetero = HybridSequential()
+    hetero.add(nn.Dense(8, in_units=8, flatten=False))
+    hetero.add(nn.Dense(8, in_units=8, use_bias=False, flatten=False))
+    mx.random.seed(0)
+    hetero.initialize()
+    with pytest.raises(ValueError, match="block 1"):
+        pipeline_stages(hetero, 2, sample=x)
+
+    widen = HybridSequential()
+    widen.add(nn.Dense(16, in_units=8, flatten=False))
+    widen.add(nn.Dense(16, in_units=16, flatten=False))
+    mx.random.seed(0)
+    widen.initialize()
+    with pytest.raises(ValueError, match="block 1 parameter"):
+        # same class but different shapes -> not stackable
+        pipeline_stages(widen, 2, sample=x)
+
+    bn = HybridSequential()
+    bn.add(nn.BatchNorm(in_channels=8))
+    bn.add(nn.BatchNorm(in_channels=8))
+    mx.random.seed(0)
+    bn.initialize()
+    with pytest.raises(ValueError, match="aux parameter"):
+        pipeline_stages(bn, 2, sample=x)
+
+
+# -- FusedTrainStep(pipeline=M): the 1F1B training path ---------------------
+
+def _fused_run(pipeline, zero, mesh, opt_name="sgd", opt_kw=None,
+               steps=3, seed=0, n_blocks=8, **fkw):
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    net = _dense_chain(n_blocks, seed=seed)
+    opt = opt_mod.create(opt_name, **(opt_kw or {"learning_rate": 0.1,
+                                                 "momentum": 0.9}))
+    step = FusedTrainStep(net, L2Loss(), opt, mesh=mesh,
+                          pipeline=pipeline, zero=zero, **fkw)
+    rs = np.random.RandomState(42)
+    losses = []
+    for _ in range(steps):
+        x = NDArray(jnp.asarray(rs.rand(32, 8), jnp.float32))
+        y = NDArray(jnp.asarray(rs.rand(32, 8), jnp.float32))
+        losses.append(float(step(x, y)))
+    step.sync_to_params()
+    weights = {k: np.asarray(p.data()._data)
+               for k, p in net.collect_params().items()}
+    return losses, weights, step
+
+
+def test_fused_pipeline_pp_dp_zero1_parity_sgd():
+    # acceptance: pp=4 x dp=2, pipeline=8, zero=1 matches the
+    # unpipelined dp=8 reference (SGD at float-rounding level)
+    l_ref, w_ref, _ = _fused_run(None, None, local_mesh(8))
+    l_pp, w_pp, step = _fused_run(8, 1, hybrid_mesh(dp=2, pp=4))
+    assert step.zero_stage == 1 and step._pp_staged is not None
+    np.testing.assert_allclose(l_pp, l_ref, atol=1e-6)
+    for k in w_ref:
+        np.testing.assert_allclose(w_pp[k], w_ref[k], atol=1e-6), k
+
+
+def test_fused_pipeline_pp_dp_zero1_parity_adam():
+    kw = dict(opt_name="adam", opt_kw={"learning_rate": 0.01})
+    l_ref, w_ref, _ = _fused_run(None, None, local_mesh(8), **kw)
+    l_pp, w_pp, _ = _fused_run(8, 1, hybrid_mesh(dp=2, pp=4), **kw)
+    np.testing.assert_allclose(l_pp, l_ref, atol=1e-5)
+    for k in w_ref:
+        np.testing.assert_allclose(w_pp[k], w_ref[k], atol=1e-5), k
+
+
+@pytest.mark.slow
+def test_fused_pipeline_zero2_and_accum_parity():
+    kw = dict(opt_name="adam", opt_kw={"learning_rate": 0.01})
+    l_ref, w_ref, _ = _fused_run(None, None, local_mesh(8),
+                                 grad_accum=2, **kw)
+    l_pp, w_pp, _ = _fused_run(4, 2, hybrid_mesh(dp=2, pp=4),
+                               grad_accum=2, **kw)
+    np.testing.assert_allclose(l_pp, l_ref, atol=1e-5)
+    for k in w_ref:
+        np.testing.assert_allclose(w_pp[k], w_ref[k], atol=1e-5), k
+
+
+@pytest.mark.slow
+def test_fused_pipeline_compression_composes_with_zero():
+    # int8 codes ride the dp collective; zero=1 must be bit-identical
+    # to the unsharded compressed pipeline update
+    comp = {"type": "int8"}
+    _, w0, _ = _fused_run(8, None, hybrid_mesh(dp=2, pp=4),
+                          compression=comp)
+    _, w1, _ = _fused_run(8, 1, hybrid_mesh(dp=2, pp=4),
+                          compression=comp)
+    for k in w0:
+        np.testing.assert_allclose(w1[k], w0[k], atol=0), k
+
+
+def test_fused_pipeline_degrades_without_pp_axis():
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        l_d, w_d, step = _fused_run(8, None, local_mesh(8))
+    assert any("no 'pp' axis" in str(w.message) for w in wlist)
+    assert step._pp_staged is None  # plain path, sequential semantics
+    l_ref, w_ref, _ = _fused_run(None, None, local_mesh(8))
+    np.testing.assert_allclose(l_d, l_ref, atol=0)
+    for k in w_ref:
+        np.testing.assert_allclose(w_d[k], w_ref[k], atol=0), k
+
+
+def test_fused_pipeline_norm_rule_degrades_zero():
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        _, _, step = _fused_run(8, 1, hybrid_mesh(dp=2, pp=4),
+                                opt_name="lamb",
+                                opt_kw={"learning_rate": 0.01}, steps=1)
+    assert any("elementwise update rule" in str(w.message)
+               for w in wlist)
+    assert step.zero_stage == 0  # unsharded; per-slot vmap keeps norms
+
+
+def test_fused_pipeline_zero3_clamps_to_2():
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        _, _, step = _fused_run(4, 3, hybrid_mesh(dp=2, pp=4), steps=1)
+    assert any("clamped to zero=2" in str(w.message) for w in wlist)
+    assert step.zero_stage == 2
+
+
+def test_fused_pipeline_batch_divisibility_error():
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    net = _dense_chain(4)
+    step = FusedTrainStep(net, L2Loss(),
+                          opt_mod.create("sgd", learning_rate=0.1),
+                          mesh=hybrid_mesh(dp=2, pp=4), pipeline=8)
+    x = NDArray(jnp.zeros((24, 8), jnp.float32))  # 24 % (2*8) != 0
+    with pytest.raises(ValueError, match="must divide"):
+        step(x, x)
+
+
+def test_fused_pipeline_telemetry_bubble_ratio():
+    from mxnet_tpu import telemetry as tm
+    tm.disable()
+    tm.reset()
+    try:
+        tm.enable()
+        _fused_run(8, None, hybrid_mesh(dp=1, pp=4), steps=2,
+                   n_blocks=4)
+        snap = tm.snapshot()
+        assert snap["gauges"]["pipeline_bubble_ratio"] == \
+            pytest.approx(bubble_ratio(4, 8))
+        hist = snap["histograms"]["step_time_breakdown{phase=pipeline_fill}"]
+        assert hist["count"] >= 2
+        assert "step_time_breakdown{phase=pipeline_steady}" in \
+            snap["histograms"]
+        assert "step_time_breakdown{phase=pipeline_drain}" in \
+            snap["histograms"]
+    finally:
+        tm.disable()
+        tm.reset()
+
+
+def test_fused_pipeline_resident_bytes_pp_sharded():
+    _, _, step = _fused_run(8, 1, hybrid_mesh(dp=2, pp=4), steps=1)
+    res = step.fused_resident_bytes()
+    tot = sum(v.nbytes for v in jax.tree_util.tree_leaves(step._tr))
+    # stacked weights shard over pp: per-replica is global/4
+    assert res["weights"] == tot // 4
+    assert res["opt_state"] > 0
+
+
+def test_trainer_pipeline_passthrough():
+    from mxnet_tpu.gluon.trainer import Trainer
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+    net = _dense_chain(4)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, pipeline=4)
+    step = FusedTrainStep(net, L2Loss(), trainer,
+                          mesh=hybrid_mesh(dp=2, pp=4))
+    assert step.pipeline == 4
+    x = NDArray(jnp.asarray(np.random.RandomState(5).rand(16, 8),
+                            jnp.float32))
+    float(step(x, x))  # builds and runs the pipelined executable
+    assert step._pp_staged is not None
+    with pytest.raises(ValueError, match="positive microbatch"):
+        Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                pipeline=0)
