@@ -1,0 +1,99 @@
+"""Continuous-batching serving demo: train a tiny Llama on a toy
+pattern, then push a mixed batch of requests through
+mx.serving.InferenceServer — paged KV cache, one shared decode
+executable, per-request sampling params — and compare a greedy
+request's output against one-shot generate().
+
+Usage: python examples/llama_serve.py [--cpu] [--steps 200]
+                                      [--requests 8]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.llama_infer import generate
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    mx.random.seed(0)
+    net = mx.models.get_model("llama_tiny")
+    net.initialize()
+
+    # toy language: sequences count upward mod 50 from a random start
+    rs = np.random.RandomState(0)
+    ce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return ce(logits.reshape(-1, 256), labels.reshape(-1))
+
+    step = FusedTrainStep(net, lm_loss,
+                          mx.optimizer.AdamW(learning_rate=3e-3))
+    for i in range(args.steps):
+        start = rs.randint(0, 50, (16, 1))
+        seq = (start + np.arange(33)) % 50
+        l = step(mx.nd.array(seq[:, :-1], dtype="int32"),
+                 mx.nd.array(seq[:, 1:], dtype="int32"))
+        if (i + 1) % 50 == 0:
+            print(f"step {i + 1}: loss {float(l.asscalar()):.4f}")
+    step.sync_to_params()
+
+    telemetry.enable()
+    server = mx.serving.InferenceServer(net, batch_slots=4, max_len=64,
+                                        block_size=8,
+                                        max_prompt_len=16)
+    reqs = []
+    for i in range(args.requests):
+        start = int(rs.randint(0, 50))
+        T = int(rs.randint(3, 9))
+        prompt = (start + np.arange(T)) % 50
+        # even requests greedy, odd ones sampled — both ride the SAME
+        # compiled decode tick via per-row sampling params
+        kw = {} if i % 2 == 0 else dict(temperature=0.7, top_k=5,
+                                        seed=i)
+        reqs.append((prompt, server.submit(prompt.astype(np.int32),
+                                           max_new_tokens=10, **kw)))
+    server.run()
+
+    for prompt, r in reqs:
+        kind = "greedy " if r.temperature == 0.0 else "sampled"
+        print(f"req {r.id} ({kind}) {prompt.tolist()} -> "
+              f"{r.output_tokens}  ttft={r.ttft * 1e3:.1f}ms")
+
+    # the greedy rows are token-identical to one-shot generate()
+    prompt, r = reqs[0]
+    one = generate(net, prompt[None, :].astype(np.int32),
+                   max_new_tokens=10, max_len=64)
+    match = r.output_tokens == one[0, len(prompt):].tolist()
+    print("parity with one-shot generate():", match)
+
+    st = server.stats()
+    print(f"stats: {st['ticks']} ticks, {st['tokens_generated']} "
+          f"tokens, prefill_compiles={st['prefill_compiles']} "
+          f"decode_compiles={st['decode_compiles']} "
+          f"kv_utilization={st['kv_utilization']:.2f}")
+    snap = telemetry.snapshot()
+    ttft = snap["histograms"]["serving_ttft_seconds"]
+    print(f"TTFT p50 {ttft['p50'] * 1e3:.1f}ms / p95 "
+          f"{ttft['p95'] * 1e3:.1f}ms over {ttft['count']} requests")
+    if not match:
+        raise SystemExit("serving output diverged from generate()")
+
+
+if __name__ == "__main__":
+    main()

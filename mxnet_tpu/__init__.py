@@ -35,6 +35,7 @@ from . import numpy as np              # reference: from mxnet import np
 from . import numpy_extension as npx   # reference: from mxnet import npx
 from . import gluon
 from . import models
+from . import serving
 from . import amp
 from . import callback
 from . import checkpoint

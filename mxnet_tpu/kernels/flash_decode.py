@@ -27,7 +27,9 @@ from .dispatch import KernelFallback
 
 __all__ = ["flash_decode", "flash_decode_quantized",
            "quantize_kv", "dequantize_kv",
-           "reference_decode_attention"]
+           "reference_decode_attention",
+           "gather_kv_pages", "flash_decode_paged",
+           "flash_decode_paged_quantized"]
 
 _fallback = KernelFallback("flash-decode",
                            strict_envs=("MXNET_TPU_STRICT_FLASH",))
@@ -155,6 +157,56 @@ def flash_decode(q, k_cache, v_cache, valid_len, scale=None,
             _fallback.note(e)
     return reference_decode_attention(q, k_cache, v_cache, valid_len,
                                       scale)
+
+
+# -- paged (block-allocated) KV cache ---------------------------------------
+# The serving engine (mxnet_tpu/serving/) stores the cache as a pool of
+# fixed-size blocks shared by all sequences; a per-sequence block table
+# maps logical block index -> physical block id. The decode kernel
+# itself is unchanged: the gather below materializes each sequence's
+# logical (K, S, d) view from its table and the existing flash sweep
+# runs on it. (An in-kernel path that DMAs blocks from HBM by table
+# lookup — no gather materialization — is the TPU follow-up; see
+# ROADMAP.)
+
+def gather_kv_pages(pages, block_tables):
+    """Gather per-sequence logical caches from a paged pool.
+
+    pages: (N, K, bs, ...) physical blocks (block 0 is the serving
+    layer's scratch sink); block_tables: (B, nb) int32 physical block
+    ids in logical order. Returns (B, K, nb*bs, ...) — the
+    cache-native layout flash_decode expects. Stale data in
+    unallocated/padded blocks is masked downstream by valid_len."""
+    g = jnp.take(pages, block_tables, axis=0)        # (B, nb, K, bs, .)
+    g = jnp.moveaxis(g, 2, 1)                        # (B, K, nb, bs, .)
+    B, K, nb, bs = g.shape[:4]
+    return g.reshape((B, K, nb * bs) + g.shape[4:])
+
+
+def flash_decode_paged(q, k_pages, v_pages, block_tables, valid_len,
+                       scale=None, use_flash=True):
+    """Block-table-aware decode attention: gather the sequences'
+    logical caches from the page pool, then the standard flash sweep.
+    The gathered view is value-identical to a contiguous cache at every
+    position < valid_len, so outputs match the contiguous path
+    exactly."""
+    k = gather_kv_pages(k_pages, block_tables)
+    v = gather_kv_pages(v_pages, block_tables)
+    return flash_decode(q, k, v, valid_len, scale=scale,
+                        use_flash=use_flash)
+
+
+def flash_decode_paged_quantized(q, k8_pages, ks_pages, v8_pages,
+                                 vs_pages, block_tables, valid_len,
+                                 scale=None, use_flash=True):
+    """Paged variant of flash_decode_quantized: int8 blocks + per-token
+    scale blocks gathered by the same table."""
+    k8 = gather_kv_pages(k8_pages, block_tables)
+    ks = gather_kv_pages(ks_pages, block_tables)
+    v8 = gather_kv_pages(v8_pages, block_tables)
+    vs = gather_kv_pages(vs_pages, block_tables)
+    return flash_decode_quantized(q, k8, ks, v8, vs, valid_len,
+                                  scale=scale, use_flash=use_flash)
 
 
 # -- int8-quantized KV cache ------------------------------------------------

@@ -6,7 +6,13 @@ TPU-first: one jitted prefill (prompt forward that fills the cache) and
 one jitted `lax.scan` over decode steps — static shapes throughout (the
 cache is allocated at `max_len` up front), so the whole generation loop
 is exactly two XLA executables regardless of prompt/output length.
-Greedy or temperature/top-k sampling via functional RNG keys.
+Both are PERSISTENT: they are built once per (shape, max_len, cache
+dtype, sampling mode) signature and cached on the net through
+mxnet_tpu.serving.executables, so repeat calls never retrace — the
+continuous-batching server (mxnet_tpu/serving/) rides the same cache
+with paged variants. Greedy or temperature/top-k/top-p sampling via
+functional RNG keys; sampling params are traced per-row vectors, so
+changing them never recompiles.
 
     net = mx.models.get_model("llama_tiny"); net.initialize()
     out = generate(net, prompt_ids, max_new_tokens=32, temperature=0.8)
@@ -14,7 +20,6 @@ Greedy or temperature/top-k sampling via functional RNG keys.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional
 
 import numpy as _np
@@ -189,60 +194,118 @@ def build_decoder(net, max_len: int, kv_cache_dtype: str = "model"):
 def generate(net, prompt_ids, max_new_tokens: int, temperature=0.0,
              top_k: int = 0, top_p: float = 0.0, seed: int = 0,
              max_len: Optional[int] = None,
-             kv_cache_dtype: str = "model"):
+             kv_cache_dtype: str = "model",
+             valid_len=None, eos_id: Optional[int] = None,
+             return_finished: bool = False):
     """Autoregressive generation. prompt_ids: (B, T) NDArray/array of
-    int32 (right-pad shorter rows with any token and pass
-    `valid_len`-style ragged prompts as equal lengths for now).
+    int32. Ragged prompts: right-pad shorter rows with any token and
+    pass per-row true lengths as `valid_len` (B,) — padded positions
+    are masked in prefill and each row's continuation starts at its
+    own length. Generated tokens occupy columns [T, T+max_new) of the
+    output regardless of the row's valid length.
+
     temperature 0 = greedy; top_k keeps the k best logits; top_p keeps
-    the smallest nucleus whose probability mass reaches p (both compose
-    with temperature). Returns (B, T + max_new_tokens) numpy."""
+    the smallest nucleus whose probability mass reaches p (both
+    compose with temperature). Scalars broadcast, or pass (B,) arrays
+    for per-row sampling params.
+
+    eos_id: rows freeze after emitting eos (remaining columns filled
+    with eos) and decoding runs in fixed-size chunks so an early
+    all-rows-finished batch stops paying for the tail.
+    return_finished=True additionally returns (B,) finish positions —
+    the index of eos within the generated tokens, or -1.
+
+    Executables (prefill + scanned decode chunk) are built once per
+    (shape, max_len, cache dtype, greedy/sample) signature and cached
+    on the net via mxnet_tpu.serving.executables — repeat calls are
+    warm, and sampling params never retrace (they are traced
+    vectors). Returns (B, T + max_new_tokens) numpy."""
+    from ..serving import executables as _exe
+
     ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
         else jnp.asarray(prompt_ids)
     ids = ids.astype(jnp.int32)
     B, T = ids.shape
     cfg = net.model.cfg
-    max_len = max_len or min(cfg.max_seq_len, T + max_new_tokens)
+    if valid_len is None:
+        valid = jnp.full((B,), T, jnp.int32)
+    else:
+        valid = jnp.asarray(
+            valid_len.asnumpy() if isinstance(valid_len, NDArray)
+            else valid_len).astype(jnp.int32).reshape(B)
+        if not bool(jnp.all((valid >= 1) & (valid <= T))):
+            raise ValueError("valid_len entries must lie in [1, T]")
+
+    greedy = temperature is None or (
+        _np.ndim(temperature) == 0 and float(temperature) <= 0.0)
+    mode = "greedy" if greedy else "sample"
+
+    # chunked decode: with an eos the scan runs CHUNK tokens at a
+    # time so a finished batch exits early (and the chunk executable
+    # is reused across every max_new_tokens). Without an eos a single
+    # full-length chunk preserves the exact legacy cache footprint.
+    if eos_id is None:
+        chunk = max_new_tokens
+    else:
+        chunk = min(8, max_new_tokens)
+    n_chunks = -(-max_new_tokens // chunk)
+    padded_new = n_chunks * chunk
+    cap = max_len or cfg.max_seq_len
+    if T + padded_new > cap:          # cap hit: one exact-size chunk
+        chunk, n_chunks, padded_new = max_new_tokens, 1, max_new_tokens
+    if max_len is None:
+        max_len = min(cfg.max_seq_len, T + padded_new)
     assert T + max_new_tokens <= max_len, "max_len too small"
-    params, prefill, step = build_decoder(net, max_len,
-                                          kv_cache_dtype=kv_cache_dtype)
-    valid = jnp.full((B,), T, jnp.int32)
-    cache, logits = jax.jit(prefill)(params, ids, valid)
 
-    def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        lg = logits.astype(jnp.float32) / temperature
-        if top_k:
-            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-            lg = jnp.where(lg < kth, -jnp.inf, lg)
-        if top_p and 0.0 < top_p < 1.0:
-            # nucleus: drop tokens outside the smallest prefix (by
-            # descending prob) whose cumulative mass reaches top_p;
-            # the top token always survives
-            sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
-            probs = jax.nn.softmax(sorted_lg, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p        # prefix mass < p
-            # threshold logit = smallest kept logit per row
-            thresh = jnp.min(
-                jnp.where(keep_sorted, sorted_lg, jnp.inf),
-                axis=-1, keepdims=True)
-            lg = jnp.where(lg < thresh, -jnp.inf, lg)
-        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    dec = _exe.decoder_programs(net, max_len, kv_cache_dtype)
+    scan = _exe.scan_program(net, max_len, kv_cache_dtype, mode)
+    params = _params_tree(net)
+    cache, logits = dec["prefill"](params, ids, valid)
 
-    key = jax.random.PRNGKey(seed)
+    as_vec = lambda v, dt: jnp.broadcast_to(
+        jnp.asarray(v, dt), (B,)) if v is not None \
+        else jnp.zeros((B,), dt)
+    temps = as_vec(temperature, jnp.float32)
+    ks = as_vec(top_k, jnp.int32)
+    ps = as_vec(top_p, jnp.float32)
+    eos = jnp.int32(-1 if eos_id is None else eos_id)
+    finished = jnp.zeros((B,), bool)
+    pos = valid
 
-    def scan_body(carry, key_i):
-        cache, logits, pos = carry
-        tok = pick(logits, key_i)
-        cache, logits = step(params, cache, pos, tok)
-        return (cache, logits, pos + 1), tok
+    if mode == "sample":
+        all_keys = jax.random.split(jax.random.PRNGKey(seed),
+                                    n_chunks * chunk)
+    else:  # scanned over but never read
+        all_keys = jnp.zeros((n_chunks * chunk, 2), jnp.uint32)
 
-    keys = jax.random.split(key, max_new_tokens)
-    scan = jax.jit(partial(lax.scan, scan_body))
-    (_, _, _), toks = scan((cache, logits, valid), keys)
-    out = jnp.concatenate([ids, toks.T], axis=1)
-    return _np.asarray(out)
+    pieces = []
+    emitted = 0
+    for c in range(n_chunks):
+        cache, logits, pos, finished, toks = scan(
+            params, cache, logits, pos, finished, eos, temps, ks, ps,
+            all_keys[c * chunk:(c + 1) * chunk])
+        pieces.append(_np.asarray(toks))         # (chunk, B)
+        emitted += chunk
+        if eos_id is not None and emitted < padded_new \
+                and bool(_np.asarray(finished).all()):
+            # every row froze: the remaining scans would only emit
+            # eos — skip them (the early exit the satellite asks for)
+            pieces.append(_np.full((padded_new - emitted, B), eos_id,
+                                   _np.int32))
+            break
+
+    toks = _np.concatenate(pieces, axis=0)[:max_new_tokens]
+    out = _np.concatenate([_np.asarray(ids), toks.T.astype(_np.int32)],
+                          axis=1)
+    if not return_finished:
+        return out
+    gen = out[:, T:]
+    if eos_id is None:
+        finish_pos = _np.full((B,), -1, _np.int64)
+    else:
+        hit = gen == eos_id
+        finish_pos = _np.where(hit.any(axis=1), hit.argmax(axis=1), -1)
+    return out, finish_pos
 
 
 def generate_beam(net, prompt_ids, max_new_tokens: int, beam_size=4,
@@ -256,6 +319,8 @@ def generate_beam(net, prompt_ids, max_new_tokens: int, beam_size=4,
     top-k over (B, W*V). Finished beams are frozen by forcing eos at
     log-prob 0. Returns (B, T + max_new_tokens) numpy — the best beam
     per batch row under score / len**length_penalty."""
+    from ..serving import executables as _exe
+
     ids = prompt_ids._data if isinstance(prompt_ids, NDArray) \
         else jnp.asarray(prompt_ids)
     ids = ids.astype(jnp.int32)
@@ -264,10 +329,13 @@ def generate_beam(net, prompt_ids, max_new_tokens: int, beam_size=4,
     cfg = net.model.cfg
     max_len = max_len or min(cfg.max_seq_len, T + max_new_tokens)
     assert T + max_new_tokens <= max_len, "max_len too small"
-    params, prefill, step = build_decoder(net, max_len,
-                                          kv_cache_dtype=kv_cache_dtype)
+    # persistent executables shared with generate(): prefill and the
+    # (B*W)-row step compile once per signature and stay cached
+    dec = _exe.decoder_programs(net, max_len,
+                                kv_cache_dtype=kv_cache_dtype)
+    params = _params_tree(net)
     valid = jnp.full((B,), T, jnp.int32)
-    cache, logits = jax.jit(prefill)(params, ids, valid)
+    cache, logits = dec["prefill"](params, ids, valid)
 
     # expand every batch row to W beams (contiguous blocks of W)
     rep = lambda x: jnp.repeat(x, W, axis=0)
@@ -284,7 +352,7 @@ def generate_beam(net, prompt_ids, max_new_tokens: int, beam_size=4,
 
     from .beam_search import beam_expand_topk
 
-    jstep = jax.jit(step)
+    jstep = dec["step"]
     for t in range(max_new_tokens):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1) \
             .reshape(B, W, V)

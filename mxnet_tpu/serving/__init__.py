@@ -1,0 +1,33 @@
+"""Continuous-batching inference serving (mx.serving).
+
+The production half of the north star: flash-decode inference behind a
+request scheduler instead of one-shot `generate()` calls.
+
+- `kv_cache.PagedKVCache` — block-allocated KV pool; sequences of
+  different lengths share one fixed-shape decode batch through
+  per-sequence block tables.
+- `executables` — persistent compiled prefill/decode `Program`s with
+  compile/hit accounting (also the executable cache behind
+  `generate()` — its per-call retrace is gone).
+- `server.InferenceServer` — continuous batching: admit into free
+  batch slots and evict finished sequences every decode tick, with
+  per-request sampling params inside the one shared executable and
+  TTFT / tokens-per-sec-per-chip / queue-depth telemetry.
+
+    server = mx.serving.InferenceServer(net, batch_slots=8,
+                                        max_len=256)
+    reqs = [server.submit(p, max_new_tokens=32, temperature=0.8)
+            for p in prompts]
+    server.run()
+
+See docs/serving.md for the architecture and the block-table math.
+"""
+from . import kv_cache
+from . import sampling
+from . import executables
+from . import server
+from .kv_cache import PagedKVCache
+from .server import InferenceServer, Request
+
+__all__ = ["PagedKVCache", "InferenceServer", "Request",
+           "kv_cache", "sampling", "executables", "server"]

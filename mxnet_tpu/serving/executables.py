@@ -1,0 +1,303 @@
+"""Persistent compiled prefill/decode executables.
+
+Before this module, every `generate()` call rebuilt `build_decoder`'s
+closures and wrapped them in FRESH `jax.jit` objects — a full retrace
++ XLA compile per call (benchmarks/decode_bench.py had to
+difference-time around it). Here every executable is a `Program`: a
+named, compile-counting `jax.jit` wrapper cached on the net object by
+its build signature. Callers get back the SAME jit object for the
+same signature, so jit's own shape-keyed cache makes repeat calls
+genuinely warm, and the counters prove it:
+
+- trace-time side effect counts compiles (the counted body only runs
+  when jit misses);
+- every call records a hit or a compile (with wall seconds) into
+  `tracing.cache_stats()` under the program's name — the serving
+  acceptance bar ("exactly one prefill compile + one decode compile
+  for a 16-request mixed workload") is asserted against these.
+
+Three program families:
+
+- `decoder_programs(net, max_len, kv_cache_dtype)`: the contiguous
+  prefill + single step from models/llama_infer.build_decoder,
+  shared by generate(), generate_beam(), and tests.
+- `scan_program(net, ..., mode)`: a chunk of decode steps as one
+  `lax.scan` with traced per-row sampling params + eos bookkeeping
+  (mode "greedy" skips the sampler entirely).
+- `paged_programs(net, ...)`: the serving engine's block-table
+  prefill (writes straight into the page pool) and continuous-batch
+  decode tick (sample + step + page write + per-row PRNG advance in
+  ONE executable).
+
+Donation: page pools and caches are donated on non-CPU backends (the
+caller always threads the returned arrays back), so serving holds one
+pool's worth of HBM, not two.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import tracing
+
+__all__ = ["Program", "decoder_programs", "scan_program",
+           "paged_programs", "reset_programs", "program_store"]
+
+
+class _LowerShim:
+    """Duck-typed _CacheEntry so tracing.record_compile can dump HLO
+    (MXNET_TPU_DUMP_HLO) for serving programs too."""
+
+    def __init__(self, jit_fn, avals):
+        self.jit_fn = jit_fn
+        self._example_avals = avals
+
+
+class Program:
+    """One named persistent executable with honest compile/hit
+    accounting into tracing.cache_stats() (and, through it, the
+    telemetry compile counters)."""
+
+    def __init__(self, name, fn, donate_argnums=()):
+        self.name = name
+        self.compiles = 0
+        self.calls = 0
+
+        def counted(*args):
+            # executes at TRACE time only — jit cache hits never
+            # re-enter the Python body
+            self.compiles += 1
+            return fn(*args)
+
+        kw = {}
+        if donate_argnums and jax.default_backend() != "cpu":
+            # CPU XLA cannot honor donation; skipping avoids the
+            # per-call "donated buffers were not usable" warning
+            kw["donate_argnums"] = donate_argnums
+        self._jit = jax.jit(counted, **kw)
+
+    def __call__(self, *args):
+        self.calls += 1
+        before = self.compiles
+        t0 = time.perf_counter()
+        out = self._jit(*args)
+        if self.compiles > before:
+            avals = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.result_type(x)),
+                args)
+            tracing.record_compile(self.name,
+                                   _LowerShim(self._jit, avals))
+            tracing.record_compile_seconds(
+                self.name, time.perf_counter() - t0)
+        else:
+            tracing.record_hit(self.name)
+        return out
+
+
+# -- per-net program store --------------------------------------------------
+
+def program_store(net) -> dict:
+    """The net's signature-keyed program cache (created on demand).
+    Lives on the net object so it dies with it — no global registry
+    pinning model weights."""
+    st = getattr(net, "_serving_programs", None)
+    if st is None:
+        st = {}
+        object.__setattr__(net, "_serving_programs", st)
+    return st
+
+
+def reset_programs(net):
+    """Drop every cached program for `net` (tests / reconfiguration)."""
+    program_store(net).clear()
+
+
+def decoder_programs(net, max_len: int, kv_cache_dtype: str = "model"):
+    """Contiguous-cache prefill + step as cached Programs. The
+    returned dict also exposes the raw (untraced) step for scan
+    builders."""
+    st = program_store(net)
+    key = ("decoder", max_len, kv_cache_dtype)
+    ent = st.get(key)
+    if ent is None:
+        from ..models.llama_infer import build_decoder
+        _, prefill, step = build_decoder(net, max_len,
+                                         kv_cache_dtype=kv_cache_dtype)
+        ent = {"prefill": Program("gen_prefill", prefill),
+               "step": Program("gen_step", step),
+               "raw_step": step}
+        st[key] = ent
+    return ent
+
+
+def _make_scan(step, mode: str):
+    """A chunk of decode steps as one scanned executable.
+
+    Carry: (cache, logits, pos, finished). Per step: sample from the
+    incoming logits (per-row traced params), freeze finished rows to
+    eos, run the cached decode step, note fresh eos hits. `eos` is a
+    traced scalar (-1 = disabled), so eos and non-eos calls share one
+    executable."""
+    from .sampling import sample_tokens
+
+    def scan_chunk(params, cache, logits, pos, finished, eos, temps,
+                   top_ks, top_p, keys):
+        def body(carry, key_t):
+            cache, logits, pos, finished = carry
+            if mode == "sample":
+                row_keys = jax.random.split(key_t, logits.shape[0])
+                tok = sample_tokens(logits, row_keys, temps, top_ks,
+                                    top_p)
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # finished rows keep emitting eos (and keep stepping —
+            # rows are independent, their cache writes are inert)
+            tok = jnp.where(finished, jnp.maximum(eos, 0), tok)
+            finished = finished | ((eos >= 0) & (tok == eos))
+            cache, logits = step(params, cache, pos, tok)
+            return (cache, logits, pos + 1, finished), tok
+
+        (cache, logits, pos, finished), toks = lax.scan(
+            body, (cache, logits, pos, finished), keys)
+        return cache, logits, pos, finished, toks
+
+    return scan_chunk
+
+
+def scan_program(net, max_len: int, kv_cache_dtype: str, mode: str):
+    """Cached scan-chunk Program. mode: 'greedy' | 'sample'."""
+    assert mode in ("greedy", "sample"), mode
+    st = program_store(net)
+    key = ("scan", max_len, kv_cache_dtype, mode)
+    prog = st.get(key)
+    if prog is None:
+        step = decoder_programs(net, max_len, kv_cache_dtype)["raw_step"]
+        prog = Program(f"gen_scan_{mode}", _make_scan(step, mode),
+                       donate_argnums=(1,))
+        st[key] = prog
+    return prog
+
+
+# -- paged serving programs -------------------------------------------------
+
+def _quant_rows(rows):
+    """Per-token symmetric int8 over the trailing dim — EXACTLY
+    quantize_kv's math (kernels/flash_decode.py) so paged int8 serving
+    is token-identical to the contiguous int8 generate() path.
+    rows (..., d) -> (int8 rows, f32 scales (..., 1))."""
+    rf = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(rf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q8 = jnp.clip(jnp.round(rf / scale), -127, 127).astype(jnp.int8)
+    return q8, scale
+
+
+def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
+                   block_size: int, max_prompt_len: int,
+                   kv_cache_dtype: str = "model"):
+    """Serving executables over a paged pool:
+
+    prefill(params, pages, bt_row, ids, valid_len)
+        -> (pages, last_logits):  ONE request (batch 1, right-padded
+        to max_prompt_len) through the training-identical layer math,
+        k/v written straight into its allocated blocks (padding tokens
+        route to the scratch block).
+
+    decode(params, pages, block_tables, pos, last_logits, keys,
+           temps, top_ks, top_ps, active)
+        -> (pages, tok, logits, keys): one continuous-batching tick —
+        per-row sampling of the PREVIOUS logits, one decode step for
+        all batch slots, paged cache write, per-row PRNG advance.
+        Inactive slots compute against the scratch block and their
+        outputs are discarded by the scheduler.
+    """
+    st = program_store(net)
+    key = ("paged", batch_slots, max_blocks_per_seq, block_size,
+           max_prompt_len, kv_cache_dtype)
+    ent = st.get(key)
+    if ent is not None:
+        return ent
+
+    from ..models import llama_math
+    from ..kernels.flash_decode import (flash_decode_paged,
+                                        flash_decode_paged_quantized)
+    from .sampling import sample_tokens
+
+    cfg = net.model.cfg
+    H, K, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q8 = kv_cache_dtype == "int8"
+    bs = block_size
+
+    def write_rows(pg, blk_ids, offs, k_rows, v_rows):
+        """Scatter per-token rows into the pool. blk_ids/offs (T,),
+        rows (T, K, d). Advanced indices around the K slice put the
+        token axis first — value shape (T, K, d) matches the rows."""
+        if q8:
+            k8, ks = _quant_rows(k_rows)
+            v8, vs = _quant_rows(v_rows)
+            return {"k": pg["k"].at[blk_ids, :, offs, :].set(k8),
+                    "ks": pg["ks"].at[blk_ids, :, offs, :].set(ks),
+                    "v": pg["v"].at[blk_ids, :, offs, :].set(v8),
+                    "vs": pg["vs"].at[blk_ids, :, offs, :].set(vs)}
+        return {"k": pg["k"].at[blk_ids, :, offs, :].set(k_rows),
+                "v": pg["v"].at[blk_ids, :, offs, :].set(v_rows)}
+
+    def prefill(params, pages, bt_row, ids, valid_len):
+        B, T = ids.shape                       # B == 1
+        x = params["embed"][ids]
+        positions = jnp.arange(T)
+        t = jnp.arange(T)
+        # padding tokens (t >= valid) sink into scratch block 0
+        blk = jnp.where(t < valid_len[0], bt_row[t // bs], 0)
+        offs = t % bs
+        new_pages = []
+        for lp, pg in zip(params["layers"], pages):
+            x, k, v = llama_math.decoder_layer(
+                lp, x, positions, cfg.rms_eps, cfg.rope_base, H, K, d,
+                lengths=valid_len, return_kv=True)
+            new_pages.append(write_rows(pg, blk, offs, k[0], v[0]))
+        x = llama_math.rms(x, params["norm"], cfg.rms_eps)
+        idx = jnp.maximum(valid_len - 1, 0)
+        last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        return new_pages, last @ params["head"].T
+
+    def decode(params, pages, block_tables, pos, last_logits, keys,
+               temps, top_ks, top_ps, active):
+        split = jax.vmap(partial(jax.random.split, num=2))(keys)
+        keys_sample, keys_next = split[:, 0], split[:, 1]
+        tok = sample_tokens(last_logits, keys_sample, temps, top_ks,
+                            top_ps)
+        rows = jnp.arange(batch_slots)
+        blk = jnp.where(active, block_tables[rows, pos // bs], 0)
+        offs = jnp.where(active, pos % bs, 0)
+        vl = jnp.where(active, pos + 1, 1)
+        x = params["embed"][tok][:, None, :]
+        new_pages = []
+        for lp, pg in zip(params["layers"], pages):
+            q, k, v = llama_math.layer_qkv(lp, x, pos[:, None],
+                                           cfg.rms_eps, cfg.rope_base,
+                                           H, K, d)
+            npg = write_rows(pg, blk, offs, k[:, 0], v[:, 0])
+            if q8:
+                att = flash_decode_paged_quantized(
+                    q[:, 0], npg["k"], npg["ks"], npg["v"], npg["vs"],
+                    block_tables, vl)[:, None]
+            else:
+                att = flash_decode_paged(q[:, 0], npg["k"], npg["v"],
+                                         block_tables, vl)[:, None]
+            x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+            new_pages.append(npg)
+        logits = llama_math.final_logits(params, x, cfg.rms_eps)[:, 0]
+        return new_pages, tok, logits, keys_next
+
+    ent = {"prefill": Program("serving_prefill", prefill,
+                              donate_argnums=(1,)),
+           "decode": Program("serving_decode", decode,
+                             donate_argnums=(1,))}
+    st[key] = ent
+    return ent

@@ -1,0 +1,208 @@
+"""Paged KV cache: a fixed pool of fixed-size blocks shared by every
+in-flight sequence (vLLM-style paged attention, adapted to the
+cache-native (·, K, S, d) layout of kernels/flash_decode.py).
+
+Why paging: the one-shot `generate()` cache is (B, max_len, ...) per
+call — every sequence pays for the longest possible sequence, and
+sequences of different lengths cannot share a batch without wasting
+HBM on the short rows. Here the device cache is a pool of
+`num_blocks` blocks of `block_size` tokens each; a sequence holds
+exactly ceil(len / block_size) blocks, tracked by a per-slot block
+table that maps logical block index -> physical block id. The decode
+kernel reads through the table (flash_decode_paged), so 16 requests at
+wildly different lengths share one fixed-shape decode batch.
+
+Split of responsibilities:
+
+- THIS class owns the host-side allocator: the free list, the block
+  tables, per-slot lengths, and the device page pool arrays.
+- The compiled executables (serving/executables.py) receive the pool +
+  tables as arguments and return the updated pool; the server threads
+  the returned arrays back in (donation-friendly — the pool is never
+  copied).
+
+Block 0 is reserved as a scratch sink: inactive batch slots and
+masked-out prompt padding write there, so the compiled step never
+needs a conditional around its cache writes. It is never allocated.
+
+Quantized mode ("int8") mirrors the contiguous int8 cache: int8 data
+blocks plus per-token fp32 scale blocks (quantize_kv semantics), so
+paged serving composes with the halved-HBM-traffic decode kernel.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Block allocator + device page pool for `num_layers` layers.
+
+    Device layout per layer:
+      "model" dtype: {"k": (N, K, bs, d), "v": (N, K, bs, d)}
+      "int8":        {"k": int8 (N, K, bs, d), "ks": f32 (N, K, bs, 1),
+                      "v": int8 (N, K, bs, d), "vs": f32 (N, K, bs, 1)}
+    """
+
+    def __init__(self, *, num_layers: int, num_kv_heads: int,
+                 head_dim: int, num_blocks: int, block_size: int,
+                 batch_slots: int, max_blocks_per_seq: int,
+                 dtype=jnp.float32, quantized: bool = False):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved scratch block)")
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.batch_slots = batch_slots
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.quantized = quantized
+        self.dtype = dtype
+
+        N, K, bs, d = num_blocks, num_kv_heads, block_size, head_dim
+        # device_put with an EXPLICIT device = committed initial
+        # pools. Fresh eager arrays are uncommitted, and the
+        # executables' first call would then carry a different
+        # sharding signature than every later call (whose pools are
+        # jit outputs) — one silent extra XLA compile per program.
+        dev = jax.devices()[0]
+        if quantized:
+            self.pages = [jax.device_put(
+                {"k": jnp.zeros((N, K, bs, d), jnp.int8),
+                 "ks": jnp.full((N, K, bs, 1), 1e-8 / 127.0,
+                                jnp.float32),
+                 "v": jnp.zeros((N, K, bs, d), jnp.int8),
+                 "vs": jnp.full((N, K, bs, 1), 1e-8 / 127.0,
+                                jnp.float32)}, dev)
+                          for _ in range(num_layers)]
+        else:
+            self.pages = [jax.device_put(
+                {"k": jnp.zeros((N, K, bs, d), dtype),
+                 "v": jnp.zeros((N, K, bs, d), dtype)}, dev)
+                          for _ in range(num_layers)]
+
+        # host-side allocator state. Free list is LIFO (hot blocks get
+        # reused first); block 0 never enters it.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        #: (slots, max_blocks) physical ids in logical order; 0 =
+        #: unallocated (reads of those positions are masked by
+        #: valid_len, writes only ever target allocated blocks or the
+        #: scratch sink)
+        self.block_tables = np.zeros((batch_slots, max_blocks_per_seq),
+                                     np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in
+                                              range(batch_slots)]
+        self._slot_len = np.zeros(batch_slots, np.int64)
+        self.alloc_count = 0
+        self.free_count = 0
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        # excludes the reserved scratch block
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return max(1, math.ceil(num_tokens / self.block_size))
+
+    def can_alloc(self, num_tokens: int) -> bool:
+        return len(self._free) >= self.blocks_for(num_tokens)
+
+    def stats(self) -> dict:
+        cap = self.num_blocks - 1
+        return {"num_blocks": cap, "block_size": self.block_size,
+                "free_blocks": self.num_free_blocks,
+                "used_blocks": self.num_used_blocks,
+                "utilization": self.num_used_blocks / cap if cap else 0,
+                "allocs": self.alloc_count, "frees": self.free_count}
+
+    def slot_len(self, slot: int) -> int:
+        return int(self._slot_len[slot])
+
+    def slot_blocks(self, slot: int) -> List[int]:
+        return list(self._slot_blocks[slot])
+
+    # -- alloc / extend / free ----------------------------------------------
+
+    def alloc(self, slot: int, num_tokens: int) -> bool:
+        """Allocate blocks for a fresh sequence of `num_tokens` in
+        `slot`. Returns False (and allocates nothing) if the pool
+        cannot cover it; the slot must be empty."""
+        if self._slot_blocks[slot]:
+            raise ValueError(f"slot {slot} already holds "
+                             f"{len(self._slot_blocks[slot])} blocks")
+        need = self.blocks_for(num_tokens)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence of {num_tokens} tokens needs {need} blocks "
+                f"> max_blocks_per_seq={self.max_blocks_per_seq}")
+        if len(self._free) < need:
+            return False
+        blocks = [self._free.pop() for _ in range(need)]
+        self._slot_blocks[slot] = blocks
+        self.block_tables[slot, :need] = blocks
+        self._slot_len[slot] = num_tokens
+        self.alloc_count += need
+        return True
+
+    def ensure(self, slot: int, pos: int) -> bool:
+        """Make sure the block holding token position `pos` is
+        allocated for `slot` (called before every decode tick for the
+        slot's next write position). Allocates at most one block.
+        Returns False if the pool is exhausted — the scheduler then
+        preempts another sequence and retries."""
+        need = pos // self.block_size + 1
+        held = len(self._slot_blocks[slot])
+        if need <= held:
+            self._slot_len[slot] = max(self._slot_len[slot], pos + 1)
+            return True
+        if need > self.max_blocks_per_seq:
+            raise ValueError(f"position {pos} exceeds "
+                             f"max_blocks_per_seq={self.max_blocks_per_seq}"
+                             f" * block_size={self.block_size}")
+        if not self._free:
+            return False
+        blk = self._free.pop()
+        self._slot_blocks[slot].append(blk)
+        self.block_tables[slot, held] = blk
+        self._slot_len[slot] = pos + 1
+        self.alloc_count += 1
+        return True
+
+    def free_slot(self, slot: int):
+        """Return the slot's blocks to the pool and clear its table
+        row (so an evicted slot's reads resolve to the scratch
+        block)."""
+        blocks = self._slot_blocks[slot]
+        self.free_count += len(blocks)
+        # LIFO reuse keeps the pool compact under churn
+        self._free.extend(reversed(blocks))
+        self._slot_blocks[slot] = []
+        self.block_tables[slot, :] = 0
+        self._slot_len[slot] = 0
+
+    def check(self):
+        """Allocator invariants (tests + debugging): no double
+        ownership, scratch never handed out, conservation of blocks."""
+        owned = [b for blks in self._slot_blocks for b in blks]
+        assert 0 not in owned, "scratch block allocated"
+        assert 0 not in self._free, "scratch block in free list"
+        assert len(set(owned)) == len(owned), "double-owned block"
+        assert not (set(owned) & set(self._free)), \
+            "block both owned and free"
+        assert len(owned) + len(self._free) == self.num_blocks - 1, \
+            "block leak"

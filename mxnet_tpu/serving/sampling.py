@@ -1,0 +1,55 @@
+"""Per-row token sampling as TRACED arrays, not Python constants.
+
+`generate()`'s old `pick()` baked temperature/top_k/top_p into the
+trace, so every sampling config was a fresh executable. Here the
+knobs ride in as (B,) vectors, so ONE compiled step serves any mix of
+per-request sampling params — the requirement for continuous batching,
+where a greedy request and a top-p request share the same decode tick.
+
+Semantics (per row, matching the old pick() pipeline exactly):
+  temperature <= 0  -> greedy argmax (the sampled branch is computed
+                       and discarded — where() keeps shapes static)
+  top_k > 0         -> keep the k best logits
+  0 < top_p < 1     -> nucleus: keep the smallest descending-prob
+                       prefix whose mass reaches p (top token always
+                       survives); composes after top_k
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens"]
+
+
+def sample_tokens(logits, row_keys, temperature, top_k, top_p):
+    """logits (B, V); row_keys (B, 2) uint32 PRNG keys (one per row —
+    rows sample independently, so evicting one request never shifts
+    another's stream); temperature/top_p (B,) f32; top_k (B,) i32
+    (0 = disabled). Returns (B,) int32 tokens."""
+    lg0 = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg0, axis=-1).astype(jnp.int32)
+    t = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.where(t > 0, t, 1.0)
+    lg = lg0 / safe_t[:, None]
+    V = lg.shape[-1]
+
+    k = jnp.asarray(top_k, jnp.int32)
+    asc = jnp.sort(lg, axis=-1)
+    kth = jnp.take_along_axis(
+        asc, jnp.clip(V - k, 0, V - 1)[:, None], axis=-1)   # (B, 1)
+    lg = jnp.where((k > 0)[:, None] & (lg < kth), -jnp.inf, lg)
+
+    p = jnp.asarray(top_p, jnp.float32)
+    desc = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < p[:, None]            # prefix mass < p
+    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                     keepdims=True)            # smallest kept logit
+    use_p = (p > 0) & (p < 1)
+    lg = jnp.where(use_p[:, None] & (lg < thresh), -jnp.inf, lg)
+
+    sampled = jax.vmap(jax.random.categorical)(row_keys, lg) \
+        .astype(jnp.int32)
+    return jnp.where(t > 0, sampled, greedy)

@@ -1,0 +1,385 @@
+"""Continuous-batching inference server.
+
+The scheduling model is the standard continuous-batching loop (Orca /
+vLLM; the Gemma-on-TPU serving comparison in PAPERS.md sets the
+TTFT / tokens-per-sec-per-chip bar this engine is instrumented for):
+
+- `submit()` enqueues a request (prompt + per-request sampling params
+  + max_new_tokens). FIFO by submission.
+- every `step()` (one decode tick):
+    1. ADMIT: while a batch slot and enough KV blocks are free, pop
+       the queue head, allocate its blocks, run the persistent prefill
+       executable (batch 1, padded to `max_prompt_len` — so 16
+       mixed-length prompts are ONE compile), and seed the slot's
+       logits/PRNG rows.
+    2. ENSURE: lazily allocate each running slot's next block when its
+       write position crosses a block boundary. Pool exhausted →
+       preempt the youngest running request (free its blocks, re-queue
+       it at the front; greedy requests regenerate identically).
+    3. DECODE: one shared decode-tick executable for ALL slots —
+       per-row sampling of the previous logits, one flash-decode step
+       through the paged cache, per-row PRNG advance. Compiled once,
+       reused for the lifetime of the server.
+    4. EVICT: finished rows (eos hit or max_new_tokens reached) free
+       their blocks and slots at the SAME tick, so the next step()
+       admits from the queue immediately.
+
+Telemetry (PR-4 registry, enabled via telemetry.enable()):
+  serving_ttft_seconds        histogram — submit -> first token
+  serving_tick_seconds        histogram — one decode tick
+  serving_queue_depth         gauge
+  serving_active_slots        gauge
+  serving_kv_blocks_free      gauge
+  serving_tokens_per_sec_per_chip  gauge (rolling 256-tick window)
+  serving_tokens_total / serving_requests_total / _finished /
+  serving_preemptions_total   counters
+  per-tick phase spans: serve_admit / serve_decode (chrome trace +
+  step_time_breakdown rows)
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..ndarray import NDArray
+from .kv_cache import PagedKVCache
+from . import executables
+
+__all__ = ["Request", "InferenceServer"]
+
+_QUEUED, _RUNNING, _FINISHED = "queued", "running", "finished"
+
+
+class Request:
+    """One generation request and its lifecycle record."""
+
+    _next_id = 0
+
+    def __init__(self, prompt, max_new_tokens, temperature, top_k,
+                 top_p, eos_id, seed):
+        self.id = Request._next_id
+        Request._next_id += 1
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = -1 if eos_id is None else int(eos_id)
+        self.seed = int(seed)
+        self.state = _QUEUED
+        self.output_tokens: List[int] = []
+        self.finish_reason: Optional[str] = None  # "eos" | "length"
+        self.t_submit = time.perf_counter()
+        self.t_first_token: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self.preemptions = 0
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    def tokens(self) -> np.ndarray:
+        """prompt + generated tokens, 1-D int32."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output_tokens, np.int32)])
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state}, "
+                f"prompt={len(self.prompt)}t, "
+                f"out={len(self.output_tokens)}t)")
+
+
+class InferenceServer:
+    """Continuous-batching engine over the paged KV cache and the
+    persistent prefill/decode executables.
+
+        server = InferenceServer(net, batch_slots=8, max_len=256)
+        reqs = [server.submit(p, max_new_tokens=32) for p in prompts]
+        server.run()
+        for r in reqs: print(r.tokens())
+
+    `max_len` (= max_blocks_per_seq * block_size) bounds
+    prompt + generated per sequence; `num_blocks` sizes the shared
+    pool (default: enough for every slot at full length, +1 scratch —
+    shrink it to exercise preemption)."""
+
+    def __init__(self, net, *, batch_slots: int = 8,
+                 max_len: int = 256, block_size: int = 16,
+                 max_prompt_len: Optional[int] = None,
+                 kv_cache_dtype: str = "model",
+                 num_blocks: Optional[int] = None):
+        if max_len % block_size:
+            raise ValueError("max_len must be a multiple of block_size")
+        cfg = net.model.cfg
+        self.net = net
+        self.cfg = cfg
+        self.batch_slots = batch_slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.max_prompt_len = max_prompt_len or min(max_len, 64)
+        self.kv_cache_dtype = kv_cache_dtype
+        max_blocks = max_len // block_size
+        if num_blocks is None:
+            num_blocks = batch_slots * max_blocks + 1
+        model_dtype = jnp.dtype(getattr(cfg, "dtype", "float32"))
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim, num_blocks=num_blocks,
+            block_size=block_size, batch_slots=batch_slots,
+            max_blocks_per_seq=max_blocks, dtype=model_dtype,
+            quantized=kv_cache_dtype == "int8")
+        self.programs = executables.paged_programs(
+            net, batch_slots=batch_slots, max_blocks_per_seq=max_blocks,
+            block_size=block_size, max_prompt_len=self.max_prompt_len,
+            kv_cache_dtype=kv_cache_dtype)
+
+        from ..models.llama_infer import _params_tree
+        self._params = _params_tree(net)
+
+        B, V = batch_slots, cfg.vocab_size
+        # device_put to an explicit device = committed: the decode
+        # executable's first call must present the same sharding
+        # signature as steady-state calls (where these are jit
+        # outputs), or jit recompiles once
+        dev = jax.devices()[0]
+        self._last_logits = jax.device_put(jnp.zeros((B, V),
+                                                     model_dtype), dev)
+        self._keys = jax.device_put(jnp.zeros((B, 2), jnp.uint32), dev)
+        self._pos = np.zeros(B, np.int32)
+        self._active = np.zeros(B, bool)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+        self._top_ps = np.zeros(B, np.float32)
+        self._slot_req: List[Optional[Request]] = [None] * B
+        self._admit_seq = 0                 # admission order stamp
+        self._slot_admit = np.zeros(B, np.int64)
+        self.queue: deque = deque()
+        self.finished: List[Request] = []
+        self.ticks = 0
+        self.tokens_generated = 0
+        self._tok_window: deque = deque(maxlen=256)
+
+    # -- request intake -----------------------------------------------------
+
+    def refresh_params(self):
+        """Re-snapshot the net's weights (after a training step /
+        checkpoint load). Shapes are unchanged, so no recompile."""
+        from ..models.llama_infer import _params_tree
+        self._params = _params_tree(self.net)
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, eos_id: Optional[int] = None,
+               seed: int = 0) -> Request:
+        """Enqueue one request. prompt_ids: 1-D (or (1, T)) ints."""
+        if isinstance(prompt_ids, NDArray):
+            prompt_ids = prompt_ids.asnumpy()
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(f"prompt of {prompt.size} tokens exceeds "
+                             f"max_prompt_len={self.max_prompt_len}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({prompt.size}) + max_new_tokens"
+                f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        req = Request(prompt, max_new_tokens, temperature, top_k,
+                      top_p, eos_id, seed)
+        self.queue.append(req)
+        telemetry.inc("serving_requests_total")
+        return req
+
+    # -- scheduler ----------------------------------------------------------
+
+    def _free_slots(self):
+        return [i for i in range(self.batch_slots)
+                if not self._active[i]]
+
+    def _admit_one(self, slot: int, req: Request):
+        T = len(req.prompt)
+        ids = np.zeros((1, self.max_prompt_len), np.int32)
+        ids[0, :T] = req.prompt
+        bt_row = jnp.asarray(self.cache.block_tables[slot])
+        with telemetry.phase("serve_prefill"):
+            self.cache.pages, last = self.programs["prefill"](
+                self._params, self.cache.pages, bt_row,
+                jnp.asarray(ids), jnp.asarray([T], jnp.int32))
+        self._last_logits = self._last_logits.at[slot].set(
+            last[0].astype(self._last_logits.dtype))
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(jax.random.PRNGKey(req.seed), jnp.uint32))
+        self._pos[slot] = T
+        self._active[slot] = True
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+        self._slot_req[slot] = req
+        self._slot_admit[slot] = self._admit_seq
+        self._admit_seq += 1
+        req.state = _RUNNING
+
+    def _admit(self):
+        admitted = 0
+        free = self._free_slots()
+        while self.queue and free:
+            req = self.queue[0]
+            # the prompt's blocks now; the first decode block comes
+            # lazily via ensure()
+            if not self.cache.can_alloc(len(req.prompt)):
+                break
+            self.queue.popleft()
+            slot = free.pop(0)
+            self.cache.alloc(slot, len(req.prompt))
+            self._admit_one(slot, req)
+            admitted += 1
+        return admitted
+
+    def _preempt_youngest(self, protect: int) -> bool:
+        """Free the most recently admitted running request (except
+        `protect`) back to the queue head. Returns False if there is
+        nothing to preempt."""
+        running = [i for i in range(self.batch_slots)
+                   if self._active[i] and i != protect]
+        if not running:
+            return False
+        victim = max(running, key=lambda i: self._slot_admit[i])
+        req = self._slot_req[victim]
+        req.state = _QUEUED
+        req.output_tokens = []          # greedy rerun is identical
+        req.preemptions += 1
+        self._evict(victim)
+        self.queue.appendleft(req)
+        telemetry.inc("serving_preemptions_total")
+        return True
+
+    def _ensure_blocks(self):
+        """Every running slot needs the block holding its next write
+        position before the tick."""
+        order = sorted((i for i in range(self.batch_slots)
+                        if self._active[i]),
+                       key=lambda i: self._slot_admit[i])
+        for slot in order:
+            while not self.cache.ensure(slot, int(self._pos[slot])):
+                if not self._preempt_youngest(slot):
+                    raise RuntimeError(
+                        "KV pool too small for a single sequence — "
+                        "raise num_blocks or lower max_len")
+
+    def _evict(self, slot: int):
+        self.cache.free_slot(slot)
+        self._active[slot] = False
+        self._pos[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 0.0
+        self._slot_req[slot] = None
+
+    def _finish(self, slot: int, reason: str):
+        req = self._slot_req[slot]
+        req.state = _FINISHED
+        req.finish_reason = reason
+        req.t_finish = time.perf_counter()
+        self.finished.append(req)
+        self._evict(slot)
+        telemetry.inc("serving_requests_finished")
+
+    # -- the tick -----------------------------------------------------------
+
+    def step(self) -> int:
+        """Admit + one decode tick + evict. Returns tokens emitted."""
+        t_tick = time.perf_counter()
+        with telemetry.phase("serve_admit"):
+            self._admit()
+        if not self._active.any():
+            self._update_gauges()
+            return 0
+        self._ensure_blocks()
+        with telemetry.phase("serve_decode"):
+            (self.cache.pages, tok, self._last_logits,
+             self._keys) = self.programs["decode"](
+                self._params, self.cache.pages,
+                jnp.asarray(self.cache.block_tables),
+                jnp.asarray(self._pos), self._last_logits, self._keys,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._active))
+            tok_np = np.asarray(tok)    # host sync = honest tick time
+        now = time.perf_counter()
+        emitted = 0
+        for slot in range(self.batch_slots):
+            if not self._active[slot]:
+                continue
+            req = self._slot_req[slot]
+            t = int(tok_np[slot])
+            req.output_tokens.append(t)
+            self._pos[slot] += 1
+            emitted += 1
+            if req.t_first_token is None:
+                req.t_first_token = now
+                if req.ttft is not None:
+                    telemetry.observe("serving_ttft_seconds", req.ttft)
+            if req.eos_id >= 0 and t == req.eos_id:
+                self._finish(slot, "eos")
+            elif len(req.output_tokens) >= req.max_new_tokens:
+                self._finish(slot, "length")
+        self.ticks += 1
+        self.tokens_generated += emitted
+        self._tok_window.append((now, emitted))
+        telemetry.inc("serving_tokens_total", emitted)
+        telemetry.observe("serving_tick_seconds", now - t_tick)
+        self._update_gauges()
+        return emitted
+
+    def _update_gauges(self):
+        if not telemetry._ENABLED:
+            return
+        telemetry.set_gauge("serving_queue_depth", len(self.queue))
+        telemetry.set_gauge("serving_active_slots",
+                            int(self._active.sum()))
+        telemetry.set_gauge("serving_kv_blocks_free",
+                            self.cache.num_free_blocks)
+        if len(self._tok_window) >= 2:
+            t0 = self._tok_window[0][0]
+            dt = self._tok_window[-1][0] - t0
+            if dt > 0:
+                n = sum(k for _, k in list(self._tok_window)[1:])
+                chips = max(1, jax.local_device_count())
+                telemetry.set_gauge("serving_tokens_per_sec_per_chip",
+                                    n / dt / chips)
+
+    def run(self, max_ticks: Optional[int] = None) -> List[Request]:
+        """Step until queue and slots drain (or max_ticks). Returns
+        the requests finished during this call's ticks."""
+        done_before = len(self.finished)
+        ticks = 0
+        while self.queue or self._active.any():
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        return self.finished[done_before:]
+
+    # -- introspection ------------------------------------------------------
+
+    def compile_stats(self) -> dict:
+        p, d = self.programs["prefill"], self.programs["decode"]
+        return {"prefill_compiles": p.compiles, "prefill_calls": p.calls,
+                "decode_compiles": d.compiles, "decode_calls": d.calls}
+
+    def stats(self) -> dict:
+        return {"ticks": self.ticks,
+                "tokens_generated": self.tokens_generated,
+                "queued": len(self.queue),
+                "active": int(self._active.sum()),
+                "finished": len(self.finished),
+                **{f"kv_{k}": v for k, v in self.cache.stats().items()},
+                **self.compile_stats()}

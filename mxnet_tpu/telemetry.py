@@ -55,7 +55,7 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "phase", "mark_phase", "step_done",
            "snapshot", "to_prometheus", "dump_json", "breakdown_table",
            "export_chrome_trace", "note_device_trace",
-           "STEP_PHASES"]
+           "STEP_PHASES", "SERVE_PHASES"]
 
 #: THE flag. Instrumented call sites across the stack guard with
 #: `if telemetry._ENABLED:` (one module-attribute load + branch) so the
@@ -66,6 +66,14 @@ _ENABLED = os.environ.get("MXNET_TPU_TELEMETRY", "0") == "1"
 #: canonical per-step timeline phases (step_time_breakdown labels)
 STEP_PHASES = ("data", "forward", "backward", "grad_comm", "optimizer",
                "weight_gather")
+
+#: per-tick phases of the serving engine (mxnet_tpu/serving/): request
+#: admission (incl. the prefill executable), the paged prefill itself,
+#: and the shared continuous-batching decode tick. Serving also owns
+#: the serving_ttft_seconds / serving_tick_seconds histograms and the
+#: serving_queue_depth / serving_active_slots / serving_kv_blocks_free
+#: / serving_tokens_per_sec_per_chip gauges.
+SERVE_PHASES = ("serve_admit", "serve_prefill", "serve_decode")
 
 _lock = threading.RLock()
 _REGISTRY: "OrderedDict[str, _Family]" = OrderedDict()

@@ -21,6 +21,7 @@ CASES = {
                        "--seq-len", "32", "--vocab", "128",
                        "--hidden", "32", "--layers", "1"],
     "llama_generate.py": ["--cpu", "--steps", "3"],
+    "llama_serve.py": ["--cpu", "--steps", "3", "--requests", "4"],
     "bert_pretrain.py": ["--cpu", "--steps", "2", "--batch-size", "2",
                          "--seq-len", "32", "--vocab", "128",
                          "--units", "32", "--layers", "1"],

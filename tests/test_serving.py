@@ -1,0 +1,501 @@
+"""Continuous-batching inference server: paged KVCache allocator,
+block-table decode parity, persistent-executable compile accounting,
+scheduler admit/evict/preempt semantics, per-request sampling
+isolation, and token parity vs one-shot generate()."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry, tracing
+from mxnet_tpu.models.llama_infer import generate
+from mxnet_tpu.serving import InferenceServer, PagedKVCache
+from mxnet_tpu.serving import executables as exe
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    n = mx.models.get_model("llama_tiny")
+    n.initialize()
+    n(mx.nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize
+    return n
+
+
+def _cache(**kw):
+    args = dict(num_layers=2, num_kv_heads=2, head_dim=8,
+                num_blocks=9, block_size=4, batch_slots=3,
+                max_blocks_per_seq=4)
+    args.update(kw)
+    return PagedKVCache(**args)
+
+
+# -- PagedKVCache allocator -------------------------------------------------
+
+def test_alloc_distinct_blocks_and_table():
+    c = _cache()
+    assert c.alloc(0, 7)          # 2 blocks
+    assert c.alloc(1, 9)          # 3 blocks
+    a, b = c.slot_blocks(0), c.slot_blocks(1)
+    assert len(a) == 2 and len(b) == 3
+    assert not (set(a) & set(b))
+    assert 0 not in a + b         # scratch never handed out
+    # table rows hold the physical ids in logical order, 0 elsewhere
+    assert list(c.block_tables[0, :2]) == a
+    assert list(c.block_tables[0, 2:]) == [0, 0]
+    c.check()
+
+
+def test_alloc_fails_without_blocks_and_leaves_state_clean():
+    c = _cache(num_blocks=4)      # 3 usable
+    assert c.alloc(0, 12)         # takes all 3
+    assert not c.alloc(1, 5)      # needs 2, none free
+    assert c.num_free_blocks == 0
+    assert c.slot_blocks(1) == []
+    c.check()
+
+
+def test_free_returns_blocks_and_clears_table():
+    c = _cache()
+    c.alloc(0, 16)
+    used = c.slot_blocks(0)
+    c.free_slot(0)
+    assert c.num_free_blocks == 8
+    assert (c.block_tables[0] == 0).all()
+    # freed blocks are reusable
+    assert c.alloc(1, 16)
+    assert set(c.slot_blocks(1)) == set(used) or c.num_free_blocks == 4
+    c.check()
+
+
+def test_ensure_allocates_on_block_boundary_only():
+    c = _cache()
+    c.alloc(0, 4)                 # exactly 1 block
+    free0 = c.num_free_blocks
+    assert c.ensure(0, 3)         # still inside block 0
+    assert c.num_free_blocks == free0
+    assert c.ensure(0, 4)         # crosses into block 1
+    assert c.num_free_blocks == free0 - 1
+    assert c.slot_len(0) == 5
+    c.check()
+
+
+def test_fragmentation_interleaved_alloc_free_conserves_blocks():
+    c = _cache(num_blocks=13, batch_slots=4, max_blocks_per_seq=3)
+    rs = np.random.RandomState(0)
+    held = {}
+    for _ in range(200):
+        slot = rs.randint(4)
+        if slot in held:
+            c.free_slot(slot)
+            del held[slot]
+        else:
+            n = int(rs.randint(1, 12))
+            if c.alloc(slot, n):
+                held[slot] = n
+        c.check()
+    st = c.stats()
+    assert st["used_blocks"] + st["free_blocks"] == 12
+    assert st["allocs"] - st["frees"] == st["used_blocks"]
+
+
+def test_alloc_beyond_max_blocks_raises():
+    c = _cache()
+    with pytest.raises(ValueError):
+        c.alloc(0, 17)            # 5 blocks > max_blocks_per_seq=4
+
+
+def test_quantized_cache_page_shapes():
+    c = _cache(quantized=True)
+    pg = c.pages[0]
+    assert pg["k"].dtype == jnp.int8 and pg["v"].dtype == jnp.int8
+    assert pg["ks"].shape == (9, 2, 4, 1)
+    assert pg["ks"].dtype == jnp.float32
+
+
+# -- block-table gather path ------------------------------------------------
+
+def test_flash_decode_paged_matches_contiguous():
+    from mxnet_tpu.kernels.flash_decode import (flash_decode,
+                                                flash_decode_paged)
+    rs = np.random.RandomState(3)
+    B, K, H, d, bs, nb = 2, 2, 4, 8, 4, 4
+    S = nb * bs
+    k = rs.randn(B, K, S, d).astype(np.float32)
+    v = rs.randn(B, K, S, d).astype(np.float32)
+    q = rs.randn(B, H, d).astype(np.float32)
+    vl = np.array([S - 3, 5], np.int32)
+    # scatter the contiguous caches into a shuffled page pool
+    N = B * nb + 1
+    perm = 1 + rs.permutation(N - 1)
+    bt = perm.reshape(B, nb).astype(np.int32)
+    kp = np.zeros((N, K, bs, d), np.float32)
+    vp = np.zeros((N, K, bs, d), np.float32)
+    for b in range(B):
+        for j in range(nb):
+            kp[bt[b, j]] = k[b, :, j * bs:(j + 1) * bs]
+            vp[bt[b, j]] = v[b, :, j * bs:(j + 1) * bs]
+    ref = flash_decode(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                       jnp.asarray(vl))
+    out = flash_decode_paged(jnp.asarray(q), jnp.asarray(kp),
+                             jnp.asarray(vp), jnp.asarray(bt),
+                             jnp.asarray(vl))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_flash_decode_paged_quantized_matches_contiguous():
+    from mxnet_tpu.kernels.flash_decode import (
+        flash_decode_quantized, flash_decode_paged_quantized,
+        quantize_kv)
+    rs = np.random.RandomState(4)
+    B, K, H, d, bs, nb = 2, 2, 4, 8, 4, 3
+    S = nb * bs
+    k = rs.randn(B, K, S, d).astype(np.float32)
+    v = rs.randn(B, K, S, d).astype(np.float32)
+    q = rs.randn(B, H, d).astype(np.float32)
+    vl = np.array([S, 7], np.int32)
+    k8, ks, v8, vs = (np.asarray(x) for x in
+                      quantize_kv(jnp.asarray(k), jnp.asarray(v)))
+    N = B * nb + 1
+    bt = (1 + rs.permutation(N - 1)).reshape(B, nb).astype(np.int32)
+    k8p = np.zeros((N, K, bs, d), np.int8)
+    ksp = np.zeros((N, K, bs, 1), np.float32)
+    v8p = np.zeros((N, K, bs, d), np.int8)
+    vsp = np.zeros((N, K, bs, 1), np.float32)
+    for b in range(B):
+        for j in range(nb):
+            sl = slice(j * bs, (j + 1) * bs)
+            k8p[bt[b, j]], ksp[bt[b, j]] = k8[b, :, sl], ks[b, :, sl]
+            v8p[bt[b, j]], vsp[bt[b, j]] = v8[b, :, sl], vs[b, :, sl]
+    ref = flash_decode_quantized(*(jnp.asarray(x) for x in
+                                   (q, k8, ks, v8, vs, vl)))
+    out = flash_decode_paged_quantized(
+        jnp.asarray(q), jnp.asarray(k8p), jnp.asarray(ksp),
+        jnp.asarray(v8p), jnp.asarray(vsp), jnp.asarray(bt),
+        jnp.asarray(vl))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+# -- persistent executables -------------------------------------------------
+
+def test_generate_reuses_compiled_executables(net):
+    exe.reset_programs(net)
+    tracing.reset_cache_stats()
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, 256, (2, 4)).astype(np.int32)
+    a = generate(net, prompt, max_new_tokens=5)
+    b = generate(net, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(a, b)
+    per = tracing.cache_stats()["per_block"]
+    assert per["gen_prefill"]["compiles"] == 1
+    assert per["gen_prefill"]["hits"] == 1
+    assert per["gen_scan_greedy"]["compiles"] == 1
+    assert per["gen_scan_greedy"]["hits"] == 1
+    assert per["gen_prefill"]["compile_seconds"] > 0
+
+
+def test_sampling_params_do_not_retrace(net):
+    """temperature/top_k/top_p are traced vectors: changing them hits
+    the SAME executable."""
+    exe.reset_programs(net)
+    tracing.reset_cache_stats()
+    rs = np.random.RandomState(6)
+    prompt = rs.randint(0, 256, (1, 4)).astype(np.int32)
+    generate(net, prompt, max_new_tokens=4, temperature=1.0, top_k=5)
+    generate(net, prompt, max_new_tokens=4, temperature=0.3,
+             top_p=0.9, seed=2)
+    per = tracing.cache_stats()["per_block"]
+    assert per["gen_scan_sample"]["compiles"] == 1
+    assert per["gen_scan_sample"]["hits"] == 1
+
+
+def test_generate_beam_reuses_step_program(net):
+    from mxnet_tpu.models.llama_infer import generate_beam
+    exe.reset_programs(net)
+    tracing.reset_cache_stats()
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, 256, (1, 5)).astype(np.int32)
+    a = generate_beam(net, prompt, max_new_tokens=3, beam_size=2)
+    b = generate_beam(net, prompt, max_new_tokens=3, beam_size=2)
+    np.testing.assert_array_equal(a, b)
+    per = tracing.cache_stats()["per_block"]
+    assert per["gen_step"]["compiles"] == 1
+    assert per["gen_step"]["hits"] >= 1
+
+
+def test_per_row_sampling_params(net):
+    """(B,) sampling vectors: a greedy row rides next to a hot row in
+    one call and still matches its solo greedy decode."""
+    rs = np.random.RandomState(8)
+    prompt = rs.randint(0, 256, (2, 5)).astype(np.int32)
+    out = generate(net, prompt, max_new_tokens=5,
+                   temperature=np.array([1.5, 0.0], np.float32),
+                   top_k=np.array([20, 0], np.int32), seed=4)
+    solo = generate(net, prompt[1:2], max_new_tokens=5)
+    np.testing.assert_array_equal(out[1], solo[0])
+
+
+# -- ragged prompts + eos ---------------------------------------------------
+
+def test_ragged_prompts_match_per_row_solo(net):
+    rs = np.random.RandomState(9)
+    ids = np.zeros((3, 8), np.int32)
+    lens = [8, 3, 5]
+    for i, L in enumerate(lens):
+        ids[i, :L] = rs.randint(0, 256, L)
+    out = generate(net, ids, max_new_tokens=4,
+                   valid_len=np.array(lens), max_len=16)
+    for i, L in enumerate(lens):
+        solo = generate(net, ids[i:i + 1, :L], max_new_tokens=4,
+                        max_len=16)
+        np.testing.assert_array_equal(out[i, 8:], solo[0, L:])
+
+
+def test_ragged_valid_len_validation(net):
+    ids = np.zeros((2, 6), np.int32)
+    with pytest.raises(ValueError):
+        generate(net, ids, max_new_tokens=2, valid_len=np.array([7, 3]))
+    with pytest.raises(ValueError):
+        generate(net, ids, max_new_tokens=2, valid_len=np.array([0, 3]))
+
+
+def test_eos_early_exit_and_finish_positions(net):
+    rs = np.random.RandomState(10)
+    prompt = rs.randint(0, 256, (2, 4)).astype(np.int32)
+    g1 = generate(net, prompt, max_new_tokens=1)
+    eos = int(g1[0, -1])          # row 0's greedy next token
+    out, fin = generate(net, prompt, max_new_tokens=12, eos_id=eos,
+                        return_finished=True)
+    assert out.shape == (2, 16)
+    assert fin[0] == 0            # row 0 hits eos immediately
+    gen0 = out[0, 4:]
+    assert (gen0 == eos).all()    # frozen to eos after the hit
+    if fin[1] >= 0:               # row 1 may or may not hit eos
+        assert out[1, 4 + fin[1]] == eos
+        assert (out[1, 4 + fin[1]:] == eos).all()
+    # rows that never finish match the plain greedy decode
+    plain = generate(net, prompt, max_new_tokens=12)
+    if fin[1] < 0:
+        np.testing.assert_array_equal(out[1], plain[1])
+
+
+def test_eos_none_keeps_legacy_contract(net):
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, 256, (1, 4)).astype(np.int32)
+    out, fin = generate(net, prompt, max_new_tokens=5,
+                        return_finished=True)
+    assert fin[0] == -1
+    assert out.shape == (1, 9)
+
+
+# -- the server -------------------------------------------------------------
+
+def _mixed_requests(server, rs, n, eos_id=None):
+    reqs = []
+    for _ in range(n):
+        T = int(rs.randint(3, server.max_prompt_len + 1))
+        p = rs.randint(0, 256, T).astype(np.int32)
+        new = int(rs.randint(2, 9))
+        reqs.append((p, new,
+                     server.submit(p, max_new_tokens=new,
+                                   eos_id=eos_id)))
+    return reqs
+
+
+def test_server_16_requests_token_parity_one_compile_each(net):
+    """The acceptance bar: 16 mixed-length greedy requests through the
+    continuous-batching server are token-identical to per-request
+    one-shot generate(), with exactly ONE prefill compile and ONE
+    decode compile."""
+    rs = np.random.RandomState(12)
+    server = InferenceServer(net, batch_slots=4, max_len=64,
+                             block_size=8, max_prompt_len=12)
+    reqs = _mixed_requests(server, rs, 16)
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] == 1, cs
+    assert cs["decode_compiles"] == 1, cs
+    assert cs["prefill_calls"] == 16
+    per = tracing.cache_stats()["per_block"]
+    assert per["serving_prefill"]["compiles"] == 1
+    assert per["serving_prefill"]["hits"] == 15
+    assert per["serving_decode"]["compiles"] == 1
+    for p, new, r in reqs:
+        assert r.state == "finished" and r.finish_reason == "length"
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), one[0, len(p):],
+            err_msg=f"request {r.id} diverged from one-shot generate")
+    # everything was released
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_server_admit_evict_ordering(net):
+    """FIFO admission; finished slots are evicted and refilled from
+    the queue at the next tick."""
+    rs = np.random.RandomState(13)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=2 + i) for i in range(5)]
+    server.step()
+    # first two admitted in submit order
+    assert reqs[0].state == "running" and reqs[1].state == "running"
+    assert reqs[2].state == "queued"
+    server.run()
+    assert [r.state for r in reqs] == ["finished"] * 5
+    # completion respects slot reuse: r0 (2 toks) finished first and
+    # its slot went to r2 before r3/r4
+    fin = sorted(reqs, key=lambda r: r.t_finish)
+    assert fin[0] is reqs[0]
+
+
+def test_server_per_request_sampling_isolation(net):
+    rs = np.random.RandomState(14)
+    server = InferenceServer(net, batch_slots=3, max_len=64,
+                             block_size=8, max_prompt_len=12)
+    pg = rs.randint(0, 256, 5).astype(np.int32)
+    r_greedy = server.submit(pg, max_new_tokens=6)
+    server.submit(rs.randint(0, 256, 9).astype(np.int32),
+                  max_new_tokens=6, temperature=1.5, top_k=30, seed=3)
+    server.submit(rs.randint(0, 256, 3).astype(np.int32),
+                  max_new_tokens=6, temperature=0.8, top_p=0.95,
+                  seed=5)
+    server.run()
+    solo = generate(net, pg[None, :], max_new_tokens=6, max_len=64)
+    np.testing.assert_array_equal(np.asarray(r_greedy.output_tokens),
+                                  solo[0, 5:])
+
+
+def test_server_sampled_requests_deterministic_by_seed(net):
+    rs = np.random.RandomState(15)
+    p = rs.randint(0, 256, 6).astype(np.int32)
+
+    def run_once():
+        server = InferenceServer(net, batch_slots=2, max_len=64,
+                                 block_size=8, max_prompt_len=8)
+        r = server.submit(p, max_new_tokens=6, temperature=1.0,
+                          top_k=10, seed=11)
+        server.run()
+        return list(r.output_tokens)
+
+    assert run_once() == run_once()
+
+
+def test_server_int8_cache_parity(net):
+    rs = np.random.RandomState(16)
+    server = InferenceServer(net, batch_slots=2, max_len=64,
+                             block_size=8, max_prompt_len=12,
+                             kv_cache_dtype="int8")
+    reqs = _mixed_requests(server, rs, 4)
+    server.run()
+    for p, new, r in reqs:
+        one = generate(net, p[None, :], max_new_tokens=new,
+                       max_len=64, kv_cache_dtype="int8")
+        np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                      one[0, len(p):])
+
+
+def test_server_eos_finishes_early(net):
+    rs = np.random.RandomState(17)
+    p = rs.randint(0, 256, 5).astype(np.int32)
+    g1 = generate(net, p[None, :], max_new_tokens=1, max_len=64)
+    eos = int(g1[0, -1])
+    server = InferenceServer(net, batch_slots=2, max_len=64,
+                             block_size=8, max_prompt_len=8)
+    r = server.submit(p, max_new_tokens=10, eos_id=eos)
+    server.run()
+    assert r.finish_reason == "eos"
+    assert r.output_tokens == [eos]
+
+
+def test_server_preemption_under_tiny_pool(net):
+    """Pool holds ~1.5 sequences: the scheduler must preempt the
+    younger request, finish the older, then complete the preempted one
+    with token-identical greedy output."""
+    rs = np.random.RandomState(18)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=12,
+                             num_blocks=6)
+    pa = rs.randint(0, 256, 10).astype(np.int32)
+    pb = rs.randint(0, 256, 10).astype(np.int32)
+    ra = server.submit(pa, max_new_tokens=12)
+    rb = server.submit(pb, max_new_tokens=12)
+    server.run()
+    assert ra.state == "finished" and rb.state == "finished"
+    assert ra.preemptions + rb.preemptions >= 1
+    for p, r in ((pa, ra), (pb, rb)):
+        one = generate(net, p[None, :], max_new_tokens=12, max_len=32)
+        np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                      one[0, 10:])
+    server.cache.check()
+
+
+def test_server_submit_validation(net):
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    with pytest.raises(ValueError):
+        server.submit(np.arange(9, dtype=np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError):
+        server.submit(np.arange(8, dtype=np.int32), max_new_tokens=30)
+    with pytest.raises(ValueError):
+        server.submit(np.zeros(0, np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError):
+        InferenceServer(net, max_len=30, block_size=8)
+
+
+def test_server_telemetry(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(19)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8)
+        for _ in range(3):
+            server.submit(rs.randint(0, 256, 5).astype(np.int32),
+                          max_new_tokens=3)
+        server.run()
+        snap = telemetry.snapshot()
+        assert snap["histograms"]["serving_ttft_seconds"]["count"] == 3
+        assert snap["counters"]["serving_tokens_total"] == 9.0
+        assert snap["counters"]["serving_requests_total"] == 3.0
+        assert snap["counters"]["serving_requests_finished"] == 3.0
+        # phase spans landed in the step-time breakdown
+        bd = snap["step_time_breakdown"]
+        assert "serve_admit" in bd and "serve_decode" in bd
+        assert "serve_prefill" in bd
+        assert "serving_queue_depth" in snap["gauges"]
+        assert "serving_kv_blocks_free" in snap["gauges"]
+        assert snap["histograms"]["serving_tick_seconds"]["count"] >= 3
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_server_refresh_params_picks_up_new_weights(net):
+    rs = np.random.RandomState(20)
+    p = rs.randint(0, 256, 5).astype(np.int32)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    r0 = server.submit(p, max_new_tokens=4)
+    server.run()
+    gate = net.model.layers[0].mlp.gate_proj.weight
+    orig = gate.data().asnumpy()
+    try:
+        gate.set_data(mx.nd.array(orig + 0.05 * np.sign(orig)))
+        server.refresh_params()
+        r1 = server.submit(p, max_new_tokens=4)
+        server.run()
+        one = generate(net, p[None, :], max_new_tokens=4, max_len=32)
+        np.testing.assert_array_equal(np.asarray(r1.output_tokens),
+                                      one[0, 5:])
+    finally:
+        gate.set_data(mx.nd.array(orig))
+    # no recompile across the weight refresh
+    assert server.compile_stats()["decode_compiles"] == 1
+    assert r0.output_tokens  # the pre-update run completed too
