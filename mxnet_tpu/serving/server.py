@@ -75,6 +75,10 @@ class Request:
         self.seed = int(seed)
         self.state = _QUEUED
         self.output_tokens: List[int] = []
+        #: high-water mark of tokens already counted into the server's
+        #: throughput metrics; survives preemption so regenerated
+        #: tokens are not double-counted
+        self.tokens_counted = 0
         self.finish_reason: Optional[str] = None  # "eos" | "length"
         self.t_submit = time.perf_counter()
         self.t_first_token: Optional[float] = None
@@ -193,6 +197,17 @@ class InferenceServer:
             raise ValueError(
                 f"prompt({prompt.size}) + max_new_tokens"
                 f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        # a request whose lifetime footprint exceeds the whole pool can
+        # never be admitted (or never finish): _admit would leave it
+        # queued forever and run() would spin. Reject it up front.
+        need = self.cache.blocks_for(prompt.size + max_new_tokens)
+        capacity = self.cache.num_blocks - 1    # block 0 is scratch
+        if need > capacity:
+            raise ValueError(
+                f"request needs {need} KV blocks "
+                f"(prompt {prompt.size} + {max_new_tokens} new tokens, "
+                f"block_size={self.block_size}) but the pool only has "
+                f"{capacity} — raise num_blocks or shrink the request")
         req = Request(prompt, max_new_tokens, temperature, top_k,
                       top_p, eos_id, seed)
         self.queue.append(req)
@@ -269,6 +284,11 @@ class InferenceServer:
                         if self._active[i]),
                        key=lambda i: self._slot_admit[i])
         for slot in order:
+            if not self._active[slot]:
+                # preempted by an older slot earlier in this pass —
+                # calling ensure() on it would allocate a block to an
+                # empty slot and poison its next admission
+                continue
             while not self.cache.ensure(slot, int(self._pos[slot])):
                 if not self._preempt_youngest(slot):
                     raise RuntimeError(
@@ -315,6 +335,7 @@ class InferenceServer:
             tok_np = np.asarray(tok)    # host sync = honest tick time
         now = time.perf_counter()
         emitted = 0
+        net_new = 0
         for slot in range(self.batch_slots):
             if not self._active[slot]:
                 continue
@@ -323,6 +344,12 @@ class InferenceServer:
             req.output_tokens.append(t)
             self._pos[slot] += 1
             emitted += 1
+            # tokens regenerated after a preemption were already
+            # counted before the preemption — only net-new tokens feed
+            # the throughput counters and the tokens/sec window
+            if len(req.output_tokens) > req.tokens_counted:
+                req.tokens_counted = len(req.output_tokens)
+                net_new += 1
             if req.t_first_token is None:
                 req.t_first_token = now
                 if req.ttft is not None:
@@ -332,9 +359,9 @@ class InferenceServer:
             elif len(req.output_tokens) >= req.max_new_tokens:
                 self._finish(slot, "length")
         self.ticks += 1
-        self.tokens_generated += emitted
-        self._tok_window.append((now, emitted))
-        telemetry.inc("serving_tokens_total", emitted)
+        self.tokens_generated += net_new
+        self._tok_window.append((now, net_new))
+        telemetry.inc("serving_tokens_total", net_new)
         telemetry.observe("serving_tick_seconds", now - t_tick)
         self._update_gauges()
         return emitted

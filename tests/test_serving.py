@@ -436,6 +436,69 @@ def test_server_preemption_under_tiny_pool(net):
     server.cache.check()
 
 
+def test_server_preemption_cascade_skips_evicted_slots(net):
+    """Regression: three slots churning in a 6-block pool. When an
+    older slot's ensure() preempts a younger slot that comes later in
+    the ensure pass, the pass must skip the now-evicted slot instead
+    of allocating a block to the empty slot (which poisoned its next
+    admission with 'slot already holds N blocks')."""
+    rs = np.random.RandomState(20)
+    server = InferenceServer(net, batch_slots=3, max_len=16,
+                             block_size=4, max_prompt_len=4,
+                             num_blocks=7)
+    prompts = [rs.randint(0, 256, 4).astype(np.int32)
+               for _ in range(3)]
+    reqs = [server.submit(p, max_new_tokens=8) for p in prompts]
+    server.run(max_ticks=1000)
+    assert all(r.state == "finished" for r in reqs)
+    for p, r in zip(prompts, reqs):
+        one = generate(net, p[None, :], max_new_tokens=8, max_len=16)
+        np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                      one[0, 4:])
+    server.cache.check()
+
+
+def test_server_preemption_token_accounting(net):
+    """Regression: tokens regenerated after a preemption must not be
+    counted twice into tokens_generated / serving_tokens_total."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(21)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=12,
+                                 num_blocks=6)
+        ra = server.submit(rs.randint(0, 256, 10).astype(np.int32),
+                           max_new_tokens=12)
+        rb = server.submit(rs.randint(0, 256, 10).astype(np.int32),
+                           max_new_tokens=12)
+        server.run()
+        assert ra.preemptions + rb.preemptions >= 1
+        total_out = len(ra.output_tokens) + len(rb.output_tokens)
+        assert server.tokens_generated == total_out
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving_tokens_total"] == total_out
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_server_rejects_request_larger_than_pool(net):
+    """Regression: a request whose lifetime KV footprint exceeds the
+    whole pool used to sit in the queue forever (run() spun on it);
+    submit() now rejects it up front. Requests that do fit the shrunk
+    pool still run to completion."""
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             num_blocks=3)
+    with pytest.raises(ValueError, match="KV blocks"):
+        server.submit(np.arange(12, dtype=np.int32), max_new_tokens=2)
+    r = server.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+    server.run(max_ticks=100)
+    assert r.state == "finished"
+    server.cache.check()
+
+
 def test_server_submit_validation(net):
     server = InferenceServer(net, batch_slots=2, max_len=32,
                              block_size=8, max_prompt_len=8)
