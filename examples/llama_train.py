@@ -71,7 +71,7 @@ def main():
     if args.ckpt:
         from mxnet_tpu.checkpoint import Checkpointer
         ck = Checkpointer(args.ckpt, max_to_keep=2)
-        meta = ck.restore(net=net, fused_step=step)
+        meta = ck.restore(net=net, fused_step=step, missing_ok=True)
         start = meta["step"] if meta else 0
         if start:
             print(f"resumed at step {start}")

@@ -39,6 +39,7 @@ from . import serving
 from . import amp
 from . import callback
 from . import checkpoint
+from . import faults
 from . import monitor
 from . import profiler
 from . import telemetry

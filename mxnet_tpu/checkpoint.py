@@ -15,7 +15,13 @@ Usage::
 
     ckpt = Checkpointer("/tmp/run0", max_to_keep=3)
     ckpt.save(step, net, trainer)            # or fused_step=FusedTrainStep
-    step = ckpt.restore(net, trainer)        # -> restored step (or None)
+    meta = ckpt.restore(net, trainer, missing_ok=True)  # None on fresh dir
+
+Every committed step carries a manifest (file set + byte counts +
+digest + tree structure); restore falls back to the newest VERIFIED
+step when the newest one is truncated/partial, and
+:class:`PreemptionHandler` turns SIGTERM into drain-async + one final
+synchronous save.
 
 Single-file helpers :func:`save_checkpoint` / :func:`load_checkpoint`
 wrap a one-off Checkpointer. Multi-host: orbax coordinates all
@@ -23,18 +29,24 @@ processes; call on every process (not just rank 0).
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import signal as _signal
+import warnings
+from typing import Any, Dict, List, Optional
 
 import numpy as _np
 
 import jax
 import jax.numpy as jnp
 
+from . import faults as _ft
 from . import random as _random
+from . import telemetry as _tm
 
-__all__ = ["Checkpointer", "save_checkpoint", "load_checkpoint",
-           "latest_step"]
+__all__ = ["Checkpointer", "PreemptionHandler", "save_checkpoint",
+           "load_checkpoint", "latest_step"]
 
 
 def _net_state(net) -> Dict[str, Any]:
@@ -44,10 +56,18 @@ def _net_state(net) -> Dict[str, Any]:
 
 def _trainer_state(trainer) -> Dict[str, Any]:
     trainer._init_states()
+    states = trainer._states
+    if trainer._mt_updater is not None and trainer._mt_updater.zero1:
+        # gather-on-save (same as Trainer.save_states): eager-ZeRO
+        # sharded bucket state exports back to full per-parameter
+        # trees so the checkpoint restores under ANY replica count.
+        # Copy first — the live dict keeps its resident shards.
+        states = dict(states)
+        trainer._mt_updater.zero1_export_states(states)
     # index_update_count keys are ints; stringify for the json leaf
     opt = trainer._optimizer
     return {
-        "slots": {str(i): s for i, s in trainer._states.items()
+        "slots": {str(i): s for i, s in states.items()
                   if s is not None},
         "meta": {"num_update": int(opt.num_update),
                  "index_update_count": {
@@ -60,17 +80,88 @@ def _fused_state(fused) -> Dict[str, Any]:
     if fused._params is None:  # snapshot before the first step
         return {"slots": None, "meta": {"num_update": 0}}
     fused.sync_to_params()
-    return {"slots": fused._states,
+    # export_states de-buckets zero>=1 sharded slots to per-name trees
+    # so the checkpoint restores onto a different replica count
+    return {"slots": fused.export_states(),
             "meta": {"num_update": int(fused._step_count)}}
 
 
+_ORBAX_CPU_MP_PATCHED = False
+
+
+def _patch_orbax_multiprocess_cpu():
+    """orbax 0.7 coordinates processes with device collectives
+    (``multihost_utils.sync_global_devices`` / ``broadcast_one_to_all``
+    run a jitted psum), which the CPU backend rejects on multi-process
+    jobs ("Multiprocess computations aren't implemented on the CPU
+    backend"). Re-route its process barriers through the
+    jax.distributed client barrier and its host broadcasts through the
+    coordination-service KV store — both backend-independent — so
+    multi-process CPU jobs (the dryrun's kill-restart gang, CI) can
+    share one checkpoint directory like a real pod."""
+    global _ORBAX_CPU_MP_PATCHED
+    if _ORBAX_CPU_MP_PATCHED:
+        return
+    _ORBAX_CPU_MP_PATCHED = True
+    import base64
+    import itertools
+    import pickle
+
+    import orbax.checkpoint as ocp
+    from orbax.checkpoint import multihost as omh
+
+    def _sync(name, timeout=None, processes=None, barrier_sync_fn=None,
+              **_kw):
+        if omh.utils.should_skip_process_sync():
+            return
+        fn = barrier_sync_fn or omh.utils.get_barrier_sync_fn(
+            processes=processes)
+        timeout = timeout or omh.utils._DEFAULT_BARRIER_TIMEOUT
+        fn(key=name, timeout_ms=int(timeout * 1000))
+
+    _counter = itertools.count()
+
+    def _bcast(in_tree, is_source=None):
+        if jax.process_count() == 1:
+            return in_tree
+        if is_source is None:
+            is_source = jax.process_index() == 0
+        client = jax._src.distributed.global_state.client
+        key = f"mxtpu/ocp_bcast/{next(_counter)}"
+        if is_source:
+            client.key_value_set(key, base64.b64encode(
+                pickle.dumps(in_tree)).decode())
+        blob = client.blocking_key_value_get(key, 600_000)
+        return pickle.loads(base64.b64decode(blob))
+
+    for mod in (omh.utils, omh):
+        mod.sync_global_processes = _sync
+        mod.broadcast_one_to_all = _bcast
+    ocp.utils.broadcast_one_to_all = _bcast  # import-time alias
+
+
 class Checkpointer:
-    """Versioned training checkpoints in ``directory/<step>/``."""
+    """Versioned training checkpoints in ``directory/<step>/``.
+
+    Every committed step gets a companion manifest
+    (``directory/_manifests/<step>.json``) recording the step's file
+    set with byte counts, a digest over that listing, and the saved
+    tree structure (leaf paths / shapes / dtypes). :meth:`restore`
+    verifies the newest step against its manifest before trusting it
+    and falls back to the newest VERIFIED step when bytes are missing
+    or truncated — a preemption mid-write (or mid-manifest) therefore
+    costs at most one checkpoint interval, never the whole run.
+    Directories written before manifests existed restore as before
+    (no ``_manifests/`` dir → every step is trusted)."""
+
+    _MANIFESTS = "_manifests"
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = None,
                  async_save: bool = False):
         import orbax.checkpoint as ocp
         self._ocp = ocp
+        if jax.process_count() > 1 and jax.default_backend() == "cpu":
+            _patch_orbax_multiprocess_cpu()
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         opts = ocp.CheckpointManagerOptions(
@@ -78,12 +169,119 @@ class Checkpointer:
             enable_async_checkpointing=async_save)
         self._mngr = ocp.CheckpointManager(self.directory, options=opts)
         self._async = async_save
+        # manifests for async saves are deferred until the data is
+        # known committed (wait/restore/close/next save); a kill in the
+        # gap leaves the step unverified == invisible to restore
+        self._pending_manifest: Dict[int, list] = {}
+
+    # -- manifests ----------------------------------------------------------
+    def _manifest_dir(self) -> str:
+        return os.path.join(self.directory, self._MANIFESTS)
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir(), f"{int(step)}.json")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _scan_files(self, step: int) -> Dict[str, int]:
+        root = self._step_dir(step)
+        out: Dict[str, int] = {}
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                p = os.path.join(dirpath, fn)
+                out[os.path.relpath(p, root)] = os.path.getsize(p)
+        return out
+
+    @staticmethod
+    def _digest(files: Dict[str, int]) -> str:
+        h = hashlib.sha256()
+        for rel in sorted(files):
+            h.update(f"{rel}\x00{int(files[rel])}\n".encode())
+        return h.hexdigest()
+
+    def _commit_manifest(self, step: int, leaves: list):
+        if jax.process_index() != 0:
+            # multi-process job sharing one directory: the primary owns
+            # the manifest (all processes see identical bytes anyway)
+            return
+        files = self._scan_files(step)
+        man = {"step": int(step), "files": files,
+               "digest": self._digest(files), "leaves": leaves}
+        os.makedirs(self._manifest_dir(), exist_ok=True)
+        tmp = self._manifest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(man, f)
+        os.replace(tmp, self._manifest_path(step))  # atomic commit
+
+    def _flush_manifests(self):
+        """Commit deferred manifests for async saves that have landed,
+        and drop manifests whose step dir was garbage-collected."""
+        for step in list(self._pending_manifest):
+            leaves, spec = self._pending_manifest.pop(step)
+            if os.path.isdir(self._step_dir(step)):
+                self._commit_manifest(step, leaves)
+                self._apply_truncate(step, spec)
+        mdir = self._manifest_dir()
+        if os.path.isdir(mdir):
+            for fn in os.listdir(mdir):
+                stem = fn.rsplit(".", 1)[0]
+                if fn.endswith(".json") and stem.lstrip("-").isdigit() \
+                        and not os.path.isdir(self._step_dir(int(stem))):
+                    os.unlink(os.path.join(mdir, fn))
+
+    def verify_step(self, step: int) -> bool:
+        """True iff `step`'s on-disk bytes match its manifest (file
+        set, byte counts, digest). Steps without a manifest are trusted
+        only in legacy directories (no ``_manifests/`` at all)."""
+        mp = self._manifest_path(step)
+        if not os.path.isfile(mp):
+            return not os.path.isdir(self._manifest_dir())
+        try:
+            with open(mp) as f:
+                man = json.load(f)
+        except (ValueError, OSError):
+            return False
+        files = self._scan_files(step)
+        want = {k: int(v) for k, v in man.get("files", {}).items()}
+        return files == want and self._digest(files) == man.get("digest")
+
+    def _apply_truncate(self, step: int, spec):
+        """checkpoint.truncate fault: chop the step's largest file
+        (the array data) to simulate a half-written checkpoint. The
+        fault is FIRED at save() time (so it attaches to the step
+        being saved, not whichever async step flushes next) and
+        applied here, after the manifest committed."""
+        if spec is None:
+            return
+        if str(spec.get("mode", "")).lower() == "nomanifest":
+            # the kill landed between the data commit and the manifest
+            # write: bytes are fine but the step is unverifiable
+            try:
+                os.unlink(self._manifest_path(step))
+            except OSError:
+                pass
+            return
+        files = self._scan_files(step)
+        if not files:
+            return
+        rel = max(files, key=lambda r: files[r])
+        keep = spec.get("bytes", spec.get("keep"))
+        _ft.truncate_file(os.path.join(self._step_dir(step), rel),
+                          keep_bytes=None if keep is None else int(keep))
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, net=None, trainer=None, fused_step=None,
-             extra: Optional[dict] = None):
-        """Snapshot everything needed to resume at `step`."""
+             extra: Optional[dict] = None, force_sync: bool = False):
+        """Snapshot everything needed to resume at `step`.
+        ``force_sync=True`` blocks until committed even on an
+        async_save checkpointer (the preemption-drain final save)."""
         ocp = self._ocp
+        if self._pending_manifest:
+            # previous async save: wait for its commit so the manifest
+            # lands before a new save can race the step-dir scan
+            self._mngr.wait_until_finished()
+            self._flush_manifests()
         arrays: Dict[str, Any] = {}
         meta: Dict[str, Any] = {"step": int(step)}
         if net is not None:
@@ -101,28 +299,100 @@ class Checkpointer:
         arrays["rng_key"] = _random._st().key
         if extra:
             meta["extra"] = extra
+        if jax.process_count() > 1:
+            # orbax refuses host-local jax arrays on multi-process
+            # jobs; ours are replicated-identical (gathered by
+            # sync_to_params / zero1 export), so hand them over as
+            # numpy and let the primary write them. Cross-host
+            # sharded arrays stay jax.Arrays for distributed
+            # serialization.
+            arrays = jax.tree_util.tree_map(
+                lambda a: _np.asarray(a)
+                if isinstance(a, jax.Array) and a.is_fully_addressable
+                and a.dtype.kind in "biufc" else a, arrays)
+        leaves = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(arrays)[0]:
+            name = jax.tree_util.keystr(path)
+            if hasattr(leaf, "shape"):
+                leaves.append([name, [int(d) for d in leaf.shape],
+                               str(leaf.dtype)])
+            else:
+                leaves.append([name, None, type(leaf).__name__])
+        trunc = _ft.fire("checkpoint.truncate") if _ft._ACTIVE else None
         self._mngr.save(int(step), args=ocp.args.Composite(
             state=ocp.args.StandardSave(arrays),
             meta=ocp.args.JsonSave(meta)))
-        if not self._async:
+        if self._async and not force_sync:
+            self._pending_manifest[int(step)] = (leaves, trunc)
+        else:
             self._mngr.wait_until_finished()
+            self._commit_manifest(int(step), leaves)
+            self._apply_truncate(int(step), trunc)
 
     # -- restore ------------------------------------------------------------
     def restore(self, net=None, trainer=None, fused_step=None,
-                step: Optional[int] = None) -> Optional[dict]:
-        """Load the given (default: latest) step back into net/trainer.
-        Returns the meta dict ({'step': ..., 'extra': ...}) or None when
-        the directory holds no checkpoints."""
+                step: Optional[int] = None,
+                missing_ok: bool = False) -> Optional[dict]:
+        """Load the given (default: newest VERIFIED) step back into
+        net/trainer and return its meta dict ({'step': ..., ...}).
+
+        Steps failing manifest verification (truncated / missing
+        bytes) — and steps whose actual restore raises — are skipped
+        with a warning, falling back to the next older verified step;
+        each such fallback counts ``checkpoint_fallbacks_total``. An
+        explicitly requested broken ``step`` raises instead.
+
+        A directory with no checkpoints at all raises
+        :class:`FileNotFoundError`; pass ``missing_ok=True`` for the
+        resume-or-cold-start pattern (returns None)."""
         ocp = self._ocp
-        self._mngr.wait_until_finished()  # drain any in-flight async save
-        if step is None:
-            step = self._mngr.latest_step()
-            if step is None:
+        self.wait()  # drain any in-flight async save + its manifest
+        steps = sorted(self._mngr.all_steps())
+        if not steps:
+            if missing_ok:
                 return None
-        restored = self._mngr.restore(
-            int(step), args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(),
-                meta=ocp.args.JsonRestore()))
+            raise FileNotFoundError(
+                f"no checkpoints found in {self.directory!r} — nothing "
+                "to restore (pass missing_ok=True to start fresh)")
+        explicit = step is not None
+        if explicit and int(step) not in steps:
+            raise FileNotFoundError(
+                f"checkpoint step {int(step)} not found in "
+                f"{self.directory!r} (available: {steps})")
+        candidates = [int(step)] if explicit else steps[::-1]
+        restored = None
+        for s in candidates:
+            if not self.verify_step(s):
+                if explicit:
+                    raise RuntimeError(
+                        f"checkpoint step {s} in {self.directory!r} "
+                        "failed manifest verification (truncated or "
+                        "partially written) — refusing to restore it")
+                warnings.warn(
+                    f"checkpoint step {s} in {self.directory!r} failed "
+                    "manifest verification; falling back to the next "
+                    "older verified step")
+                _tm.inc("checkpoint_fallbacks_total")
+                continue
+            try:
+                restored = self._mngr.restore(
+                    s, args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(),
+                        meta=ocp.args.JsonRestore()))
+                step = s
+                break
+            except Exception:
+                if explicit:
+                    raise
+                warnings.warn(
+                    f"restoring checkpoint step {s} from "
+                    f"{self.directory!r} raised; falling back to the "
+                    "next older step")
+                _tm.inc("checkpoint_fallbacks_total")
+        if restored is None:
+            raise RuntimeError(
+                f"no restorable checkpoint in {self.directory!r}: all "
+                f"steps {steps[::-1]} failed verification or restore")
         arrays, meta = restored["state"], restored["meta"]
         if "rng_key" in arrays:
             _random._st().key = jnp.asarray(arrays["rng_key"]).astype(
@@ -147,6 +417,11 @@ class Checkpointer:
         for k, s in arrays["opt"].items():
             trainer._states[int(k)] = jax.tree_util.tree_map(
                 jnp.asarray, s)
+        if trainer._mt_updater is not None and trainer._mt_updater.zero1:
+            # drop resident sharded state; the next step re-imports the
+            # restored full per-param trees into (possibly differently
+            # sized) shard groups — elastic across replica counts
+            trainer._mt_updater.zero1_reset()
         om = meta.get("opt_meta", {})
         opt = trainer._optimizer
         opt.num_update = om.get("num_update", opt.num_update)
@@ -171,8 +446,12 @@ class Checkpointer:
         fused.refresh_weights()
         fused._aux = {n: params[n].data()._data for n in fused._aux_names}
         if "opt" in arrays:
-            fused._states = jax.tree_util.tree_map(
-                jnp.asarray, arrays["opt"])
+            slots = jax.tree_util.tree_map(jnp.asarray, arrays["opt"])
+            if fused._zero1_groups is not None and not any(
+                    str(k).startswith("__zero1__") for k in slots):
+                # per-name portable slots -> this mesh's bucket layout
+                slots = fused._bucket_states(slots)
+            fused._states = slots
         if step_count is not None:
             fused._step_count = step_count
         if fused.mesh is not None and fused._compiled is not None:
@@ -187,17 +466,94 @@ class Checkpointer:
             fused._states = jax.device_put(fused._states, fused._st_sh)
 
     def wait(self):
-        """Block until any in-flight async save has committed."""
+        """Block until any in-flight async save has committed (and its
+        manifest with it)."""
         self._mngr.wait_until_finished()
+        self._flush_manifests()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step that passes manifest verification, or None."""
+        for s in sorted(self._mngr.all_steps(), reverse=True):
+            if self.verify_step(s):
+                return s
+        return None
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 
     def close(self):
+        self._mngr.wait_until_finished()
+        self._flush_manifests()
         self._mngr.close()
+
+
+class PreemptionHandler:
+    """Preemption-safe elastic checkpointing: catch SIGTERM (the TPU
+    preemption notice), drain any in-flight async save, and write ONE
+    final synchronous checkpoint before the SIGKILL deadline.
+
+    The handler only sets a flag — all checkpoint work happens
+    cooperatively in the training loop, where the model state is
+    consistent (a signal can land mid-optimizer-update; saving from
+    the handler itself would snapshot half-updated weights)::
+
+        ck = Checkpointer(dir, async_save=True)
+        with PreemptionHandler(ck) as ph:
+            for step in range(start, num_steps):
+                loss = train_step(...)
+                if step % 100 == 0:
+                    ck.save(step, net=net, trainer=trainer)
+                if ph.preempted:
+                    ph.finalize(step, net=net, trainer=trainer)
+                    break
+
+    On restart, ``ck.restore(..., missing_ok=True)`` resumes from the
+    final checkpoint — or, had the kill landed mid-write, from the
+    newest older step that verifies."""
+
+    def __init__(self, checkpointer: Checkpointer,
+                 signals=(_signal.SIGTERM,)):
+        self._ck = checkpointer
+        self._signals = tuple(signals)
+        self._old: Dict[int, Any] = {}
+        self.preempted = False
+        self.signum: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        self.preempted = True
+        self.signum = signum
+
+    def install(self) -> "PreemptionHandler":
+        for s in self._signals:
+            self._old[s] = _signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self):
+        for s, h in self._old.items():
+            _signal.signal(s, h)
+        self._old.clear()
+
+    __enter__ = install
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    def finalize(self, step: Optional[int] = None, net=None, trainer=None,
+                 fused_step=None, extra: Optional[dict] = None
+                 ) -> Optional[int]:
+        """Drain in-flight async saves, then write a final synchronous
+        checkpoint at `step` (skipped when `step` is already on disk —
+        the periodic save just committed it). Returns the step the job
+        can resume from."""
+        self._ck.wait()
+        if step is not None and int(step) not in self._ck.all_steps():
+            self._ck.save(int(step), net=net, trainer=trainer,
+                          fused_step=fused_step, extra=extra,
+                          force_sync=True)
+        return self._ck.latest_verified_step()
 
 
 def save_checkpoint(directory: str, step: int, net=None, trainer=None,
@@ -212,12 +568,13 @@ def save_checkpoint(directory: str, step: int, net=None, trainer=None,
 
 
 def load_checkpoint(directory: str, net=None, trainer=None,
-                    fused_step=None,
-                    step: Optional[int] = None) -> Optional[dict]:
+                    fused_step=None, step: Optional[int] = None,
+                    missing_ok: bool = False) -> Optional[dict]:
     ck = Checkpointer(directory)
     try:
         return ck.restore(net=net, trainer=trainer,
-                          fused_step=fused_step, step=step)
+                          fused_step=fused_step, step=step,
+                          missing_ok=missing_ok)
     finally:
         ck.close()
 

@@ -1,0 +1,302 @@
+"""Deterministic fault injection for fault-tolerance testing.
+
+Production-scale training and serving must survive preemptions, corrupt
+checkpoints, NaN gradient spikes, stalled collectives, and slow hosts —
+but none of those happen on demand in a unit test. This module makes
+them happen on demand: a process-wide registry of *named injection
+sites* that the runtime consults at well-defined points, armed either
+programmatically (:func:`inject`) or via the ``MXNET_TPU_FAULTS``
+environment variable (so subprocess harnesses — the kill-and-restart
+resume tests, the multihost dryrun — can arm faults in a child they
+never import into).
+
+Sites shipped with the framework (grep for the constant to find the
+instrumented line):
+
+====================== ====================================================
+site                   fires at
+====================== ====================================================
+``checkpoint.truncate`` after a ``Checkpointer.save`` commit: truncates a
+                        just-written array file (and per ``mode`` drops the
+                        step's manifest) — a crash/bitrot mid-write
+``collective.timeout``  entry of ``KVStore.pushpull`` — raises
+                        :class:`FaultTimeout` like a hung collective
+``grad.nonfinite``      ``Trainer.step`` before the update — poisons one
+                        parameter's gradient with NaN/Inf
+``step.kill``           ``Trainer.step`` / ``FusedTrainStep.__call__``
+                        mid-step (grads exist, update not yet applied) —
+                        SIGKILLs the process like a preemption
+``host.slow``           same call sites — sleeps ``ms`` (straggler host)
+``serving.stall``       ``InferenceServer.step`` — skips the decode tick
+                        (a wedged device) so the watchdog has something
+                        real to catch
+``multihost.break``     ``multihost.initialize`` — raises, proving the
+                        dryrun turns red over a broken multihost path
+====================== ====================================================
+
+Env grammar (``;``-separated entries, ``:``-separated fields, first
+field is the site name)::
+
+    MXNET_TPU_FAULTS="step.kill:at=3;grad.nonfinite:at=2:times=1"
+
+Trigger keys (combine freely; all optional):
+
+- ``at=K``     fire on the K-th hit of the site (1-based); implies
+               ``times=1`` unless given
+- ``after=K``  fire on every hit strictly after the K-th
+- ``every=N``  fire when ``hits % N == 0``
+- ``p=0.25``   fire with probability p from the injector's seeded RNG
+               (``seed=S`` per entry; default 0 — deterministic runs)
+- ``times=M``  stop after M fires (default unlimited)
+
+Payload keys ride in the same entry and are handed back by
+:func:`fire` (e.g. ``ms=50`` for ``host.slow``, ``bytes=128`` /
+``mode=nomanifest`` for ``checkpoint.truncate``, ``signal=term`` for
+``step.kill``).
+
+Cost contract: like telemetry, the whole layer is off by default —
+instrumented hot paths guard on the single module flag ``_ACTIVE``
+(one attribute load + branch), so un-armed production runs pay nothing.
+Every fire increments ``faults_injected_total{site=...}`` on the
+telemetry registry.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+import signal as _signal
+import threading
+import time
+from typing import Dict, Optional
+
+from . import telemetry as _tm
+
+__all__ = ["SITES", "FaultInjected", "FaultTimeout",
+           "configure", "inject", "clear", "reset_counts", "active",
+           "specs", "hits", "fires", "fire",
+           "kill_point", "delay_point", "timeout_point", "poison_grads",
+           "truncate_file"]
+
+#: the named injection sites instrumented across the stack
+SITES = ("checkpoint.truncate", "collective.timeout", "grad.nonfinite",
+         "step.kill", "host.slow", "serving.stall", "multihost.break")
+
+
+class FaultInjected(RuntimeError):
+    """An armed fault fired. `.site` names the injection site."""
+
+    def __init__(self, site: str, msg: Optional[str] = None):
+        super().__init__(msg or f"injected fault at site {site!r}")
+        self.site = site
+
+
+class FaultTimeout(FaultInjected, TimeoutError):
+    """Injected collective/IO timeout (isinstance TimeoutError)."""
+
+
+#: THE flag: instrumented call sites guard with `if faults._ACTIVE:` so
+#: the un-armed path never takes the lock or formats a string.
+_ACTIVE = False
+
+_lock = threading.RLock()
+
+
+class _Spec:
+    __slots__ = ("site", "opts", "hits", "fires", "rng")
+
+    def __init__(self, site: str, opts: Dict):
+        self.site = site
+        self.opts = dict(opts)
+        self.hits = 0
+        self.fires = 0
+        self.rng = _pyrandom.Random(int(opts.get("seed", 0)))
+
+    def should_fire(self) -> bool:
+        self.hits += 1
+        o = self.opts
+        times = o.get("times",
+                      1 if "at" in o and "every" not in o else None)
+        if times is not None and self.fires >= int(times):
+            return False
+        trig = False
+        if "at" in o and self.hits == int(o["at"]):
+            trig = True
+        if "after" in o and self.hits > int(o["after"]):
+            trig = True
+        if "every" in o and self.hits % int(o["every"]) == 0:
+            trig = True
+        if "p" in o and self.rng.random() < float(o["p"]):
+            trig = True
+        if not ({"at", "after", "every", "p"} & o.keys()):
+            trig = True  # bare site = fire on every hit (up to `times`)
+        if trig:
+            self.fires += 1
+        return trig
+
+
+_SPECS: Dict[str, _Spec] = {}
+
+
+def _parse_value(v: str):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
+
+
+def configure(spec: Optional[str] = None):
+    """Replace the armed set from a ``MXNET_TPU_FAULTS``-grammar string
+    (None/empty = disarm everything)."""
+    global _ACTIVE
+    with _lock:
+        _SPECS.clear()
+        for entry in (spec or "").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            fields = entry.split(":")
+            site, opts = fields[0].strip(), {}
+            for f in fields[1:]:
+                if not f.strip():
+                    continue
+                k, _, v = f.partition("=")
+                opts[k.strip()] = _parse_value(v.strip())
+            _SPECS[site] = _Spec(site, opts)
+        _ACTIVE = bool(_SPECS)
+
+
+def inject(site: str, **opts):
+    """Arm one site programmatically (tests). Replaces any existing
+    spec for the site; trigger/payload keys as in the env grammar."""
+    global _ACTIVE
+    with _lock:
+        _SPECS[site] = _Spec(site, opts)
+        _ACTIVE = True
+
+
+def clear(site: Optional[str] = None):
+    """Disarm one site (or all of them)."""
+    global _ACTIVE
+    with _lock:
+        if site is None:
+            _SPECS.clear()
+        else:
+            _SPECS.pop(site, None)
+        _ACTIVE = bool(_SPECS)
+
+
+def reset_counts():
+    """Zero every armed site's hit/fire counters (keeps them armed)."""
+    with _lock:
+        for sp in _SPECS.values():
+            sp.hits = 0
+            sp.fires = 0
+            sp.rng = _pyrandom.Random(int(sp.opts.get("seed", 0)))
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def specs() -> Dict[str, dict]:
+    with _lock:
+        return {s: dict(sp.opts) for s, sp in _SPECS.items()}
+
+
+def hits(site: str) -> int:
+    sp = _SPECS.get(site)
+    return sp.hits if sp is not None else 0
+
+
+def fires(site: str) -> int:
+    sp = _SPECS.get(site)
+    return sp.fires if sp is not None else 0
+
+
+def fire(site: str) -> Optional[dict]:
+    """One hit of `site`: returns the payload dict when the armed spec
+    triggers (counting ``faults_injected_total{site=...}``), else None.
+    Un-armed sites return None without counting a hit."""
+    if not _ACTIVE:
+        return None
+    with _lock:
+        sp = _SPECS.get(site)
+        if sp is None or not sp.should_fire():
+            return None
+        _tm.inc("faults_injected_total", site=site)
+        return dict(sp.opts)
+
+
+# -- site behaviors (called from the instrumented lines) --------------------
+
+def kill_point(site: str = "step.kill"):
+    """Die like a preemption: SIGKILL self (``signal=term`` sends
+    SIGTERM instead — exercising the graceful path; ``signal=exit``
+    hard-exits with code 9)."""
+    spec = fire(site)
+    if spec is None:
+        return
+    how = str(spec.get("signal", "kill")).lower()
+    if how == "exit":
+        os._exit(9)
+    sig = _signal.SIGTERM if how == "term" else _signal.SIGKILL
+    os.kill(os.getpid(), sig)
+    # SIGTERM may be handled (that is the point of the preemption
+    # handler test); SIGKILL never returns here.
+
+
+def delay_point(site: str = "host.slow"):
+    """Straggle: sleep the spec's ``ms`` (default 50)."""
+    spec = fire(site)
+    if spec is not None:
+        time.sleep(float(spec.get("ms", 50)) / 1e3)
+
+
+def timeout_point(site: str = "collective.timeout"):
+    """Raise :class:`FaultTimeout` as if the collective hung past its
+    deadline (after an optional ``ms`` stall)."""
+    spec = fire(site)
+    if spec is not None:
+        ms = float(spec.get("ms", 0))
+        if ms:
+            time.sleep(ms / 1e3)
+        raise FaultTimeout(site, f"injected collective timeout at "
+                                 f"{site!r} (hit {hits(site)})")
+
+
+def poison_grads(params, site: str = "grad.nonfinite") -> bool:
+    """Overwrite the first parameter-with-a-grad's gradient with the
+    spec's ``value`` (nan|inf|-inf, default nan). Returns True when a
+    grad was poisoned."""
+    spec = fire(site)
+    if spec is None:
+        return False
+    import jax.numpy as jnp
+    val = {"inf": float("inf"), "-inf": float("-inf")}.get(
+        str(spec.get("value", "nan")).lower(), float("nan"))
+    for p in params:
+        if p.grad_req == "null":
+            continue
+        g = p.grad()
+        if g is None or not getattr(g._data, "size", 0):
+            continue
+        g._data = jnp.full(g._data.shape, val, g._data.dtype)
+        return True
+    return False
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None):
+    """Chop a file to `keep_bytes` (default: half its size) — the
+    checkpoint-corruption primitive."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else min(int(keep_bytes), size)
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep
+
+
+# arm from the environment at import (subprocess harnesses set this)
+if os.environ.get("MXNET_TPU_FAULTS"):
+    configure(os.environ["MXNET_TPU_FAULTS"])

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from .. import faults as _ft
 from .. import multi_tensor as _mt
 from .. import optimizer as opt
 from .. import telemetry as _tm
@@ -21,7 +22,104 @@ from ..ndarray import NDArray
 from ..sparse import RowSparseNDArray
 from .parameter import Parameter, ParameterDict
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "GradSanitizer"]
+
+
+class GradSanitizer:
+    """Skip the optimizer step when the global gradient state is
+    non-finite (NaN/Inf), instead of training on poison.
+
+    One NaN gradient silently corrupts every weight it touches and the
+    run never recovers; at pod scale a single flipped bit or an fp16
+    overflow produces exactly that. The sanitizer checks EVERY live
+    gradient buffer before the update — full-size ``p.grad()`` buffers
+    on the standard path, and the reduce-scattered 1/N flat shards plus
+    pending hook cotangents under ZeRO-2/3 (where the full buffers are
+    already freed) — and on a non-finite verdict:
+
+    - skips the update (weights and optimizer state untouched),
+    - clears the poisoned grads (zeroed buffers / discarded shards so
+      ``grad_req="add"`` accumulation cannot carry the NaN forward),
+    - backs off the AMP loss scale when a :class:`~mxnet_tpu.amp.
+      DynamicLossScaler` is attached (fp16 overflow IS the common
+      cause — the skip and the scale halving are one mechanism),
+    - counts ``steps_skipped_nonfinite_total`` on the telemetry
+      registry.
+
+    The skip budget is bounded: more than ``max_consecutive_skips``
+    non-finite steps in a row raises :class:`FloatingPointError` — at
+    that point the run is diverged, not unlucky, and restarting from
+    the last checkpoint beats burning pod-hours skipping forever. A
+    finite step resets the budget."""
+
+    def __init__(self, max_consecutive_skips: int = 8):
+        self.max_consecutive_skips = int(max_consecutive_skips)
+        self.consecutive_skips = 0
+        self.total_skips = 0
+        self.last_skip_step: Optional[int] = None
+
+    # -- checks -------------------------------------------------------------
+    def _grad_arrays(self, trainer) -> list:
+        arrs = []
+        for p in trainer._params:
+            if p.grad_req == "null":
+                continue
+            gb = p._data._grad if p._data is not None else None
+            if gb is not None and getattr(gb._data, "size", 0):
+                arrs.append(gb._data)
+        if trainer._mt_updater is not None:
+            arrs.extend(trainer._mt_updater.grad_shard_arrays())
+        return arrs
+
+    def grads_finite(self, trainer) -> bool:
+        """True iff every live grad buffer/shard is finite. One host
+        sync (the all-reduce of the per-array isfinite flags)."""
+        arrs = self._grad_arrays(trainer)
+        if not arrs:
+            return True
+        flags = [jnp.isfinite(a).all() for a in arrs]
+        return bool(jnp.stack(flags).all())
+
+    def _clear_grads(self, trainer):
+        for p in trainer._params:
+            if p.grad_req == "null":
+                continue
+            gb = p._data._grad if p._data is not None else None
+            if gb is not None and getattr(gb._data, "size", 0):
+                gb._data = jnp.zeros_like(gb._data)
+        if trainer._mt_updater is not None:
+            trainer._mt_updater.discard_grads()
+
+    # -- the gate -----------------------------------------------------------
+    def precheck(self, trainer) -> bool:
+        """Run before the update. Returns True when the step may
+        proceed; False (after cleanup + backoff) when it must be
+        skipped."""
+        scaler = getattr(trainer, "_amp_scaler", None)
+        if self.grads_finite(trainer):
+            self.consecutive_skips = 0
+            if scaler is not None:
+                scaler.update_scale(False)
+                trainer._scale = 1.0 / scaler.loss_scale
+            return True
+        self.consecutive_skips += 1
+        self.total_skips += 1
+        self.last_skip_step = int(trainer._optimizer.num_update)
+        self._clear_grads(trainer)
+        if scaler is not None:
+            # fp16 overflow backoff: halve the loss scale exactly like
+            # the reference DynamicLossScaler skip path
+            scaler.update_scale(True)
+            trainer._scale = 1.0 / scaler.loss_scale
+        _tm.inc("steps_skipped_nonfinite_total")
+        if self.consecutive_skips > self.max_consecutive_skips:
+            raise FloatingPointError(
+                f"gradients non-finite for {self.consecutive_skips} "
+                f"consecutive steps (> max_consecutive_skips="
+                f"{self.max_consecutive_skips}) — the run has diverged; "
+                "restore from the last verified checkpoint (and lower "
+                "the LR or enable AMP loss scaling)")
+        return False
 
 
 class Trainer:
@@ -29,7 +127,7 @@ class Trainer:
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, multi_tensor=True,
                  zero1=False, zero1_shards=None, zero=None,
-                 pipeline=None):
+                 pipeline=None, skip_nonfinite=False):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -83,6 +181,17 @@ class Trainer:
                              f"count; got {pipeline!r}")
         self._pipeline_req = int(pipeline) if pipeline is not None \
             else None
+        # non-finite gradient gate (fault tolerance): False = off,
+        # True = GradSanitizer with defaults, an int = skip budget, or
+        # a ready-made GradSanitizer instance
+        if isinstance(skip_nonfinite, GradSanitizer):
+            self._sanitizer: Optional[GradSanitizer] = skip_nonfinite
+        elif skip_nonfinite:
+            self._sanitizer = GradSanitizer(
+                max_consecutive_skips=skip_nonfinite
+                if not isinstance(skip_nonfinite, bool) else 8)
+        else:
+            self._sanitizer = None
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):
@@ -215,9 +324,44 @@ class Trainer:
         semantics)."""
         self._init_states()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if _ft._ACTIVE:
+            # fault-injection sites for the eager step: a kill here
+            # lands with step N-1 committed and step N not — the exact
+            # state the checkpoint-resume harness must survive
+            _ft.kill_point("step.kill")
+            _ft.delay_point("host.slow")
+            spec = _ft.fire("grad.nonfinite")
+            if spec is not None:
+                self._poison_grads(spec)
+        if self._sanitizer is not None and \
+                not self._sanitizer.precheck(self):
+            return  # skipped: weights/opt-state untouched, grads cleared
         self._update()
         if _tm._ENABLED:
             _tm.step_done(batch_size)
+
+    def _poison_grads(self, spec):
+        """grad.nonfinite fault payload: overwrite one live gradient
+        buffer with NaN/Inf (``value=nan|inf|-inf``). Targets a full
+        ``p.grad()`` buffer when resident; under ZeRO-2/3 (full buffers
+        freed mid-backward) poisons the first resident grad shard
+        instead, so the injection reaches every sharding stage."""
+        val = float(spec.get("value", "nan"))
+        for p in self._params:
+            gb = p._data._grad if p._data is not None else None
+            if gb is not None and getattr(gb._data, "size", 0):
+                gb._data = jnp.full_like(gb._data, val)
+                return
+        if self._mt_updater is not None:
+            for zg in self._mt_updater._zgroups.values():
+                if zg.gshards is None:
+                    continue
+                for j, a in enumerate(zg.gshards):
+                    if a is not None:
+                        # elementwise arithmetic keeps the shard's
+                        # sharding (full_like would replicate it)
+                        zg.gshards[j] = a * 0 + val
+                        return
 
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from . import faults as _ft
 from . import telemetry as _tm
 from .ndarray import NDArray
 from .sparse import RowSparseNDArray
@@ -199,6 +200,11 @@ class KVStore:
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (reference: kvstore 'pushpull' / NCCL path).
         Without an optimizer attached this is a pure gradient allreduce."""
+        if _ft._ACTIVE:
+            # every collective (incl. flat buckets / reduce-scatter)
+            # funnels through here — the one choke point where a hung
+            # allreduce can be simulated deterministically
+            _ft.timeout_point("collective.timeout")
         if isinstance(key, (list, tuple)):
             for i, k in enumerate(key):
                 self.pushpull(k, value[i],

@@ -673,15 +673,20 @@ class MultiTensorUpdater:
         sig = self._hook_signature()
         if self._hook_map is not None and sig == self._hook_sig:
             return
-        self._hook_sig = sig
-        self._hook_map = {}
+        self._hook_sig = None
         live = [(i, p) for i, p in self._hook_params
                 if p.grad_req != "null"]
         groups = self._group_members(live, self._hook_states)
+        # build into a local dict: _zero_group_for nukes self._hook_map
+        # when it (re)builds a group (e.g. after zero1_reset), which
+        # would otherwise happen mid-loop
+        hmap = {}
         for gid, members in enumerate(groups.values()):
             zg = self._zero_group_for(gid, members, self._hook_states)
             for k, (i, _, _) in enumerate(members):
-                self._hook_map[i] = (zg, gid, zg.k2bucket[k], k)
+                hmap[i] = (zg, gid, zg.k2bucket[k], k)
+        self._hook_map = hmap
+        self._hook_sig = sig
 
     def _hook_fire(self, i, arr, g) -> bool:
         """Autograd delivered leaf i's finalized cotangent. Stash it in
@@ -762,6 +767,39 @@ class MultiTensorUpdater:
         else:
             zg.gshards[j] = shard_flat
         zg.gfresh[j] = True
+
+    def grad_shard_arrays(self):
+        """Every live stage>=2 gradient array this updater holds: the
+        resident reduce-scattered 1/N flat shards plus any cotangents
+        still pending in partially-filled hook buckets. The trainer's
+        GradSanitizer folds these into the global finiteness check —
+        under ZeRO-2 the full-size grad buffers are already freed, so
+        p.grad() alone would miss every hooked parameter."""
+        out = []
+        for zg in self._zgroups.values():
+            if zg.gshards is not None:
+                out.extend(a for a in zg.gshards if a is not None)
+            if zg.pending is not None:
+                for buf in zg.pending:
+                    out.extend(buf.values())
+        return out
+
+    def discard_grads(self):
+        """Drop every resident grad shard and pending hook cotangent
+        (stage >= 2). Called when a step is SKIPPED (non-finite grads):
+        the poisoned shards must not survive into the next round's
+        accumulation."""
+        for zg in self._zgroups.values():
+            if zg.plans is None:
+                continue
+            nbk = len(zg.plans)
+            if zg.gshards is not None:
+                zg.gshards = [None] * nbk
+            if zg.gfresh is not None:
+                zg.gfresh = [False] * nbk
+            if zg.pending is not None:
+                for buf in zg.pending:
+                    buf.clear()
 
     def _collect_grad_shards(self, zg, gid, kvstore):
         """Step-time consumption of the resident grad shards; buckets

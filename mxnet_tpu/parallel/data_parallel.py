@@ -20,6 +20,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
+from .. import faults as _ft
 from .. import random as _random
 from .. import telemetry as _tm
 from ..ndarray import NDArray
@@ -334,6 +335,61 @@ class FusedTrainStep:
             if self.mesh is not None and self._compiled is not None:
                 self._tr = {n: _global_put(v, self._tr_sh[n])
                             for n, v in self._tr.items()}
+
+    def export_states(self):
+        """Optimizer slot state in per-name full-size form. Under
+        zero>=1 the resident `__zero1__<g>_<j>` buckets are gathered,
+        de-padded and unflattened back to one tree per parameter — the
+        padded bucket layout depends on the dp shard count, so this is
+        what makes a checkpoint replica-count portable (restoring
+        re-buckets for whatever mesh the new run compiled)."""
+        st = self._states
+        if st is None or self._zero1_groups is None or \
+                not any(str(k).startswith("__zero1__") for k in st):
+            return st
+        from .. import multi_tensor as _mt
+        out = {}
+        for gi, g in enumerate(self._zero1_groups):
+            buckets = [st[f"__zero1__{gi}_{j}"]
+                       for j in range(len(g.plans))]
+            flat0, treedef = jax.tree_util.tree_flatten(buckets[0])
+            leaves = [jax.tree_util.tree_leaves(b) for b in buckets]
+            per_name = [[] for _ in g.names]
+            for L in range(len(flat0)):
+                fulls = [_unshard(leaves[j][L])
+                         for j in range(len(g.plans))]
+                for m, a in enumerate(_mt.unflatten_buckets(
+                        fulls, g.plans, len(g.names))):
+                    per_name[m].append(a)
+            for m, n in enumerate(g.names):
+                out[n] = jax.tree_util.tree_unflatten(
+                    treedef, per_name[m])
+        return out
+
+    def _bucket_states(self, per_name):
+        """Inverse of export_states: flatten restored per-name slot
+        trees into this step's compiled `__zero1__` bucket layout
+        (padded for THIS mesh's dp shard count)."""
+        from .. import multi_tensor as _mt
+        shard = NamedSharding(self.mesh, P(self.dp_axis))
+        new_states = {}
+        for gi, g in enumerate(self._zero1_groups):
+            member = [jax.tree_util.tree_flatten(per_name[n])
+                      for n in g.names]
+            treedef = member[0][1]
+            nleaf = len(member[0][0])
+            per_leaf = []
+            for L in range(nleaf):
+                bks = _mt.pad_buckets(_mt.flatten_buckets(
+                    [member[m][0][L] for m in range(len(g.names))],
+                    g.plans), g.plans, g.padded)
+                per_leaf.append([_global_put(b, shard) for b in bks])
+            for j in range(len(g.plans)):
+                new_states[f"__zero1__{gi}_{j}"] = \
+                    jax.tree_util.tree_unflatten(
+                        treedef, [per_leaf[L][j]
+                                  for L in range(nleaf)])
+        return new_states
 
     # -- compilation ---------------------------------------------------------
     def _build(self, args):
@@ -1309,6 +1365,12 @@ class FusedTrainStep:
             self._init_state(args)
         if self._compiled is None:
             self._build(args)
+        if _ft._ACTIVE:
+            # preemption / straggler injection: the kill lands mid-run
+            # with the previous step's state committed but this step's
+            # not — exactly what the checkpoint resume harness needs
+            _ft.kill_point("step.kill")
+            _ft.delay_point("host.slow")
         self._step_count += 1
         self.optimizer.num_update = self._step_count
         hyper = {"lr": jnp.asarray(self.optimizer.learning_rate,

@@ -49,10 +49,13 @@ def initialize(coordinator_address: Optional[str] = None,
     global _initialized
     if _initialized:
         return
-    if os.environ.get("MXNET_TPU_BREAK_MULTIHOST"):
-        # test-only fault injection: lets the dryrun's 2-process leg
-        # prove that a broken multihost path turns the dryrun red
-        # instead of being swallowed as "skipped"
+    from .. import faults as _ft
+    if os.environ.get("MXNET_TPU_BREAK_MULTIHOST") or \
+            (_ft._ACTIVE and _ft.fire("multihost.break") is not None):
+        # fault injection (faults.py site "multihost.break"; the env
+        # var is the pre-injector spelling, kept for compat): lets the
+        # dryrun's 2-process legs prove that a broken multihost path
+        # turns the dryrun red instead of being swallowed as "skipped"
         raise RuntimeError("multihost.initialize deliberately broken "
                            "(MXNET_TPU_BREAK_MULTIHOST set)")
     coordinator_address = coordinator_address or os.environ.get(
@@ -61,6 +64,19 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["MXNET_TPU_NUM_PROCS"])
     if process_id is None and "MXNET_TPU_PROC_ID" in os.environ:
         process_id = int(os.environ["MXNET_TPU_PROC_ID"])
+    # CPU-backend multi-process jobs (CI dryruns, tests) need a real
+    # collectives implementation — without this every cross-process
+    # computation dies with "Multiprocess computations aren't
+    # implemented on the CPU backend". Checked via the platforms
+    # CONFIG string so we don't force backend init before
+    # jax.distributed.initialize.
+    plats = (jax.config.jax_platforms or "")
+    if "cpu" in plats.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # older/newer jax: name or impl missing
+            pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)

@@ -27,7 +27,8 @@ from . import sampling
 from . import executables
 from . import server
 from .kv_cache import PagedKVCache
-from .server import InferenceServer, Request
+from .server import InferenceServer, Request, ServerStalledError
 
 __all__ = ["PagedKVCache", "InferenceServer", "Request",
+           "ServerStalledError",
            "kv_cache", "sampling", "executables", "server"]

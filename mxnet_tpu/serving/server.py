@@ -33,8 +33,18 @@ Telemetry (PR-4 registry, enabled via telemetry.enable()):
   serving_tokens_per_sec_per_chip  gauge (rolling 256-tick window)
   serving_tokens_total / serving_requests_total / _finished /
   serving_preemptions_total   counters
+  serving_requests_total{status=...}  labeled terminal outcomes
+  serving_watchdog_stalls_total       watchdog trips
   per-tick phase spans: serve_admit / serve_decode (chrome trace +
   step_time_breakdown rows)
+
+Robustness (fault tolerance PR): per-request deadlines (expired
+requests finish with status ``timed_out``), a preemption retry cap
+(``preempted``), a watchdog that raises after `watchdog_ticks`
+consecutive zero-progress ticks with work pending, and
+:meth:`InferenceServer.drain` / :meth:`InferenceServer.shutdown` for
+graceful teardown (``submit`` after shutdown raises; stragglers are
+cancelled with status ``rejected``).
 """
 from __future__ import annotations
 
@@ -47,14 +57,25 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults as _ft
 from .. import telemetry
 from ..ndarray import NDArray
 from .kv_cache import PagedKVCache
 from . import executables
 
-__all__ = ["Request", "InferenceServer"]
+__all__ = ["Request", "InferenceServer", "ServerStalledError"]
 
 _QUEUED, _RUNNING, _FINISHED = "queued", "running", "finished"
+#: terminal statuses — set exactly once when a request leaves the system
+_OK, _TIMED_OUT, _PREEMPTED, _REJECTED = \
+    "ok", "timed_out", "preempted", "rejected"
+
+
+class ServerStalledError(RuntimeError):
+    """The decode loop made no progress for `watchdog_ticks` ticks
+    while work was pending — the executable (or its device) is wedged.
+    Raised out of step()/run() so the supervisor can restart the
+    server instead of spinning forever."""
 
 
 class Request:
@@ -63,7 +84,7 @@ class Request:
     _next_id = 0
 
     def __init__(self, prompt, max_new_tokens, temperature, top_k,
-                 top_p, eos_id, seed):
+                 top_p, eos_id, seed, deadline_s=None):
         self.id = Request._next_id
         Request._next_id += 1
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -79,8 +100,15 @@ class Request:
         #: throughput metrics; survives preemption so regenerated
         #: tokens are not double-counted
         self.tokens_counted = 0
-        self.finish_reason: Optional[str] = None  # "eos" | "length"
+        self.finish_reason: Optional[str] = None
+        #: terminal outcome: "ok" | "timed_out" | "preempted" |
+        #: "rejected"; None while the request is still live
+        self.status: Optional[str] = None
         self.t_submit = time.perf_counter()
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        #: absolute wall-clock deadline; queue wait counts against it
+        self.t_deadline = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
         self.t_first_token: Optional[float] = None
         self.t_finish: Optional[float] = None
         self.preemptions = 0
@@ -120,7 +148,9 @@ class InferenceServer:
                  max_len: int = 256, block_size: int = 16,
                  max_prompt_len: Optional[int] = None,
                  kv_cache_dtype: str = "model",
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 max_preemptions: Optional[int] = 3,
+                 watchdog_ticks: int = 256):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -171,6 +201,15 @@ class InferenceServer:
         self.ticks = 0
         self.tokens_generated = 0
         self._tok_window: deque = deque(maxlen=256)
+        # robustness knobs: a request preempted more than
+        # max_preemptions times fails terminally (None = unlimited);
+        # the watchdog raises after watchdog_ticks consecutive ticks
+        # without progress while work is pending
+        self.max_preemptions = max_preemptions
+        self.watchdog_ticks = int(watchdog_ticks)
+        self._stall_ticks = 0
+        self._draining = False
+        self._shutdown = False
 
     # -- request intake -----------------------------------------------------
 
@@ -183,8 +222,19 @@ class InferenceServer:
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, eos_id: Optional[int] = None,
-               seed: int = 0) -> Request:
-        """Enqueue one request. prompt_ids: 1-D (or (1, T)) ints."""
+               seed: int = 0,
+               deadline_s: Optional[float] = None) -> Request:
+        """Enqueue one request. prompt_ids: 1-D (or (1, T)) ints.
+        ``deadline_s`` bounds the request's total wall-clock lifetime
+        (queue wait included); past it the request finishes with
+        status ``timed_out``."""
+        if self._shutdown or self._draining:
+            telemetry.inc("serving_requests_total", status=_REJECTED)
+            raise RuntimeError(
+                "InferenceServer is "
+                + ("shut down" if self._shutdown else "draining")
+                + " — submit() rejected; start a new server (or submit "
+                  "before calling drain()/shutdown())")
         if isinstance(prompt_ids, NDArray):
             prompt_ids = prompt_ids.asnumpy()
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -209,7 +259,7 @@ class InferenceServer:
                 f"block_size={self.block_size}) but the pool only has "
                 f"{capacity} — raise num_blocks or shrink the request")
         req = Request(prompt, max_new_tokens, temperature, top_k,
-                      top_p, eos_id, seed)
+                      top_p, eos_id, seed, deadline_s=deadline_s)
         self.queue.append(req)
         telemetry.inc("serving_requests_total")
         return req
@@ -269,12 +319,18 @@ class InferenceServer:
             return False
         victim = max(running, key=lambda i: self._slot_admit[i])
         req = self._slot_req[victim]
+        req.preemptions += 1
+        telemetry.inc("serving_preemptions_total")
+        if self.max_preemptions is not None \
+                and req.preemptions > self.max_preemptions:
+            # retry budget exhausted: fail the request terminally
+            # instead of thrashing the pool forever
+            self._finish(victim, "preempted", status=_PREEMPTED)
+            return True
         req.state = _QUEUED
         req.output_tokens = []          # greedy rerun is identical
-        req.preemptions += 1
         self._evict(victim)
         self.queue.appendleft(req)
-        telemetry.inc("serving_preemptions_total")
         return True
 
     def _ensure_blocks(self):
@@ -304,23 +360,59 @@ class InferenceServer:
         self._top_ps[slot] = 0.0
         self._slot_req[slot] = None
 
-    def _finish(self, slot: int, reason: str):
+    def _finish(self, slot: int, reason: str, status: str = _OK):
         req = self._slot_req[slot]
+        self._evict(slot)
+        self._terminate(req, reason, status)
+
+    def _terminate(self, req: Request, reason: str, status: str):
+        """Terminal transition shared by running (post-evict) and
+        still-queued requests."""
         req.state = _FINISHED
         req.finish_reason = reason
+        req.status = status
         req.t_finish = time.perf_counter()
         self.finished.append(req)
-        self._evict(slot)
         telemetry.inc("serving_requests_finished")
+        telemetry.inc("serving_requests_total", status=status)
+
+    def _expire_deadlines(self):
+        """Fail every request (queued or running) past its deadline
+        with status ``timed_out``. Runs at the top of each tick, so a
+        queued request cannot be admitted after it already expired."""
+        now = time.perf_counter()
+        for slot in range(self.batch_slots):
+            req = self._slot_req[slot]
+            if req is not None and req.t_deadline is not None \
+                    and now > req.t_deadline:
+                self._finish(slot, "timeout", status=_TIMED_OUT)
+        if any(r.t_deadline is not None for r in self.queue):
+            keep: deque = deque()
+            while self.queue:
+                req = self.queue.popleft()
+                if req.t_deadline is not None and now > req.t_deadline:
+                    self._terminate(req, "timeout", _TIMED_OUT)
+                else:
+                    keep.append(req)
+            self.queue = keep
 
     # -- the tick -----------------------------------------------------------
 
     def step(self) -> int:
         """Admit + one decode tick + evict. Returns tokens emitted."""
         t_tick = time.perf_counter()
+        done0 = len(self.finished)
+        self._expire_deadlines()
+        if _ft._ACTIVE and _ft.fire("serving.stall") is not None:
+            # injected wedged tick: no admission, no decode — the
+            # deterministic stimulus for the watchdog tests
+            self._note_progress(0, done0)
+            self._update_gauges()
+            return 0
         with telemetry.phase("serve_admit"):
-            self._admit()
+            admitted = self._admit()
         if not self._active.any():
+            self._note_progress(admitted, done0)
             self._update_gauges()
             return 0
         self._ensure_blocks()
@@ -363,8 +455,29 @@ class InferenceServer:
         self._tok_window.append((now, net_new))
         telemetry.inc("serving_tokens_total", net_new)
         telemetry.observe("serving_tick_seconds", now - t_tick)
+        self._note_progress(admitted + emitted, done0)
         self._update_gauges()
         return emitted
+
+    def _note_progress(self, progress: int, done_before: int):
+        """Watchdog bookkeeping: `progress` units this tick (tokens
+        emitted + admissions + requests finished). Zero progress with
+        work still pending, `watchdog_ticks` ticks in a row, means the
+        decode path is wedged — raise so a supervisor restarts the
+        server instead of the loop spinning forever."""
+        progress += len(self.finished) - done_before
+        if progress > 0 or not (self.queue or self._active.any()):
+            self._stall_ticks = 0
+            return
+        self._stall_ticks += 1
+        if self._stall_ticks >= self.watchdog_ticks:
+            stalled, self._stall_ticks = self._stall_ticks, 0
+            telemetry.inc("serving_watchdog_stalls_total")
+            raise ServerStalledError(
+                f"serving watchdog: {stalled} consecutive ticks without "
+                f"progress ({len(self.queue)} queued, "
+                f"{int(self._active.sum())} active) — decode path is "
+                "stalled; restart the server")
 
     def _update_gauges(self):
         if not telemetry._ENABLED:
@@ -395,6 +508,47 @@ class InferenceServer:
                 break
         return self.finished[done_before:]
 
+    # -- graceful teardown --------------------------------------------------
+
+    def drain(self, max_ticks: Optional[int] = None,
+              deadline_s: Optional[float] = None) -> List[Request]:
+        """Stop admitting NEW submissions (submit() now raises) and run
+        the already-accepted work to completion, bounded by `max_ticks`
+        and/or `deadline_s`. Returns the requests finished during the
+        drain; anything still unfinished at the bound is left for
+        :meth:`shutdown` to cancel."""
+        self._draining = True
+        done_before = len(self.finished)
+        t0 = time.perf_counter()
+        ticks = 0
+        while self.queue or self._active.any():
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            if deadline_s is not None \
+                    and time.perf_counter() - t0 > deadline_s:
+                break
+            self.step()
+            ticks += 1
+        return self.finished[done_before:]
+
+    def shutdown(self, drain: bool = True,
+                 max_ticks: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
+        """Graceful shutdown: optionally drain in-flight work, then
+        cancel whatever remains with status ``rejected`` and refuse
+        all further submissions. Idempotent."""
+        if self._shutdown:
+            return
+        if drain:
+            self.drain(max_ticks=max_ticks, deadline_s=deadline_s)
+        for slot in range(self.batch_slots):
+            if self._active[slot]:
+                self._finish(slot, "shutdown", status=_REJECTED)
+        while self.queue:
+            self._terminate(self.queue.popleft(), "shutdown", _REJECTED)
+        self._shutdown = True
+        self._update_gauges()
+
     # -- introspection ------------------------------------------------------
 
     def compile_stats(self) -> dict:
@@ -403,10 +557,17 @@ class InferenceServer:
                 "decode_compiles": d.compiles, "decode_calls": d.calls}
 
     def stats(self) -> dict:
+        by_status = {s: 0 for s in (_OK, _TIMED_OUT, _PREEMPTED,
+                                    _REJECTED)}
+        for r in self.finished:
+            by_status[r.status or _OK] += 1
         return {"ticks": self.ticks,
                 "tokens_generated": self.tokens_generated,
                 "queued": len(self.queue),
                 "active": int(self._active.sum()),
                 "finished": len(self.finished),
+                "status_counts": by_status,
+                "draining": self._draining,
+                "shutdown": self._shutdown,
                 **{f"kv_{k}": v for k, v in self.cache.stats().items()},
                 **self.compile_stats()}

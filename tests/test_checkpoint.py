@@ -140,3 +140,408 @@ def test_multihost_helpers():
         mesh = mh.hybrid_device_mesh(ici_shape=[2, 2], dcn_shape=[1, 1],
                                      axis_names=["dp", "tp"])
         assert mesh.shape == {"dp": 2, "tp": 2}
+
+
+# -- manifest verification / fallback restore (fault tolerance PR) -----------
+
+def test_manifest_written_and_verified(tmp_path):
+    net = _make_net()
+    ck = Checkpointer(str(tmp_path / "m"))
+    ck.save(1, net=net)
+    ck.save(2, net=net)
+    import os
+    assert sorted(os.listdir(str(tmp_path / "m" / "_manifests"))) == \
+        ["1.json", "2.json"]
+    assert ck.verify_step(1) and ck.verify_step(2)
+    assert ck.latest_verified_step() == 2
+    ck.close()
+
+
+def test_restore_falls_back_to_newest_verified(tmp_path):
+    import os
+    import warnings
+    from mxnet_tpu import telemetry as tm
+    X, Y = _data()
+    net = _make_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    ck = Checkpointer(str(tmp_path / "fb"))
+    _train_steps(net, tr, X, Y, 1)
+    ck.save(1, net=net, trainer=tr)
+    w1 = {n: p.data().asnumpy().copy()
+          for n, p in net.collect_params().items()}
+    _train_steps(net, tr, X, Y, 1)
+    ck.save(2, net=net, trainer=tr)
+    # truncate step 2's biggest file: half-written checkpoint
+    files = ck._scan_files(2)
+    big = max(files, key=lambda r: files[r])
+    with open(os.path.join(ck._step_dir(2), big), "r+b") as f:
+        f.truncate(files[big] // 2)
+    assert not ck.verify_step(2) and ck.latest_verified_step() == 1
+
+    tm.reset()
+    tm.enable()
+    try:
+        net2 = _make_net(seed=5)
+        tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            meta = ck.restore(net=net2, trainer=tr2)
+        assert meta["step"] == 1
+        assert any("manifest verification" in str(x.message) for x in w)
+        snap = tm.snapshot()["counters"]
+        assert snap["checkpoint_fallbacks_total"] == 1.0
+    finally:
+        tm.disable()
+        tm.reset()
+    for n, p in net2.collect_params().items():
+        np.testing.assert_array_equal(p.data().asnumpy(), w1[n])
+    # explicitly requesting the broken step refuses loudly
+    with pytest.raises(RuntimeError, match="manifest verification"):
+        ck.restore(net=net2, trainer=tr2, step=2)
+    ck.close()
+
+
+def test_restore_empty_dir_raises_unless_missing_ok(tmp_path):
+    from mxnet_tpu.checkpoint import load_checkpoint
+    net = _make_net()
+    d = str(tmp_path / "none")
+    with pytest.raises(FileNotFoundError, match="no checkpoints"):
+        load_checkpoint(d, net=net)
+    assert load_checkpoint(d, net=net, missing_ok=True) is None
+    ck = Checkpointer(str(tmp_path / "empty2"))
+    with pytest.raises(FileNotFoundError, match="missing_ok"):
+        ck.restore(net=net)
+    ck.close()
+    # explicit step on an empty dir still reports "no checkpoints"
+    with pytest.raises(FileNotFoundError, match="no checkpoints"):
+        load_checkpoint(d, net=net, step=7)
+    # ... while a populated dir reports which steps ARE available
+    d2 = str(tmp_path / "some")
+    ck2 = Checkpointer(d2)
+    ck2.save(1, net=net)
+    ck2.close()
+    with pytest.raises(FileNotFoundError, match="not found"):
+        load_checkpoint(d2, net=net, step=7)
+
+
+def test_truncate_fault_site_and_nomanifest_mode(tmp_path):
+    from mxnet_tpu import faults
+    net = _make_net()
+    ck = Checkpointer(str(tmp_path / "tf"))
+    ck.save(1, net=net)
+    try:
+        faults.inject("checkpoint.truncate", at=1)
+        ck.save(2, net=net)          # truncated on commit
+        faults.inject("checkpoint.truncate", mode="nomanifest")
+        ck.save(3, net=net)          # bytes fine, manifest dropped
+    finally:
+        faults.clear()
+    assert ck.verify_step(1)
+    assert not ck.verify_step(2)     # bytes missing
+    assert not ck.verify_step(3)     # unverifiable without manifest
+    assert ck.latest_verified_step() == 1
+    meta = ck.restore(net=net)
+    assert meta["step"] == 1
+    ck.close()
+
+
+def test_legacy_dir_without_manifests_restores(tmp_path):
+    import shutil
+    net = _make_net()
+    d = str(tmp_path / "legacy")
+    ck = Checkpointer(d)
+    ck.save(1, net=net)
+    ck.close()
+    shutil.rmtree(str(tmp_path / "legacy" / "_manifests"))
+    ck2 = Checkpointer(d)
+    assert ck2.verify_step(1)        # no _manifests dir at all: trusted
+    assert ck2.restore(net=net)["step"] == 1
+    ck2.close()
+
+
+def test_preemption_handler_drains_and_finalizes(tmp_path):
+    import os
+    import signal
+    from mxnet_tpu.checkpoint import PreemptionHandler
+    X, Y = _data()
+    net = _make_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    ck = Checkpointer(str(tmp_path / "pre"), async_save=True)
+    with PreemptionHandler(ck) as ph:
+        assert not ph.preempted
+        step = 0
+        for step in range(1, 6):
+            _train_steps(net, tr, X, Y, 1)
+            if step % 2 == 0:
+                ck.save(step, net=net, trainer=tr)
+            if step == 5:            # the preemption notice arrives
+                os.kill(os.getpid(), signal.SIGTERM)
+            if ph.preempted:
+                break
+        assert ph.preempted and ph.signum == signal.SIGTERM
+        resume = ph.finalize(step, net=net, trainer=tr)
+    assert resume == 5
+    assert ck.verify_step(4) and ck.verify_step(5)
+    # SIGTERM handling is restored on exit
+    import signal as _s
+    assert _s.getsignal(_s.SIGTERM) != ph._handler
+    net2 = _make_net(seed=3)
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+    assert ck.restore(net=net2, trainer=tr2)["step"] == 5
+    ck.close()
+    for n, p in net2.collect_params().items():
+        np.testing.assert_array_equal(
+            p.data().asnumpy(), net.collect_params()[n].data().asnumpy())
+
+
+def test_eager_zero_trainer_state_roundtrips_elastically(tmp_path):
+    """Checkpointer now exports eager-ZeRO sharded optimizer state as
+    full per-param trees (like Trainer.save_states), so a run sharded
+    N=4 ways restores into an N=2 trainer and continues exactly like
+    the uninterrupted N=4 run."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    X, Y = _data()
+
+    def make(shards):
+        net = _make_net()
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9},
+                              zero=2, zero1_shards=shards)
+        return net, tr
+
+    net, tr = make(4)
+    _train_steps(net, tr, X, Y, 3)
+    ck = Checkpointer(str(tmp_path / "zero"))
+    ck.save(3, net=net, trainer=tr)
+    ref = _train_steps(net, tr, X, Y, 2)   # uninterrupted continuation
+    ck.close()
+
+    net2, tr2 = make(2)                     # replica-count change
+    _train_steps(net2, tr2, X, Y, 1)        # materialize shard groups
+    ck2 = Checkpointer(str(tmp_path / "zero"))
+    meta = ck2.restore(net=net2, trainer=tr2)
+    ck2.close()
+    assert meta["step"] == 3
+    got = _train_steps(net2, tr2, X, Y, 2)
+    np.testing.assert_allclose(np.float32(ref), np.float32(got),
+                               rtol=1e-6, atol=1e-7)
+
+
+# -- kill-and-restart harness (ISSUE 7): a subprocess trains with
+# per-step checkpoints, gets SIGKILLed mid-step at an injected fault
+# point (MXNET_TPU_FAULTS=step.kill:at=K), restarts, and must land on
+# the uninterrupted run's weights. ------------------------------------
+
+import os as _os
+import signal as _signal
+import subprocess as _subprocess
+import sys as _sys
+import textwrap as _textwrap
+
+REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+CKPT_WORKER = _textwrap.dedent("""
+    import sys, os
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import Checkpointer
+
+    ckdir, opt, zero, shards, total, outp = sys.argv[1:7]
+    zero, shards, total = int(zero), int(shards), int(total)
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"))
+    net.add(mx.gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    okw = ({{"learning_rate": 0.1, "momentum": 0.9}} if opt == "sgd"
+           else {{"learning_rate": 0.01}})
+    tkw = {{}}
+    if zero:
+        tkw["zero"] = zero
+        if shards:
+            tkw["zero1_shards"] = shards
+    tr = mx.gluon.Trainer(net.collect_params(), opt, okw, **tkw)
+
+    rs = np.random.RandomState(42)
+    X = mx.nd.array(rs.rand(8, 10).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, 8), dtype="int32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ck = Checkpointer(ckdir)
+    meta = ck.restore(net=net, trainer=tr, missing_ok=True)
+    start = int(meta["step"]) if meta else 0
+    for s in range(start + 1, total + 1):
+        with mx.autograd.record():
+            l = loss_fn(net(X), Y).mean()
+        l.backward()
+        tr.step(1)              # step.kill fires here when armed
+        ck.save(s, net=net, trainer=tr)
+    ck.close()
+    np.savez(outp, **{{n: p.data().asnumpy()
+                       for n, p in net.collect_params().items()}})
+    print("CKPT_WORKER_DONE", start, total)
+""")
+
+FUSED_WORKER = _textwrap.dedent("""
+    import sys, os
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import Checkpointer
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    ckdir, ndp, total, outp = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]), sys.argv[4])
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"))
+    net.add(mx.gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    mesh = make_mesh([ndp], ["dp"])
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    step = FusedTrainStep(
+        net, loss_fn, mx.optimizer.SGD(learning_rate=0.1, momentum=0.9),
+        mesh=mesh, zero=3)
+
+    rs = np.random.RandomState(42)
+    X = mx.nd.array(rs.rand(8, 10).astype(np.float32))
+    Y = mx.nd.array(rs.randint(0, 4, 8), dtype="int32")
+
+    ck = Checkpointer(ckdir)
+    meta = ck.restore(net=net, fused_step=step, missing_ok=True)
+    start = int(meta["step"]) if meta else 0
+    for s in range(start + 1, total + 1):
+        step(X, Y)              # step.kill fires here when armed
+        ck.save(s, fused_step=step)
+    ck.close()
+    step.sync_to_params()
+    np.savez(outp, **{{n: p.data().asnumpy()
+                       for n, p in net.collect_params().items()}})
+    print("FUSED_WORKER_DONE", start, total)
+""")
+
+
+def _run_worker(script, args, fault=None, timeout=150):
+    env = dict(_os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_FAULTS", None)
+    if fault:
+        env["MXNET_TPU_FAULTS"] = fault
+    p = _subprocess.Popen(
+        [_sys.executable, "-u", str(script)] + [str(a) for a in args],
+        stdout=_subprocess.PIPE, stderr=_subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+    except _subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("checkpoint worker hung")
+    return p.returncode, out
+
+
+def _assert_same_weights(ref_npz, got_npz, exact=True, atol=1e-6):
+    ref, got = np.load(ref_npz), np.load(got_npz)
+    assert sorted(ref.files) == sorted(got.files)
+    for k in ref.files:
+        if exact:
+            np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+        else:
+            np.testing.assert_allclose(ref[k], got[k], rtol=0,
+                                       atol=atol, err_msg=k)
+
+
+def test_kill_restart_sgd_bitexact(tmp_path):
+    """SIGKILL mid-step 3 of 6; the restarted run resumes from the last
+    verified checkpoint and lands bit-for-bit on the uninterrupted
+    run's weights (SGD+momentum)."""
+    script = tmp_path / "worker.py"
+    script.write_text(CKPT_WORKER.format(repo=REPO))
+    rc, out = _run_worker(
+        script, [tmp_path / "ref", "sgd", 0, 0, 6, tmp_path / "ref.npz"])
+    assert rc == 0 and "CKPT_WORKER_DONE 0 6" in out, out
+    rc, out = _run_worker(
+        script, [tmp_path / "run", "sgd", 0, 0, 6, tmp_path / "x.npz"],
+        fault="step.kill:at=3")
+    assert rc == -_signal.SIGKILL, (rc, out)
+    rc, out = _run_worker(
+        script, [tmp_path / "run", "sgd", 0, 0, 6, tmp_path / "got.npz"])
+    assert rc == 0, out
+    assert "CKPT_WORKER_DONE 2 6" in out, out  # resumed from step 2
+    _assert_same_weights(tmp_path / "ref.npz", tmp_path / "got.npz")
+
+
+@pytest.mark.slow
+def test_kill_restart_adam_close(tmp_path):
+    """Adam continuation after SIGKILL-and-restart stays within 1e-6 of
+    the uninterrupted run (slot state + num_update round-trip)."""
+    script = tmp_path / "worker.py"
+    script.write_text(CKPT_WORKER.format(repo=REPO))
+    rc, out = _run_worker(
+        script, [tmp_path / "ref", "adam", 0, 0, 6, tmp_path / "ref.npz"])
+    assert rc == 0, out
+    rc, out = _run_worker(
+        script, [tmp_path / "run", "adam", 0, 0, 6, tmp_path / "x.npz"],
+        fault="step.kill:at=4")
+    assert rc == -_signal.SIGKILL, (rc, out)
+    rc, out = _run_worker(
+        script, [tmp_path / "run", "adam", 0, 0, 6, tmp_path / "got.npz"])
+    assert rc == 0 and "CKPT_WORKER_DONE 3 6" in out, out
+    _assert_same_weights(tmp_path / "ref.npz", tmp_path / "got.npz",
+                         exact=False, atol=1e-6)
+
+
+def test_kill_restart_zero2_elastic_shards(tmp_path):
+    """Eager ZeRO-2 killed at N=4 shards resumes at N=2 shards: the
+    exported per-param slot trees re-shard on restore (arXiv:2004.13336
+    elasticity), matching the uninterrupted N=4 run."""
+    script = tmp_path / "worker.py"
+    script.write_text(CKPT_WORKER.format(repo=REPO))
+    rc, out = _run_worker(
+        script, [tmp_path / "ref", "sgd", 2, 4, 6, tmp_path / "ref.npz"])
+    assert rc == 0, out
+    rc, out = _run_worker(
+        script, [tmp_path / "run", "sgd", 2, 4, 6, tmp_path / "x.npz"],
+        fault="step.kill:at=3")
+    assert rc == -_signal.SIGKILL, (rc, out)
+    rc, out = _run_worker(              # replica-count change: N=4 -> N=2
+        script, [tmp_path / "run", "sgd", 2, 2, 6, tmp_path / "got.npz"])
+    assert rc == 0 and "CKPT_WORKER_DONE 2 6" in out, out
+    _assert_same_weights(tmp_path / "ref.npz", tmp_path / "got.npz",
+                         exact=False, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_kill_restart_fused_zero3_elastic(tmp_path):
+    """Fused zero=3 killed on a dp=8 mesh resumes on dp=4: export_states
+    de-buckets the sharded slots to per-name trees at save time and the
+    new run re-buckets them for its own mesh."""
+    script = tmp_path / "worker.py"
+    script.write_text(FUSED_WORKER.format(repo=REPO))
+    rc, out = _run_worker(
+        script, [tmp_path / "ref", 8, 6, tmp_path / "ref.npz"])
+    assert rc == 0 and "FUSED_WORKER_DONE 0 6" in out, out
+    rc, out = _run_worker(
+        script, [tmp_path / "run", 8, 6, tmp_path / "x.npz"],
+        fault="step.kill:at=3")
+    assert rc == -_signal.SIGKILL, (rc, out)
+    rc, out = _run_worker(              # mesh change: dp=8 -> dp=4
+        script, [tmp_path / "run", 4, 6, tmp_path / "got.npz"])
+    assert rc == 0 and "FUSED_WORKER_DONE 2 6" in out, out
+    _assert_same_weights(tmp_path / "ref.npz", tmp_path / "got.npz",
+                         exact=False, atol=1e-6)

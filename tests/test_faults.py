@@ -1,0 +1,266 @@
+"""Fault-injection harness (mxnet_tpu.faults) + the trainer-level
+GradSanitizer: deterministic triggers, instrumented sites, skip-on-NaN
+semantics, AMP loss-scale cooperation, and the consecutive-skip cap.
+Runs on the 8-virtual-device CPU mesh (conftest)."""
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry as tm
+from mxnet_tpu.faults import FaultInjected, FaultTimeout
+from mxnet_tpu.gluon.trainer import GradSanitizer
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no faults armed and a clean
+    telemetry registry."""
+    faults.clear()
+    tm.disable()
+    tm.reset()
+    yield
+    faults.clear()
+    tm.disable()
+    tm.reset()
+
+
+# -- trigger grammar ---------------------------------------------------------
+
+def test_configure_parses_entries():
+    faults.configure("step.kill:at=3;grad.nonfinite:p=0.25:seed=7;"
+                     "host.slow:ms=5")
+    sp = faults.specs()
+    assert set(sp) == {"step.kill", "grad.nonfinite", "host.slow"}
+    assert sp["step.kill"] == {"at": 3}
+    assert sp["grad.nonfinite"] == {"p": 0.25, "seed": 7}
+    assert sp["host.slow"] == {"ms": 5}
+    assert faults.active()
+    faults.configure(None)
+    assert not faults.active() and faults.specs() == {}
+
+
+def test_at_fires_kth_hit_once():
+    faults.inject("step.kill", at=3)
+    got = [faults.fire("step.kill") is not None for _ in range(6)]
+    assert got == [False, False, True, False, False, False]
+    assert faults.hits("step.kill") == 6
+    assert faults.fires("step.kill") == 1
+
+
+def test_after_every_times_and_bare():
+    faults.inject("host.slow", after=2)
+    assert [faults.fire("host.slow") is not None for _ in range(5)] == \
+        [False, False, True, True, True]
+    faults.inject("host.slow", every=3)
+    assert [faults.fire("host.slow") is not None for _ in range(7)] == \
+        [False, False, True, False, False, True, False]
+    faults.inject("host.slow", times=2)  # bare trigger, capped fires
+    assert [faults.fire("host.slow") is not None for _ in range(4)] == \
+        [True, True, False, False]
+
+
+def test_probabilistic_trigger_is_seeded():
+    def trail(seed):
+        faults.inject("host.slow", p=0.5, seed=seed)
+        return [faults.fire("host.slow") is not None for _ in range(32)]
+    a, b, c = trail(11), trail(11), trail(12)
+    assert a == b          # same seed -> same fault schedule
+    assert a != c          # different seed -> different schedule
+    assert any(a) and not all(a)
+
+
+def test_reset_counts_rewinds_schedule():
+    faults.inject("step.kill", at=2)
+    assert [faults.fire("step.kill") is not None for _ in range(3)] == \
+        [False, True, False]
+    faults.reset_counts()
+    assert [faults.fire("step.kill") is not None for _ in range(3)] == \
+        [False, True, False]
+
+
+def test_unarmed_site_is_free():
+    faults.inject("host.slow")
+    assert faults.fire("step.kill") is None
+    assert faults.hits("step.kill") == 0
+
+
+def test_fire_counts_telemetry():
+    tm.enable()
+    faults.inject("host.slow", times=2)
+    faults.fire("host.slow")
+    faults.fire("host.slow")
+    faults.fire("host.slow")  # past times cap: no fire, no count
+    snap = tm.snapshot()["counters"]
+    assert snap["faults_injected_total{site=host.slow}"] == 2.0
+
+
+# -- site behaviors ----------------------------------------------------------
+
+def test_timeout_point_raises_fault_timeout():
+    faults.inject("collective.timeout", at=1)
+    with pytest.raises(FaultTimeout) as ei:
+        faults.timeout_point()
+    assert isinstance(ei.value, TimeoutError)
+    assert isinstance(ei.value, FaultInjected)
+    assert ei.value.site == "collective.timeout"
+
+
+def test_delay_point_sleeps_ms():
+    faults.inject("host.slow", ms=30)
+    t0 = time.perf_counter()
+    faults.delay_point()
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_kill_point_sigterm_is_catchable():
+    hit = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: hit.append(s))
+    try:
+        faults.inject("step.kill", signal="term")
+        faults.kill_point()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert hit == [signal.SIGTERM]
+
+
+def test_truncate_file(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(b"x" * 100)
+    assert faults.truncate_file(str(p)) == 50
+    assert p.stat().st_size == 50
+    faults.truncate_file(str(p), keep_bytes=7)
+    assert p.stat().st_size == 7
+
+
+def test_collective_timeout_fires_in_kvstore():
+    kv = mx.kv.create("dist_sync")  # falls back to in-process TPU sync
+    g = mx.nd.ones((4,))
+    kv.pushpull(0, g, out=g)        # unarmed: free
+    faults.inject("collective.timeout", at=1)
+    with pytest.raises(FaultTimeout):
+        kv.pushpull(0, g, out=g)
+
+
+# -- GradSanitizer -----------------------------------------------------------
+
+def _net_and_trainer(**tr_kwargs):
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize(force_reinit=True)
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, **tr_kwargs)
+    return net, tr
+
+
+def _one_step(net, tr, bs=2):
+    x = mx.nd.ones((bs, 3))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(bs)
+
+
+def test_sanitizer_skips_nonfinite_step():
+    net, tr = _net_and_trainer(skip_nonfinite=True)
+    _one_step(net, tr)
+    w0 = net.weight.data().asnumpy().copy()
+    faults.inject("grad.nonfinite", times=1)
+    _one_step(net, tr)  # poisoned -> skipped
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert tr._sanitizer.total_skips == 1
+    assert tr._sanitizer.consecutive_skips == 1
+    faults.clear()
+    _one_step(net, tr)  # finite step trains and resets the streak
+    assert tr._sanitizer.consecutive_skips == 0
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_sanitizer_counts_telemetry():
+    tm.enable()
+    net, tr = _net_and_trainer(skip_nonfinite=True)
+    faults.inject("grad.nonfinite", times=2)
+    _one_step(net, tr)
+    _one_step(net, tr)
+    snap = tm.snapshot()["counters"]
+    assert snap["steps_skipped_nonfinite_total"] == 2.0
+    assert snap["faults_injected_total{site=grad.nonfinite}"] == 2.0
+    assert "steps_skipped_nonfinite_total" in tm.to_prometheus()
+
+
+def test_sanitizer_consecutive_cap_raises():
+    net, tr = _net_and_trainer(skip_nonfinite=2)
+    faults.inject("grad.nonfinite")  # every step
+    _one_step(net, tr)
+    _one_step(net, tr)
+    with pytest.raises(FloatingPointError, match="consecutive"):
+        _one_step(net, tr)
+
+
+def test_sanitizer_inf_and_explicit_instance():
+    san = GradSanitizer(max_consecutive_skips=5)
+    net, tr = _net_and_trainer(skip_nonfinite=san)
+    assert tr._sanitizer is san
+    _one_step(net, tr)
+    w0 = net.weight.data().asnumpy().copy()
+    faults.inject("grad.nonfinite", times=1, value="inf")
+    _one_step(net, tr)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert san.total_skips == 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices")
+@pytest.mark.parametrize("stage", [2, 3])
+def test_sanitizer_zero_stages_skip(stage):
+    net, tr = _net_and_trainer(zero=stage, skip_nonfinite=True)
+    _one_step(net, tr)
+    _one_step(net, tr)
+    w0 = net.weight.data().asnumpy().copy()
+    faults.inject("grad.nonfinite", times=1)
+    _one_step(net, tr)  # poisons a grad SHARD (full grads are freed)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert tr._sanitizer.total_skips == 1
+    faults.clear()
+    _one_step(net, tr)  # discard_grads left the hooks re-armable
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_sanitizer_cooperates_with_amp_scaler():
+    from mxnet_tpu import amp
+    net, tr = _net_and_trainer(skip_nonfinite=True)
+    scaler = amp.DynamicLossScaler(init_scale=2 ** 10, scale_factor=2.0,
+                                   scale_window=10 ** 9)
+    tr._amp_scaler = scaler  # what amp.init_trainer wires up
+    tr._scale = 1.0 / scaler.loss_scale
+    s0 = scaler.loss_scale
+    faults.inject("grad.nonfinite", times=1)
+    _one_step(net, tr)  # overflow-like skip: scale must back off
+    assert scaler.loss_scale == s0 / 2
+    assert tr._scale == 1.0 / scaler.loss_scale
+    faults.clear()
+    _one_step(net, tr)  # finite step keeps the backed-off scale live
+    assert scaler.loss_scale == s0 / 2
+
+
+def test_host_slow_site_in_trainer_step():
+    net, tr = _net_and_trainer()
+    faults.inject("host.slow", ms=25, times=1)
+    t0 = time.perf_counter()
+    _one_step(net, tr)
+    assert time.perf_counter() - t0 >= 0.02
+    assert faults.fires("host.slow") == 1
+
+
+def test_multihost_break_site(monkeypatch):
+    from mxnet_tpu.parallel import multihost
+    monkeypatch.setattr(multihost, "_initialized", False)
+    faults.inject("multihost.break", at=1)
+    with pytest.raises(RuntimeError, match="deliberately broken"):
+        multihost.initialize()
+    assert not multihost._initialized

@@ -562,3 +562,183 @@ def test_server_refresh_params_picks_up_new_weights(net):
     # no recompile across the weight refresh
     assert server.compile_stats()["decode_compiles"] == 1
     assert r0.output_tokens  # the pre-update run completed too
+
+
+# -- robustness: deadlines, preemption cap, watchdog, graceful shutdown ------
+
+def test_request_terminal_status_ok(net):
+    rs = np.random.RandomState(30)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=3) for _ in range(3)]
+    server.run()
+    assert all(r.status == "ok" for r in reqs)
+    st = server.stats()["status_counts"]
+    assert st == {"ok": 3, "timed_out": 0, "preempted": 0, "rejected": 0}
+
+
+def test_deadline_expires_queued_request(net):
+    rs = np.random.RandomState(31)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    dead = server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                         max_new_tokens=4, deadline_s=0.0)
+    live = server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                         max_new_tokens=4)
+    import time as _t
+    _t.sleep(0.002)
+    server.run()
+    assert dead.state == "finished" and dead.status == "timed_out"
+    assert dead.finish_reason == "timeout"
+    assert dead.output_tokens == []   # never admitted after expiry
+    assert live.status == "ok"
+    assert server.stats()["status_counts"]["timed_out"] == 1
+
+
+def test_deadline_expires_running_request(net):
+    import time as _t
+    rs = np.random.RandomState(32)
+    server = InferenceServer(net, batch_slots=1, max_len=64,
+                             block_size=8, max_prompt_len=8)
+    r = server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=40, deadline_s=0.05)
+    server.step()                      # admitted + first token
+    assert r.state == "running" and r.output_tokens
+    _t.sleep(0.06)
+    server.run(max_ticks=3)            # next sweep sees it expired
+    assert r.status == "timed_out" and r.state == "finished"
+    assert len(r.output_tokens) < 40   # partial output is preserved
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_preemption_retry_cap_fails_request(net):
+    """max_preemptions=0: the first preemption is terminal instead of
+    a requeue — the victim fails with status 'preempted' and the
+    survivor runs to completion."""
+    rs = np.random.RandomState(33)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=12,
+                             num_blocks=6, max_preemptions=0)
+    ra = server.submit(rs.randint(0, 256, 10).astype(np.int32),
+                       max_new_tokens=12)
+    rb = server.submit(rs.randint(0, 256, 10).astype(np.int32),
+                       max_new_tokens=12)
+    server.run()
+    statuses = sorted([ra.status, rb.status])
+    assert statuses == ["ok", "preempted"]
+    victim = ra if ra.status == "preempted" else rb
+    winner = rb if victim is ra else ra
+    assert winner.finish_reason == "length"
+    assert victim.state == "finished" and victim.preemptions == 1
+    assert server.stats()["status_counts"]["preempted"] == 1
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_watchdog_trips_on_injected_stall(net):
+    from mxnet_tpu import faults
+    from mxnet_tpu.serving import ServerStalledError
+    telemetry.reset()
+    telemetry.enable()
+    rs = np.random.RandomState(34)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             watchdog_ticks=5)
+    server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                  max_new_tokens=4)
+    faults.inject("serving.stall")     # every tick is a dead tick
+    try:
+        with pytest.raises(ServerStalledError, match="5 consecutive"):
+            server.run()
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serving_watchdog_stalls_total"] == 1.0
+        assert snap["faults_injected_total{site=serving.stall}"] == 5.0
+        # disarm: the server recovers on the very next tick
+        faults.clear()
+        done = server.run()
+        assert [r.status for r in done] == ["ok"]
+    finally:
+        faults.clear()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_watchdog_quiet_when_idle(net):
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             watchdog_ticks=2)
+    for _ in range(10):                # empty ticks are not stalls
+        server.step()
+    assert server._stall_ticks == 0
+
+
+def test_drain_then_shutdown_rejects_submit(net):
+    rs = np.random.RandomState(35)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=3) for _ in range(4)]
+    done = server.drain()
+    assert len(done) == 4 and all(r.status == "ok" for r in reqs)
+    with pytest.raises(RuntimeError, match="draining"):
+        server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=2)
+    server.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=2)
+    server.shutdown()                  # idempotent
+    st = server.stats()
+    assert st["shutdown"] and st["draining"]
+
+
+def test_shutdown_without_drain_rejects_pending(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(36)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8)
+        reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                              max_new_tokens=8) for _ in range(3)]
+        server.step()                  # 2 running, 1 queued
+        server.shutdown(drain=False)
+        assert [r.status for r in reqs] == ["rejected"] * 3
+        assert all(r.state == "finished" for r in reqs)
+        assert server.cache.num_used_blocks == 0
+        st = server.stats()["status_counts"]
+        assert st["rejected"] == 3 and st["ok"] == 0
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serving_requests_total{status=rejected}"] == 3.0
+        server.cache.check()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_labeled_status_counters(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(37)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8)
+        server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=2)
+        server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=2, deadline_s=0.0)
+        import time as _t
+        _t.sleep(0.002)
+        server.run()
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serving_requests_total"] == 2.0          # submits
+        assert snap["serving_requests_total{status=ok}"] == 1.0
+        assert snap["serving_requests_total{status=timed_out}"] == 1.0
+        prom = telemetry.to_prometheus()
+        assert 'serving_requests_total{status="ok"}' in prom \
+            or "serving_requests_total{status=ok}" in prom
+    finally:
+        telemetry.disable()
+        telemetry.reset()
