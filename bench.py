@@ -579,6 +579,40 @@ def _resnet_phase(on_tpu, backend, probe_tflops, net=None):
     })
     _emit()
 
+    # whole-loop leg (default on): K steps per lax.scan dispatch.
+    # Headline takes whichever path wins — the K-loop removes the
+    # per-step dispatch gap (the delta field), but a conv net this
+    # compute-bound can lose more to XLA:CPU's big-graph compilation
+    # than the dispatch saving, so the measurement decides.
+    loop_k = int(os.environ.get("BENCH_LOOP_K", "4"))
+    if loop_k > 1:
+        step_ms_k1 = 1000.0 * batch / ips
+        window = [(x, y)] * loop_k
+        np.asarray(step.run_steps(window)._data)  # compile + first exec
+        wins = max(1, min(steps, int(max(0.0, _remaining() - 10.0)
+                                     / max(loop_k * step_s, 1e-9))))
+        t0 = time.perf_counter()
+        for _ in range(wins):
+            out = step.run_steps(window)
+        np.asarray(out._data)  # host fetch bounds the chain
+        dt_k = time.perf_counter() - t0
+        ips_k = batch * loop_k * wins / dt_k
+        step_ms_k = 1000.0 * batch / ips_k
+        best_ips = max(ips, ips_k)
+        _best.update({
+            "value": round(best_ips, 2),
+            "vs_baseline": round(best_ips / REFERENCE_IMG_PER_SEC, 3),
+            "mfu": round(best_ips * flops_per_img / peak, 4),
+            "step_ms": round(min(step_ms_k, step_ms_k1), 2),
+            "step_ms_k1": round(step_ms_k1, 2),
+            "step_ms_loop": round(step_ms_k, 2),
+            "loop_k": loop_k, "loop_windows": wins,
+            "dispatch_overhead_ms_per_step":
+                round(step_ms_k1 - step_ms_k, 2),
+            "phase": "resnet50_loop",
+        })
+        _emit()
+
 
 def _bert_phase(on_tpu, backend):
     """BERT pretraining samples/sec (SURVEY §6 metric 2), folded into
@@ -655,6 +689,30 @@ def _bert_phase(on_tpu, backend):
     float(acc.asscalar())  # chain-dependent host fetch = honest sync
     dt = time.perf_counter() - t0
     sps = batch * steps / dt
+
+    # whole-loop leg (default on): K steps per lax.scan dispatch —
+    # see the resnet phase for the rationale
+    loop_k = int(os.environ.get("BENCH_LOOP_K", "4"))
+    sps_k1, loop_fields = sps, {}
+    if loop_k > 1:
+        window = [(ids, tok, vlen, labels, mask, nsp)] * loop_k
+        np.asarray(step.run_steps(window)._data)  # compile + first
+        wins = max(1, min(steps, int(max(0.0, _remaining() - 10.0)
+                                     / max(loop_k * step_s, 1e-9))))
+        t0 = time.perf_counter()
+        for _ in range(wins):
+            out = step.run_steps(window)
+        np.asarray(out._data)
+        dt_k = time.perf_counter() - t0
+        sps_k = batch * loop_k * wins / dt_k
+        loop_fields = {
+            "bert_samples_per_sec_k1": round(sps_k1, 2),
+            "bert_loop_k": loop_k,
+            "bert_dispatch_overhead_ms_per_step":
+                round(1000.0 * batch * (1.0 / sps_k1 - 1.0 / sps_k), 2),
+        }
+        sps = max(sps, sps_k)
+    _best.update(loop_fields)
     _best.update({
         "bert_samples_per_sec": round(sps, 2),
         # only BERT-base is comparable to the V100 baseline; the CPU

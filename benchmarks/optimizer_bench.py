@@ -469,10 +469,87 @@ def main_telemetry_overhead():
         f"{TM_OVERHEAD_CEILING}x ceiling")
 
 
+def main_loop_k():
+    """`--loop-k`: whole-loop compilation sweep (ISSUE 8). One
+    dispatch-bound MLP step (small batch/hidden — the regime where the
+    per-step Python round-trip, not the math, is the bottleneck) run
+    three ways: K=1 single dispatches, and K∈{4,16} steps per lax.scan
+    dispatch via FusedTrainStep.run_steps. `value` is ms/step(K=1) /
+    ms/step(K=16); the asserted floor is > 1.0 — whole-loop compilation
+    must beat per-step dispatch on CPU where dispatch dominates."""
+    global _guard
+    _guard = guard = BudgetGuard("train_loop_k16_speedup", "x").install()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    batch = int(os.environ.get("BENCH_LOOPK_BATCH", "16"))
+    hidden = int(os.environ.get("BENCH_LOOPK_HIDDEN", "64"))
+    reps = int(os.environ.get("BENCH_LOOPK_REPS", "64"))  # steps per K
+
+    rs = np.random.RandomState(4)
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(hidden, activation="relu"),
+            mx.gluon.nn.Dense(hidden, activation="relu"),
+            mx.gluon.nn.Dense(8))
+    net.initialize()
+    step = FusedTrainStep(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.Adam(learning_rate=1e-3),
+                          mesh=None)
+    xs = mx.nd.array(rs.rand(batch, 32).astype(np.float32))
+    ys = mx.nd.array(rs.randint(0, 8, batch))
+
+    def time_k(k):
+        if k == 1:
+            for _ in range(4):
+                step(xs, ys)
+            jax.block_until_ready(step._tr)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                step(xs, ys)
+            jax.block_until_ready(step._tr)
+            return (time.perf_counter() - t0) / reps * 1e3
+        win = [(xs, ys)] * k
+        step.run_steps(win)  # compile + first exec
+        jax.block_until_ready(step._tr)
+        wins = max(1, reps // k)
+        t0 = time.perf_counter()
+        for _ in range(wins):
+            step.run_steps(win)
+        jax.block_until_ready(step._tr)
+        return (time.perf_counter() - t0) / (wins * k) * 1e3
+
+    ms = {k: time_k(k) for k in (1, 4, 16)}
+    ratio = ms[1] / ms[16]
+    guard.best.update({
+        "value": round(ratio, 3),
+        "vs_baseline": round(ratio, 3),  # floor is 1.0
+        "phase": "done",
+        "batch": batch, "hidden": hidden, "steps_per_k": reps,
+        "ms_per_step_k1": round(ms[1], 3),
+        "ms_per_step_k4": round(ms[4], 3),
+        "ms_per_step_k16": round(ms[16], 3),
+        "speedup_k4": round(ms[1] / ms[4], 3),
+        "dispatch_overhead_ms_per_step": round(ms[1] - ms[16], 3),
+        "floor": 1.0,
+    })
+    _mirror_to_telemetry(guard, "loop_k")
+    assert ratio > 1.0, (
+        f"K=16 whole-loop path ({ms[16]:.3f} ms/step) must beat K=1 "
+        f"single dispatches ({ms[1]:.3f} ms/step) on CPU; ratio "
+        f"{ratio:.3f}")
+
+
 if __name__ == "__main__":
     try:
         if "--telemetry-overhead" in sys.argv:
             main_telemetry_overhead()
+        elif "--loop-k" in sys.argv:
+            main_loop_k()
         elif "--zero" in sys.argv:
             _stage = int(sys.argv[sys.argv.index("--zero") + 1])
             main_zero1() if _stage == 1 else main_zero(_stage)

@@ -4,6 +4,7 @@ train step, optional dp×tp mesh and checkpointing.
 
 Usage:
   python examples/bert_pretrain.py [--steps 50] [--cpu] [--dp 4 --tp 2]
+  python examples/bert_pretrain.py --loop-k 8   # K steps per dispatch
 """
 import argparse
 import os
@@ -27,6 +28,10 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--ckpt", default="")
+    ap.add_argument("--loop-k", type=int, default=0,
+                    help="run K steps per dispatch via TrainLoop "
+                         "(whole-loop compilation; 0 = one dispatch "
+                         "per step)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -88,14 +93,28 @@ def main():
         ck = Checkpointer(args.ckpt, max_to_keep=2)
 
     t0 = time.time()
-    for i in range(args.steps):
-        ids, labels, mask, nsp_labels = synth_batch()
-        l = step(ids, labels, mask, nsp_labels)
-        if (i + 1) % 10 == 0:
-            print(f"step {i + 1}: loss {float(l.asscalar()):.4f}  "
-                  f"{(i + 1) * B / (time.time() - t0):.1f} samples/s")
-            if ck:
-                ck.save(i + 1, fused_step=step)
+    if args.loop_k > 0:
+        # whole-loop compilation (docs/compiled_loop.md): K steps per
+        # lax.scan dispatch, LR/loss-scale traced in-carry, checkpoint
+        # saves on K boundaries
+        def on_flush(done, losses):
+            print(f"step {done}: loss {float(losses[-1]):.4f}  "
+                  f"{done * B / (time.time() - t0):.1f} samples/s")
+
+        loop = mx.TrainLoop(step, k=args.loop_k, checkpointer=ck,
+                            save_every=10 if ck else None)
+        loop.run((synth_batch() for _ in range(args.steps)),
+                 max_steps=args.steps, on_flush=on_flush)
+    else:
+        for i in range(args.steps):
+            ids, labels, mask, nsp_labels = synth_batch()
+            l = step(ids, labels, mask, nsp_labels)
+            if (i + 1) % 10 == 0:
+                print(f"step {i + 1}: loss {float(l.asscalar()):.4f}  "
+                      f"{(i + 1) * B / (time.time() - t0):.1f} "
+                      "samples/s")
+                if ck:
+                    ck.save(i + 1, fused_step=step)
     if ck:
         ck.close()
 

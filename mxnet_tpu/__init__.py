@@ -39,6 +39,8 @@ from . import serving
 from . import amp
 from . import callback
 from . import checkpoint
+from . import train_loop
+from .train_loop import TrainLoop
 from . import faults
 from . import monitor
 from . import profiler

@@ -90,6 +90,33 @@ class DynamicLossScaler:
                 self.loss_scale *= self.scale_factor
                 self._unskipped = 0
 
+    def as_carry(self):
+        """(loss_scale, unskipped) as traced scalars — the scan-carry
+        form the compiled K-step loop threads through
+        `traced_update_scale` so loss-scale changes never retrace."""
+        return (jnp.float32(self.loss_scale), jnp.int32(self._unskipped))
+
+    def sync_from_carry(self, loss_scale, unskipped):
+        """Write the scan-carry back after a K-step dispatch (the host
+        mirror stays checkpointable / inspectable)."""
+        self.loss_scale = float(loss_scale)
+        self._unskipped = int(unskipped)
+
+    def traced_update_scale(self, ok, loss_scale, unskipped):
+        """update_scale as pure jnp ops: `ok` is the per-step
+        grads-finite predicate (overflow = ~ok). Same law as the host
+        method — back off (floor 1.0) on overflow, grow by
+        `scale_factor` after `scale_window` clean steps."""
+        grown = (unskipped + 1) >= int(self.scale_window)
+        new_scale = jnp.where(
+            ok,
+            jnp.where(grown, loss_scale * self.scale_factor, loss_scale),
+            jnp.maximum(loss_scale / self.scale_factor, 1.0))
+        new_unskipped = jnp.where(
+            ok, jnp.where(grown, 0, unskipped + 1), 0)
+        return new_scale.astype(jnp.float32), \
+            new_unskipped.astype(jnp.int32)
+
 
 import contextlib
 

@@ -31,7 +31,32 @@ from ... import telemetry as _tm
 from ...ndarray import NDArray, array
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
-__all__ = ["DataLoader", "DevicePrefetcher", "default_batchify_fn"]
+__all__ = ["DataLoader", "DevicePrefetcher", "default_batchify_fn",
+           "window_iter"]
+
+
+def window_iter(it, k: int):
+    """Group an iterator into lists of up to `k` consecutive batches —
+    the feed for the compiled K-step training loop
+    (FusedTrainStep.run_steps stacks each window to (K, ...) and runs
+    it as one lax.scan dispatch). The final window is ragged (shorter)
+    when the epoch length is not a multiple of `k`. Compose with
+    DevicePrefetcher so the prefetch thread fills the next window while
+    the current dispatch runs:
+
+        for window in window_iter(DevicePrefetcher(loader), k=8):
+            losses = step.run_steps(window)
+    """
+    if k < 1:
+        raise ValueError(f"window size must be >= 1; got {k}")
+    win = []
+    for item in it:
+        win.append(item)
+        if len(win) == k:
+            yield win
+            win = []
+    if win:
+        yield win
 
 
 class DevicePrefetcher:

@@ -192,6 +192,9 @@ class Trainer:
                 if not isinstance(skip_nonfinite, bool) else 8)
         else:
             self._sanitizer = None
+        # opt-in /metrics endpoint (MXNET_TPU_METRICS_PORT): no-op
+        # unless the env var is set
+        _tm.maybe_start_metrics_server()
 
     # -- lazy init (params may still be deferred at construction) ----------
     def _init_states(self):

@@ -27,6 +27,22 @@ class LRScheduler:
     def __call__(self, num_update):
         raise NotImplementedError
 
+    def _traced_warmup_lr(self, t):
+        import jax.numpy as jnp
+        if self.warmup_mode == "linear":
+            inc = (self.warmup_final_lr - self.warmup_begin_lr) * \
+                t.astype(jnp.float32) / max(self.warmup_steps, 1)
+            return self.warmup_begin_lr + inc
+        return jnp.full_like(t, self.warmup_final_lr, dtype=jnp.float32)
+
+    def as_traced(self):
+        """Pure `lr(num_update)` built from jnp ops — the form the
+        compiled K-step training loop evaluates in-scan so an LR change
+        never retraces. Returns None when the schedule is host-stateful
+        (FactorScheduler mutates itself per call) and the loop must
+        degrade to one dispatch per step."""
+        return None
+
 
 class FactorScheduler(LRScheduler):
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01,
@@ -81,6 +97,21 @@ class PolyScheduler(LRScheduler):
         return self.final_lr + (self.base_lr - self.final_lr) * \
             (1 - frac) ** self.power
 
+    def as_traced(self):
+        import jax.numpy as jnp
+
+        def lr(t):
+            tf = t.astype(jnp.float32)
+            frac = jnp.clip((tf - self.warmup_steps)
+                            / max(self.max_steps, 1), 0.0, 1.0)
+            main = self.final_lr + (self.base_lr - self.final_lr) * \
+                (1 - frac) ** self.power
+            main = jnp.where(t >= self.max_update, self.final_lr, main)
+            return jnp.where(t < self.warmup_steps,
+                             self._traced_warmup_lr(t),
+                             main).astype(jnp.float32)
+        return lr
+
 
 class CosineScheduler(LRScheduler):
     def __init__(self, max_update, base_lr=0.01, final_lr=0.0, **kw):
@@ -98,6 +129,21 @@ class CosineScheduler(LRScheduler):
         return self.final_lr + (self.base_lr - self.final_lr) * \
             (1 + math.cos(math.pi * frac)) / 2
 
+    def as_traced(self):
+        import jax.numpy as jnp
+
+        def lr(t):
+            tf = t.astype(jnp.float32)
+            frac = jnp.clip((tf - self.warmup_steps)
+                            / max(self.max_steps, 1), 0.0, 1.0)
+            main = self.final_lr + (self.base_lr - self.final_lr) * \
+                (1 + jnp.cos(math.pi * frac)) / 2
+            main = jnp.where(t >= self.max_update, self.final_lr, main)
+            return jnp.where(t < self.warmup_steps,
+                             self._traced_warmup_lr(t),
+                             main).astype(jnp.float32)
+        return lr
+
 
 class ConstantScheduler(LRScheduler):
     """Flat lr after (optional) warmup (reference: 'constant' mode)."""
@@ -106,6 +152,16 @@ class ConstantScheduler(LRScheduler):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         return self.base_lr
+
+    def as_traced(self):
+        import jax.numpy as jnp
+
+        def lr(t):
+            return jnp.where(t < self.warmup_steps,
+                             self._traced_warmup_lr(t),
+                             jnp.float32(self.base_lr)
+                             ).astype(jnp.float32)
+        return lr
 
 
 class LinearWarmUp(LRScheduler):
@@ -125,3 +181,15 @@ class LinearWarmUp(LRScheduler):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         return self.schedule(num_update)
+
+    def as_traced(self):
+        import jax.numpy as jnp
+        inner = getattr(self.schedule, "as_traced", lambda: None)()
+        if inner is None:
+            return None
+
+        def lr(t):
+            return jnp.where(t < self.warmup_steps,
+                             self._traced_warmup_lr(t),
+                             inner(t)).astype(jnp.float32)
+        return lr

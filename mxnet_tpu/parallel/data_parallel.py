@@ -244,6 +244,15 @@ class FusedTrainStep:
         self._step_count = 0
         self._zero3 = False  # _build_zero1 flips: _tr holds flat shards
         self._zero1_groups = None
+        # whole-loop compilation (run_steps): per-(K, batch-shape)
+        # lax.scan executables over the SAME step body _build lowered;
+        # _loop_body is the uniform per-tick closure each builder
+        # stashes, _loop_streak carries the consecutive-nonfinite-skip
+        # count across K boundaries
+        self._loop_body = None
+        self._loop_cache = {}
+        self._loop_streak = 0
+        self._loop_warned = False
         import weakref
         from .. import profiler as _prof
         ref = weakref.ref(self)
@@ -469,6 +478,20 @@ class FusedTrainStep:
                     tr[n], grads[n], states[n], hyper)
             return loss, new_tr, new_aux, new_states
 
+        # run_steps scans this same body; the extra global grad-norm
+        # feeds the stacked per-step telemetry and the in-scan
+        # nonfinite-skip predicate (unused outputs DCE away)
+        def loop_body(tr, aux, states, resid, hyper, key, batch):
+            loss, new_aux, grads = local_grads(tr, aux, key, batch)
+            gn2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree_util.tree_leaves(grads))
+            new_tr, new_states = {}, {}
+            for n in tr_names:
+                new_tr[n], new_states[n] = opt._step(
+                    tr[n], grads[n], states[n], hyper)
+            return (loss, jnp.sqrt(gn2), new_tr, new_aux, new_states,
+                    resid)
+
         if self.zero1:
             if self.mesh is not None and \
                     self.dp_axis in self.mesh.axis_names and \
@@ -524,6 +547,8 @@ class FusedTrainStep:
                 step, donate_argnums=(0, 2) if self.donate else ())
         self._tr_names = tr_names
         self._aux_names = aux_names
+        self._loop_body = loop_body
+        self._loop_mode = "gspmd" if self.mesh is not None else "plain"
 
     def _build_compressed(self, args, local_grads, tr_names, aux_names):
         """Quantized-allreduce variant: the step runs inside shard_map
@@ -576,6 +601,9 @@ class FusedTrainStep:
             grads, new_resid = compressed_psum_tree(
                 grads, resid, dp, scheme, threshold,
                 bucket_bytes=bucket_bytes)
+            # effective (decompressed, dp-mean) grad norm — replicated
+            gn2 = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree_util.tree_leaves(grads))
             loss = lax.pmean(loss, dp)
             # aux (e.g. BatchNorm running stats) computed on the local
             # shard: average across replicas like the fp32 path would
@@ -586,18 +614,31 @@ class FusedTrainStep:
             for n in tr_names:
                 new_tr[n], new_states[n] = opt._step(
                     tr[n], grads[n], states[n], hyper)
-            return (loss, new_tr, new_aux, new_states,
+            return (loss, jnp.sqrt(gn2), new_tr, new_aux, new_states,
                     jax.tree_util.tree_map(lambda r: r[None], new_resid))
+
+        def fn_step(tr, aux, states, hyper, key, resid, *batch):
+            out = step(tr, aux, states, hyper, key, resid, *batch)
+            return (out[0],) + out[2:]  # single path drops the gnorm
 
         batch_specs = tuple(split_batch_spec(
             _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp)
             for a in args)
+        in_specs = (P(), P(), P(), P(), P(), P(dp), *batch_specs)
         fn = shard_map(
-            step, mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(dp), *batch_specs),
+            fn_step, mesh=mesh, in_specs=in_specs,
             out_specs=(P(), P(), P(), P(), P(dp)))
         self._compiled = jax.jit(
             fn, donate_argnums=(0, 2, 5) if self.donate else ())
+        fn_loop = shard_map(
+            step, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(), P(), P(), P(), P(), P(dp)))
+
+        def loop_body(tr, aux, states, resid, hyper, key, batch):
+            return fn_loop(tr, aux, states, hyper, key, resid, *batch)
+
+        self._loop_body = loop_body
+        self._loop_mode = "shardmap"
         repl = NamedSharding(mesh, P())
         self._tr = {n: _global_put(v, repl)
                     for n, v in self._tr.items()}
@@ -830,6 +871,11 @@ class FusedTrainStep:
             else:
                 loss, new_aux, grads = local_grads(tr, aux, key, batch)
                 red, new_resid = _reduce_shards(grads, resid)
+            # global grad norm from the reduced shards (each rank holds
+            # a distinct 1/N slice; pad lanes are zero)
+            gn2 = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                      for v in red.values())
+            gnorm = jnp.sqrt(lax.psum(gn2, dp))
             loss = lax.pmean(loss, dp)
             new_aux = {n: lax.pmean(v, dp)
                        if jnp.issubdtype(v.dtype, jnp.inexact)
@@ -867,7 +913,7 @@ class FusedTrainStep:
                     for n, w in zip(g.names, _mt.unflatten_buckets(
                             full, g.plans, len(g.names))):
                         new_tr[n] = w
-            out = (loss, new_tr, new_aux, new_states)
+            out = (loss, gnorm, new_tr, new_aux, new_states)
             return out + ((new_resid,) if scheme is not None else ())
 
         batch_specs = tuple(split_batch_spec(
@@ -879,14 +925,24 @@ class FusedTrainStep:
         tr_spec = {k: P(dp) for k in z3_keys} if z3 else P()
         in_specs = (tr_spec, P(), st_spec, P(), P())
         out_specs = (P(), tr_spec, P(), st_spec)
+        loop_out_specs = (P(), P()) + out_specs[1:]
         if scheme is not None:
             in_specs = in_specs + (st_spec,)
             out_specs = out_specs + (st_spec,)
+            loop_out_specs = loop_out_specs + (st_spec,)
 
             def fn_step(tr, aux, states, hyper, key, resid, *batch):
+                out = step(tr, aux, states, hyper, key, resid, *batch)
+                return (out[0],) + out[2:]
+
+            def fn_stats(tr, aux, states, hyper, key, resid, *batch):
                 return step(tr, aux, states, hyper, key, resid, *batch)
         else:
             def fn_step(tr, aux, states, hyper, key, *batch):
+                out = step(tr, aux, states, hyper, key, None, *batch)
+                return (out[0],) + out[2:]
+
+            def fn_stats(tr, aux, states, hyper, key, *batch):
                 return step(tr, aux, states, hyper, key, None, *batch)
         # check_rep=False: all_gather'd weights ARE identical on every
         # replica but shard_map's static replication checker cannot
@@ -900,6 +956,20 @@ class FusedTrainStep:
             donate = (0, 2)
         self._compiled = jax.jit(
             fn, donate_argnums=donate if self.donate else ())
+        fn_loop = shard_map(
+            fn_stats, mesh=mesh, in_specs=in_specs + batch_specs,
+            out_specs=loop_out_specs, check_rep=False)
+        if scheme is not None:
+            def loop_body(tr, aux, states, resid, hyper, key, batch):
+                return fn_loop(tr, aux, states, hyper, key, resid,
+                               *batch)
+        else:
+            def loop_body(tr, aux, states, resid, hyper, key, batch):
+                loss, gnorm, ntr, naux, nst = fn_loop(
+                    tr, aux, states, hyper, key, *batch)
+                return loss, gnorm, ntr, naux, nst, resid
+        self._loop_body = loop_body
+        self._loop_mode = "shardmap"
         if z3:
             # weights live as 1/N flat bucket shards from here on;
             # full-size arrays exist only transiently inside the step
@@ -1214,6 +1284,16 @@ class FusedTrainStep:
             if ndp > 1:
                 loss = lax.pmean(loss, dp)
 
+            # global grad norm: each pp rank holds its stage's slice of
+            # `red` (full stacked for stage 0, 1/ndp flat shards under
+            # zero) — sum locally, psum across the axes that partition
+            gn2 = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                      for v in red.values())
+            gn2 = lax.psum(gn2, ppx)
+            if stage >= 1:
+                gn2 = lax.psum(gn2, dp)
+            gnorm = jnp.sqrt(gn2)
+
             new_tr, new_states = {}, {}
             if stage == 0:
                 # per-slot vmap: norm-based rules see each block's own
@@ -1238,7 +1318,7 @@ class FusedTrainStep:
                         stacked[n].shape[1:])[None]
                     new_states[n] = jax.tree_util.tree_map(
                         lambda v: v[None], nst)
-            out = (loss.astype(jnp.float32), new_tr, new_states)
+            out = (loss.astype(jnp.float32), gnorm, new_tr, new_states)
             return out + ((new_resid,) if scheme is not None else ())
 
         pspec = {n: P(ppx, *([None] * (stacked[n].ndim - 1)))
@@ -1256,6 +1336,7 @@ class FusedTrainStep:
                        split_batch_spec(yr.ndim, 0, dpn))
         in_specs = (pspec, P(ppx), st_spec, P(), P())
         out_specs = (P(), pspec, st_spec)
+        loop_out_specs = (P(), P(), pspec, st_spec)
         resid_spec = None
         if scheme is not None:
             if stage == 0:
@@ -1266,13 +1347,25 @@ class FusedTrainStep:
                 resid_spec = {n: P(dp, ppx) for n in names}
             in_specs = in_specs + (resid_spec,)
             out_specs = out_specs + (resid_spec,)
+            loop_out_specs = loop_out_specs + (resid_spec,)
 
             def fn_step(tr, mask_l, states_l, hyper, key, resid,
                         *batch):
+                out = body(tr, mask_l, states_l, hyper, key, resid,
+                           *batch)
+                return (out[0],) + out[2:]
+
+            def fn_stats(tr, mask_l, states_l, hyper, key, resid,
+                         *batch):
                 return body(tr, mask_l, states_l, hyper, key, resid,
                             *batch)
         else:
             def fn_step(tr, mask_l, states_l, hyper, key, *batch):
+                out = body(tr, mask_l, states_l, hyper, key, None,
+                           *batch)
+                return (out[0],) + out[2:]
+
+            def fn_stats(tr, mask_l, states_l, hyper, key, *batch):
                 return body(tr, mask_l, states_l, hyper, key, None,
                             *batch)
 
@@ -1286,6 +1379,23 @@ class FusedTrainStep:
         donate = (0, 2, 5) if scheme is not None else (0, 2)
         self._compiled = jax.jit(
             fn, donate_argnums=donate if self.donate else ())
+        fn_loop = shard_map(fn_stats, mesh=mesh,
+                            in_specs=in_specs + batch_specs,
+                            out_specs=loop_out_specs, check_rep=False)
+        if scheme is not None:
+            def loop_body(tr, mask_l, states_l, resid, hyper, key,
+                          batch):
+                loss, gnorm, ntr, nst, nres = fn_loop(
+                    tr, mask_l, states_l, hyper, key, resid, *batch)
+                return loss, gnorm, ntr, mask_l, nst, nres
+        else:
+            def loop_body(tr, mask_l, states_l, resid, hyper, key,
+                          batch):
+                loss, gnorm, ntr, nst = fn_loop(
+                    tr, mask_l, states_l, hyper, key, *batch)
+                return loss, gnorm, ntr, mask_l, nst, resid
+        self._loop_body = loop_body
+        self._loop_mode = "shardmap"
 
         def _nsh(spec):
             return NamedSharding(mesh, spec)
@@ -1430,3 +1540,310 @@ class FusedTrainStep:
                 raw[0], "ndim", 0) else None
             _tm.step_done(nb)
         return NDArray(loss)
+
+    # -- whole-loop compilation (K steps per dispatch) -----------------------
+    def _loop_fallback_reason(self):
+        """Why run_steps must degrade to K=1 single dispatches, or None
+        when the whole-loop path is usable (the degrade matrix in
+        docs/compiled_loop.md)."""
+        opt = self.optimizer
+        if not getattr(opt, "supports_fused", True):
+            return (f"{type(opt).__name__}.supports_fused is False "
+                    "(host-side state or randomness in the update)")
+        sched = getattr(opt, "lr_scheduler", None)
+        if sched is not None and \
+                getattr(sched, "as_traced", lambda: None)() is None:
+            return (f"{type(sched).__name__} has no traced form "
+                    "(as_traced() is None — it mutates host state per "
+                    "call), so the in-scan step counter cannot "
+                    "reproduce it")
+        tr = self._trainer
+        if tr is not None and getattr(tr, "_kvstore", None) is not None \
+                and getattr(tr, "_update_on_kvstore", False):
+            return ("update_on_kvstore routes every update through the "
+                    "host kvstore")
+        if self._loop_body is None:
+            return "this build variant does not expose a scan body"
+        return None
+
+    def _build_loop(self, k, scaler, skip_on, unroll=1):
+        """jit one lax.scan executable running `k` ticks of the SAME
+        step body `_build` lowered for the single-dispatch path. The
+        carry is (weights, aux, opt state, residuals, step counter,
+        loss-scale state, skip streak); per-tick xs are the RNG key and
+        the (K, ...)-stacked batch slices. LR schedule, AMP loss-scale
+        and nonfinite-skip all run as traced functions of the in-carry
+        counter, so nothing retraces across K boundaries."""
+        body = self._loop_body
+        opt = self.optimizer
+        sched = getattr(opt, "lr_scheduler", None)
+        lr_fn = getattr(sched, "as_traced", lambda: None)() \
+            if sched is not None else None
+        amp_on = scaler is not None
+        traced_scale = scaler.traced_update_scale if amp_on else None
+
+        def loop(tr, aux, states, resid, hyper0, carry0, keys, *sbatch):
+            def tick(c, xs):
+                tr, aux, states, resid, t, ls, unsk, streak = c
+                key, batch = xs[0], xs[1:]
+                t1 = t + 1
+                lr = lr_fn(t1) if lr_fn is not None else hyper0["lr"]
+                rescale = hyper0["rescale_unit"] / ls if amp_on \
+                    else hyper0["rescale"]
+                hyper = {"lr": jnp.asarray(lr, jnp.float32),
+                         "wd": hyper0["wd"], "t": t1,
+                         "rescale": jnp.asarray(rescale, jnp.float32)}
+                loss, gnorm, ntr, naux, nst, nres = body(
+                    tr, aux, states, resid, hyper, key, batch)
+                skipped = jnp.int32(0)
+                if not (skip_on or amp_on):
+                    # drop the grad-norm output so XLA dead-code
+                    # eliminates its reduction: a second consumer of
+                    # every grad tensor breaks the grad->optimizer
+                    # fusion and materializes the full grad set per
+                    # tick — measurably slower for big nets on CPU
+                    gnorm = jnp.zeros_like(loss)
+                if skip_on or amp_on:
+                    ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+                    if skip_on:
+                        def sel(new, old):
+                            return jax.tree_util.tree_map(
+                                lambda a, b: jnp.where(ok, a, b),
+                                new, old)
+                        ntr, naux = sel(ntr, tr), sel(naux, aux)
+                        nst, nres = sel(nst, states), sel(nres, resid)
+                        streak = jnp.where(ok, 0, streak + 1)
+                        skipped = (~ok).astype(jnp.int32)
+                    if amp_on:
+                        ls, unsk = traced_scale(ok, ls, unsk)
+                return ((ntr, naux, nst, nres, t1, ls, unsk, streak),
+                        (loss, gnorm, skipped))
+
+            c0 = (tr, aux, states, resid, carry0["t"], carry0["scale"],
+                  carry0["unskipped"], carry0["streak"])
+            c, ys = lax.scan(tick, c0, (keys,) + sbatch, unroll=unroll)
+            ntr, naux, nst, nres = c[:4]
+            losses, gnorms, skips = ys
+            return (losses, gnorms, skips, ntr, naux, nst, nres,
+                    {"scale": c[5], "unskipped": c[6], "streak": c[7]})
+
+        donate = (0, 2, 3) if self.donate else ()
+        if self._loop_mode == "gspmd":
+            # pin carry-out shardings to the carry-in ones so dispatch
+            # N+1 sees identical argument shardings (no recompile)
+            mesh = self.mesh
+            repl = NamedSharding(mesh, P())
+            hyper0_sh = {kk: repl for kk in
+                         ("lr", "wd", "rescale", "rescale_unit")}
+            carry0_sh = {kk: repl for kk in
+                         ("t", "scale", "unskipped", "streak")}
+            sb_sh = tuple(NamedSharding(mesh, P(None, *sh.spec))
+                          for sh in self._batch_sh)
+            fn = jax.jit(
+                loop,
+                in_shardings=(self._tr_sh, self._aux_sh, self._st_sh,
+                              {}, hyper0_sh, carry0_sh, repl, *sb_sh),
+                out_shardings=(repl, repl, repl, self._tr_sh,
+                               self._aux_sh, self._st_sh, {},
+                               {kk: repl for kk in
+                                ("scale", "unskipped", "streak")}),
+                donate_argnums=donate)
+        else:
+            fn = jax.jit(loop, donate_argnums=donate)
+        return {"fn": fn, "fresh": True}
+
+    def run_steps(self, batches, skip_nonfinite=None,
+                  unroll=None) -> NDArray:
+        """Run ``len(batches)`` fused steps as ONE ``lax.scan``
+        dispatch and return the stacked (K,) per-step losses.
+
+        `batches` is a sequence of K per-step argument tuples (what
+        ``__call__`` takes); they are stacked to (K, ...) on the host
+        and sliced per scan tick on device, so the executable runs K
+        full steps — forward, backward, gradient sync, optimizer —
+        without returning to Python. Numerics match K single dispatches
+        exactly: each tick consumes the same `random.next_key()` the
+        single path would have drawn, and the LR schedule / weight
+        decay / loss-scale are traced functions of the in-carry step
+        counter (host LR or loss-scale changes between dispatches never
+        retrace). One executable is compiled and cached per (K, batch
+        shape) — a ragged final window simply compiles a second, K'-
+        sized entry.
+
+        With a Trainer carrying an AMP ``DynamicLossScaler`` and/or a
+        ``GradSanitizer`` (or ``skip_nonfinite=True``), each tick also
+        checks grad finiteness in-scan: nonfinite ticks skip the update
+        (weights/state carried unchanged), the loss scale backs off /
+        grows by the host scaler's own law, and the stacked skip flags
+        are flushed to telemetry at the K boundary — where a sanitizer
+        budget overrun raises ``FloatingPointError`` like the eager
+        path. Host-visible per-step telemetry (stacked loss, grad norm,
+        skip flags) lands in ``self.last_loop_metrics``.
+
+        Unfusable configs — host-stateful LR schedulers,
+        ``supports_fused=False`` rules, update_on_kvstore — degrade
+        loudly to K single dispatches (one RuntimeWarning). Checkpoint
+        saves, fault-injection sites and the PreemptionHandler drain
+        all align to K boundaries: sites fire once per dispatch, and
+        ``_step_count`` only ever advances by K between dispatches."""
+        batches = [tuple(b) if isinstance(b, (tuple, list)) else (b,)
+                   for b in batches]
+        k = len(batches)
+        if k == 0:
+            raise ValueError("run_steps needs at least one batch")
+        if self._params is None:
+            self._init_state(batches[0])
+        if self._compiled is None:
+            self._build(batches[0])
+        opt = self.optimizer
+        trainer = self._trainer
+        scaler = getattr(trainer, "_amp_scaler", None) \
+            if trainer is not None else None
+        sanitizer = getattr(trainer, "_sanitizer", None) \
+            if trainer is not None else None
+        amp_on = scaler is not None
+        skip_on = bool(skip_nonfinite) if skip_nonfinite is not None \
+            else (sanitizer is not None or amp_on)
+        reason = self._loop_fallback_reason()
+        # K=1 with no in-scan skip/loss-scale semantics is exactly a
+        # single dispatch — skip the scan wrapper; skip_on/amp_on still
+        # go through the (K=1) scan so the streak/scale law is uniform
+        if reason is not None or (k == 1 and not (skip_on or amp_on)):
+            if reason is not None and k > 1 and not self._loop_warned:
+                import warnings
+                warnings.warn(
+                    f"run_steps(K={k}) degrading to K=1 single "
+                    f"dispatches: {reason}", RuntimeWarning,
+                    stacklevel=2)
+                self._loop_warned = True
+            losses = [self(*b)._data for b in batches]
+            return NDArray(jnp.stack(losses))
+
+        from .. import tracing as _tracing
+        import time as _time
+
+        raw = [[a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in b] for b in batches]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in raw[0])
+        # unroll=k flattens the scan into straight-line code: same
+        # single dispatch, but no while-loop boundary, so XLA keeps the
+        # single-step executable's layouts/fusions (on CPU the loop
+        # carry otherwise pays per-tick weight-layout copies that can
+        # swamp the dispatch saving for conv-heavy nets). Costs ~k x
+        # compile time; default 1 (rolled), settable per call or via
+        # `self.loop_unroll`.
+        if unroll is None:
+            unroll = getattr(self, "loop_unroll", 1)
+        unroll = k if unroll is True else min(int(unroll), k)
+        name = f"train_loop_k{k}"
+        ck = (k, sig, amp_on, skip_on, unroll)
+        entry = self._loop_cache.get(ck)
+        if entry is None:
+            entry = self._build_loop(k, scaler if amp_on else None,
+                                     skip_on, unroll=max(1, unroll))
+            self._loop_cache[ck] = entry
+        else:
+            _tracing.record_hit(name)
+
+        if _ft._ACTIVE:
+            # one fire per dispatch: fault sites land on K boundaries,
+            # with the previous window fully committed
+            _ft.kill_point("step.kill")
+            _ft.delay_point("host.slow")
+
+        # K host key draws — the exact key sequence K single dispatches
+        # would consume, so dropout/RNG parity is bitwise
+        keys = jnp.stack([_random.next_key() for _ in range(k)])
+        with _tm.phase("data"):
+            stacked = []
+            for j in range(len(raw[0])):
+                s = jnp.stack([raw[i][j] for i in range(k)])
+                if self.mesh is not None:
+                    s = _global_put(s, NamedSharding(
+                        self.mesh, P(None, *self._batch_sh[j].spec)))
+                stacked.append(s)
+
+        hyper0 = {
+            "lr": jnp.asarray(opt.lr, jnp.float32),
+            "wd": jnp.asarray(opt.wd, jnp.float32),
+            "rescale": jnp.asarray(opt.rescale_grad, jnp.float32),
+            "rescale_unit": jnp.asarray(
+                opt.rescale_grad * (scaler.loss_scale if amp_on
+                                    else 1.0), jnp.float32)}
+        if amp_on:
+            ls0, unsk0 = scaler.as_carry()
+        else:
+            ls0, unsk0 = jnp.float32(1.0), jnp.int32(0)
+        carry0 = {"t": jnp.asarray(self._step_count, jnp.int32),
+                  "scale": ls0, "unskipped": unsk0,
+                  "streak": jnp.asarray(self._loop_streak, jnp.int32)}
+        aux_in = self._pp_mask if self._pp_mask is not None \
+            else self._aux
+        resid_in = self._resid if self._resid is not None else {}
+
+        timed = _tm._ENABLED
+        fresh = entry.pop("fresh", False)
+        if timed or fresh:
+            t_start = _time.perf_counter()
+        with use_mesh(self.mesh if self.mesh is not None
+                      else current_mesh()):
+            (losses, gnorms, skips, self._tr, aux_out, self._states,
+             resid_out, carry_out) = entry["fn"](
+                self._tr, aux_in, self._states, resid_in, hyper0,
+                carry0, keys, *stacked)
+        if fresh:
+            jax.block_until_ready(losses)
+            _tracing.record_compile(name, None)
+            _tracing.record_compile_seconds(
+                name, _time.perf_counter() - t_start)
+        if self._pp_mask is not None:
+            self._pp_mask = aux_out
+        else:
+            self._aux = aux_out
+        if self._resid is not None:
+            self._resid = resid_out
+        self._step_count += k
+        opt.num_update = self._step_count
+
+        if amp_on:
+            scaler.sync_from_carry(carry_out["scale"],
+                                   carry_out["unskipped"])
+        if skip_on:
+            self._loop_streak = int(carry_out["streak"])
+            nskip = int(jnp.sum(skips))
+            if nskip:
+                _tm.inc("steps_skipped_nonfinite_total", nskip)
+            if sanitizer is not None:
+                sanitizer.consecutive_skips = self._loop_streak
+                cap = sanitizer.max_consecutive_skips
+                if self._loop_streak > cap:
+                    raise FloatingPointError(
+                        f"gradients nonfinite for {self._loop_streak} "
+                        f"consecutive steps (> max_consecutive_skips="
+                        f"{cap}) — the run has diverged; lower the lr "
+                        "or check the data pipeline")
+        self.last_loop_metrics = {"loss": NDArray(losses),
+                                  "grad_norm": NDArray(gnorms),
+                                  "skipped": NDArray(skips)}
+
+        if timed:
+            jax.block_until_ready(losses)
+            dt = _time.perf_counter() - t_start
+            per = dt / k
+            # per-step device spans are synthesized by even split: the
+            # K steps ran back-to-back inside one executable, so the
+            # per-step timeline shows K contiguous spans with the
+            # per-dispatch host gap gone
+            for i in range(k):
+                _tm.mark_phase("fused_step", per, t0=t_start + i * per,
+                               device=True)
+            if self._pp_staged is not None:
+                _tm.record_pipeline_step(self._pp_nstages,
+                                         self.pipeline, dt, t0=t_start)
+            _tm.mark_phase("fused_loop_host", dt, t0=t_start)
+            nb = raw[0][0].shape[0] if raw[0] and getattr(
+                raw[0][0], "ndim", 0) else None
+            _tm.step_done(nb * k if nb else None, steps=k)
+            _tm.set_gauge("train_loop_k", k)
+            _tm.inc("train_loop_dispatches_total")
+        return NDArray(losses)

@@ -210,6 +210,9 @@ class InferenceServer:
         self._stall_ticks = 0
         self._draining = False
         self._shutdown = False
+        # opt-in /metrics endpoint (MXNET_TPU_METRICS_PORT): no-op
+        # unless the env var is set
+        telemetry.maybe_start_metrics_server()
 
     # -- request intake -----------------------------------------------------
 

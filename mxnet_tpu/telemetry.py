@@ -55,6 +55,8 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "phase", "mark_phase", "step_done",
            "snapshot", "to_prometheus", "dump_json", "breakdown_table",
            "export_chrome_trace", "note_device_trace",
+           "start_metrics_server", "stop_metrics_server",
+           "maybe_start_metrics_server",
            "STEP_PHASES", "SERVE_PHASES"]
 
 #: THE flag. Instrumented call sites across the stack guard with
@@ -375,14 +377,18 @@ def record_pipeline_step(num_stages: int, num_microbatches: int,
     set_gauge("pipeline_num_microbatches", M)
 
 
-def step_done(samples: Optional[int] = None):
-    """Mark one optimizer step complete. Feeds `steps_total` and — when
-    `samples` (the global batch size) is given — the rolling
-    `samples_per_sec` speedometer gauge (window of the last 64 steps)."""
+def step_done(samples: Optional[int] = None, steps: int = 1):
+    """Mark `steps` optimizer steps complete (default one). Feeds
+    `steps_total` and — when `samples` (the TOTAL sample count across
+    those steps, i.e. K·global-batch for a K-step fused-loop flush) is
+    given — the rolling `samples_per_sec` speedometer gauge (window of
+    the last 64 host events). A whole-loop dispatch is one host event
+    carrying K steps' worth of samples, so the speedometer stays
+    correct without one callback per step."""
     if not _ENABLED:
         return
     now = time.perf_counter()
-    inc("steps_total")
+    inc("steps_total", steps)
     if samples:
         _SPEED_WINDOW.append((now, int(samples)))
         if len(_SPEED_WINDOW) >= 2:
@@ -478,6 +484,93 @@ def to_prometheus() -> str:
                 lines.append(f"{fam.name}_sum{sfx} {ch.sum:g}")
                 lines.append(f"{fam.name}_count{sfx} {ch.count}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+class _MetricsServer:
+    """Handle for a running /metrics endpoint: `.port`, `.url`,
+    `.close()`. Construction binds and starts the daemon thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] == "/metrics":
+                    body = to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path.split("?")[0] == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep scrapes out of stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}/metrics"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mxnet-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_METRICS_SERVER: Optional[_MetricsServer] = None
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "127.0.0.1") -> _MetricsServer:
+    """Serve `to_prometheus()` at GET /metrics (plus a /healthz probe)
+    from a stdlib ThreadingHTTPServer daemon thread — the pull-based
+    exposition for multi-host jobs where every worker scrapes its own
+    process. `port=0` binds an ephemeral port (see `.port`/`.url` on
+    the returned handle). One server per process: repeated calls return
+    the existing handle."""
+    global _METRICS_SERVER
+    with _lock:
+        if _METRICS_SERVER is None:
+            _METRICS_SERVER = _MetricsServer(port=port, host=host)
+    return _METRICS_SERVER
+
+
+def stop_metrics_server():
+    """Shut the /metrics endpoint down (no-op when none is running)."""
+    global _METRICS_SERVER
+    with _lock:
+        srv, _METRICS_SERVER = _METRICS_SERVER, None
+    if srv is not None:
+        srv.close()
+
+
+def maybe_start_metrics_server() -> Optional[_MetricsServer]:
+    """Opt-in hook Trainer/InferenceServer call at construction: when
+    MXNET_TPU_METRICS_PORT is set, enable telemetry and serve /metrics
+    on that port (0 = ephemeral; MXNET_TPU_METRICS_HOST overrides the
+    127.0.0.1 bind). Unset → None, nothing started."""
+    spec = os.environ.get("MXNET_TPU_METRICS_PORT")
+    if spec is None or spec == "":
+        return None
+    enable()
+    return start_metrics_server(
+        port=int(spec), host=os.environ.get("MXNET_TPU_METRICS_HOST",
+                                            "127.0.0.1"))
 
 
 def dump_json(path: Optional[str] = None) -> str:

@@ -1,0 +1,102 @@
+"""Whole-loop training driver: K steps per XLA dispatch.
+
+The fused step (FusedTrainStep) already compiles one step into one
+executable, but Python still dispatches every step — dataloader
+hand-off, LR schedule, loss-scale update and telemetry all round-trip
+through the host, and on dispatch-bound configs that gap dominates.
+Following the compile-the-whole-loop approach of Julia→XLA
+(arXiv:1810.09868) and the host-overlap discipline of the MLPerf
+TPU-pod work (arXiv:1909.09756), ``TrainLoop`` windows the data stream
+into chunks of K batches and runs each window as ONE ``lax.scan``
+dispatch via ``FusedTrainStep.run_steps`` — the LR schedule, weight
+decay and AMP loss-scale law are traced functions of the in-carry step
+counter, so nothing retraces across window boundaries.
+
+Checkpoint saves, fault-injection sites and preemption drain all align
+to K boundaries: the loop only regains control between dispatches, and
+``run_steps`` advances ``_step_count`` by the whole window at once.
+See docs/compiled_loop.md for when K helps and the degrade matrix.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from . import telemetry as _tm
+from .gluon.data.dataloader import DevicePrefetcher, window_iter
+
+__all__ = ["TrainLoop"]
+
+
+class TrainLoop:
+    """Drive a ``FusedTrainStep`` over a batch stream, K steps per
+    dispatch.
+
+    ``data`` yields per-step batch tuples (what ``step(*batch)``
+    takes); it is wrapped in a ``DevicePrefetcher`` (unless it already
+    is one) so the host stacks window i+1 while window i runs on
+    device. Each window of K batches becomes one ``run_steps`` call —
+    a ragged final window just uses the second cached executable.
+
+    Checkpointing: pass a ``Checkpointer`` plus ``save_every`` (in
+    steps; rounded up to the next K boundary, since the loop only sees
+    the host between dispatches) and optionally an installed
+    ``PreemptionHandler`` — on ``ph.preempted`` the loop finalizes a
+    synchronous checkpoint at the K boundary and stops cleanly.
+    """
+
+    def __init__(self, step, k: int = 8, checkpointer=None,
+                 save_every: Optional[int] = None, preemption=None,
+                 prefetch_depth: int = 2):
+        if k < 1:
+            raise ValueError(f"k must be >= 1; got {k}")
+        self.step = step
+        self.k = int(k)
+        self.checkpointer = checkpointer
+        self.save_every = save_every
+        self.preemption = preemption
+        self.prefetch_depth = prefetch_depth
+        self.stopped_by_preemption = False
+
+    def _maybe_save(self, done_steps: int, last_saved: int) -> int:
+        ck, every = self.checkpointer, self.save_every
+        if ck is None or not every:
+            return last_saved
+        # K boundary at/after the save cadence: save when the step
+        # counter crossed a multiple of `every` since the last save
+        if done_steps // every > last_saved // every:
+            ck.save(done_steps, fused_step=self.step)
+            return done_steps
+        return last_saved
+
+    def run(self, data: Iterable, max_steps: Optional[int] = None,
+            on_flush: Optional[Callable] = None) -> int:
+        """Consume `data` (one epoch, or forever for an infinite
+        stream), up to `max_steps` optimizer steps. Calls
+        ``on_flush(step_count, losses)`` after each dispatch with the
+        stacked (K,) loss NDArray. Returns the step count reached."""
+        step = self.step
+        if not isinstance(data, DevicePrefetcher):
+            data = DevicePrefetcher(data, depth=self.prefetch_depth)
+        last_saved = step._step_count
+        for window in window_iter(iter(data), self.k):
+            if max_steps is not None:
+                left = max_steps - step._step_count
+                if left <= 0:
+                    break
+                window = window[:left]
+            losses = step.run_steps(window)
+            if on_flush is not None:
+                on_flush(step._step_count, losses)
+            last_saved = self._maybe_save(step._step_count, last_saved)
+            ph = self.preemption
+            if ph is not None and ph.preempted:
+                # drain at the K boundary: the window above is fully
+                # committed, so the final checkpoint is consistent
+                ph.finalize(step._step_count, fused_step=step)
+                self.stopped_by_preemption = True
+                break
+            if max_steps is not None and step._step_count >= max_steps:
+                break
+        if _tm._ENABLED:
+            _tm.set_gauge("train_loop_k", self.k)
+        return step._step_count

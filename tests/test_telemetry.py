@@ -537,3 +537,69 @@ def test_telemetry_handler_silent_when_disabled():
     h.batch_end(_Est())
     h.train_end(_Est())
     assert lines == []
+
+
+# -- K-step flush speedometer / /metrics endpoint (ISSUE 8) ------------------
+
+def test_step_done_k_step_flush():
+    """One run_steps(K) flush counts K steps and K*batch samples — the
+    speedometer must not under-report by K when the host only regains
+    control at window boundaries."""
+    tm.enable()
+    import time
+    tm.step_done(samples=32, steps=4)
+    time.sleep(0.01)
+    tm.step_done(samples=32, steps=4)
+    snap = tm.snapshot()
+    assert snap["counters"]["steps_total"] == 8.0
+    assert snap["samples_per_sec"] > 0.0
+
+
+def test_metrics_server_serves_prometheus():
+    import urllib.request
+    tm.enable()
+    tm.inc("steps_total", 5)
+    srv = tm.start_metrics_server()
+    try:
+        assert srv.port > 0
+        body = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+        assert "steps_total 5" in body
+        hz = urllib.request.urlopen(
+            srv.url.replace("/metrics", "/healthz"), timeout=5).read()
+        assert hz == b"ok\n"
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                srv.url.replace("/metrics", "/nope"), timeout=5)
+        assert tm.start_metrics_server() is srv  # idempotent singleton
+    finally:
+        tm.stop_metrics_server()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(srv.url, timeout=2)  # actually closed
+
+
+def test_metrics_server_env_gate(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_METRICS_PORT", raising=False)
+    assert tm.maybe_start_metrics_server() is None  # opt-in: default off
+    monkeypatch.setenv("MXNET_TPU_METRICS_PORT", "0")
+    srv = tm.maybe_start_metrics_server()
+    try:
+        assert srv is not None and srv.port > 0
+        assert tm._ENABLED  # the env gate also enables collection
+    finally:
+        tm.stop_metrics_server()
+
+
+def test_metrics_server_live_counters():
+    """The endpoint reflects counters incremented after startup — it
+    snapshots per scrape, not at server start."""
+    import urllib.request
+    tm.enable()
+    srv = tm.start_metrics_server()
+    try:
+        tm.inc("train_loop_dispatches_total")
+        tm.set_gauge("train_loop_k", 8)
+        body = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+        assert "train_loop_dispatches_total 1" in body
+        assert "train_loop_k 8" in body
+    finally:
+        tm.stop_metrics_server()

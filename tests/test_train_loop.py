@@ -1,0 +1,550 @@
+"""Whole-loop compilation (ISSUE 8): ``FusedTrainStep.run_steps`` rolls
+K fused steps into ONE ``lax.scan`` dispatch — batches stacked on the
+host and sliced per tick, LR schedule / loss-scale / skip law traced
+functions of the in-carry step counter. Parity contract matches the
+fused-step suites: bit-exact for elementwise rules (SGD, compressed
+SGD), <=1e-6 for reassociated reductions (Adam, pipeline). Plus: ragged
+tails reuse a second cached executable, host LR / loss-scale changes
+never retrace, unfusable configs degrade loudly to K=1, fault sites and
+SIGKILL/restart land on K boundaries, and ``TrainLoop`` drives the
+whole thing with checkpoint cadence. Runs on the 8-virtual-device CPU
+mesh (conftest)."""
+import os as _os
+import signal as _signal
+import subprocess as _subprocess
+import sys as _sys
+import textwrap as _textwrap
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu import tracing
+from mxnet_tpu.amp import DynamicLossScaler
+from mxnet_tpu.gluon.data.dataloader import window_iter
+from mxnet_tpu.gluon.trainer import GradSanitizer
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+def _toy_net(h=16, c=3):
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(h, activation="relu"),
+            mx.gluon.nn.Dense(c))
+    net.initialize()
+    return net
+
+
+def _batches(k, n=16, seed=1):
+    rs = np.random.RandomState(seed)
+    return [(mx.nd.array(rs.randn(n, 10).astype(np.float32)),
+             mx.nd.array(rs.randint(0, 3, (n,)).astype(np.float32)))
+            for _ in range(k)]
+
+
+def _nan_batch(n=16):
+    return (mx.nd.array(np.full((n, 10), np.nan, np.float32)),
+            mx.nd.array(np.zeros((n,), np.float32)))
+
+
+def _run(loop, opt_fn, mesh_fn=None, windows=(3, 3), n=16, **kw):
+    """Train sum(windows) steps either as K single dispatches or as
+    len(windows) run_steps dispatches; return (losses, weights, step)."""
+    mx.random.seed(0)
+    net = _toy_net()
+    mesh = mesh_fn() if mesh_fn else None
+    step = FusedTrainStep(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          opt_fn(), mesh=mesh, **kw)
+    bs = _batches(sum(windows), n=n)
+    if loop:
+        losses, i = [], 0
+        for w in windows:
+            out = step.run_steps(bs[i:i + w])
+            i += w
+            losses.extend(np.asarray(out._data).tolist())
+    else:
+        losses = [float(step(*b).asscalar()) for b in bs]
+    step.sync_to_params()
+    ws = {name: np.asarray(p.data()._data, np.float32)
+          for name, p in net.collect_params().items()}
+    return np.array(losses), ws, step
+
+
+def _check_parity(opt_fn, atol=0.0, mesh_fn=None, **kw):
+    l0, w0, _ = _run(False, opt_fn, mesh_fn, **kw)
+    l1, w1, stp = _run(True, opt_fn, mesh_fn, **kw)
+    assert stp._step_count == 6
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=max(atol, 1e-6),
+                               err_msg="losses")
+    for name in w0:
+        np.testing.assert_allclose(w0[name], w1[name], rtol=0,
+                                   atol=atol, err_msg=name)
+
+
+_sgd = lambda: mx.optimizer.SGD(learning_rate=0.2, momentum=0.9)
+_adam = lambda: mx.optimizer.Adam(learning_rate=0.02)
+_dp8 = lambda: make_mesh([8], ["dp"])
+
+
+# -- parity: K-step loop vs K single dispatches ------------------------------
+
+def test_plain_sgd_bitexact():
+    _check_parity(_sgd)
+
+
+@needs8
+def test_gspmd_sgd_bitexact():
+    _check_parity(_sgd, mesh_fn=_dp8)
+
+
+@needs8
+def test_zero2_adam_close():
+    _check_parity(_adam, atol=1e-6, mesh_fn=_dp8, zero=2)
+
+
+@needs8
+@pytest.mark.parametrize("tag,opt_fn,atol,kw", [
+    ("gspmd-adam", _adam, 1e-6, {}),
+    ("zero1-sgd", _sgd, 0.0, {"zero": 1}),
+    ("zero3-sgd", _sgd, 0.0, {"zero": 3}),
+    ("accum-sgd", _sgd, 0.0, {"grad_accum": 2}),
+    ("comp2bit-sgd",
+     lambda: mx.optimizer.SGD(learning_rate=0.2), 0.0,
+     {"compression": {"type": "2bit", "threshold": 0.02}}),
+    ("comp-int8-zero2", _adam, 1e-6,
+     {"zero": 2, "compression": {"type": "int8"}}),
+])
+def test_parity_matrix(tag, opt_fn, atol, kw):
+    _check_parity(opt_fn, atol=atol, mesh_fn=_dp8, **kw)
+
+
+@needs8
+def test_pipeline_loop_parity():
+    from mxnet_tpu.parallel.mesh import hybrid_mesh
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.ndarray import NDArray
+
+    def dense_chain():
+        mx.random.seed(0)
+        net = mx.gluon.nn.HybridSequential()
+        for _ in range(8):
+            net.add(mx.gluon.nn.Dense(8, activation="relu"))
+        net.initialize()
+        return net
+
+    def run(loop):
+        net = dense_chain()
+        step = FusedTrainStep(
+            net, L2Loss(),
+            mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9),
+            mesh=hybrid_mesh(dp=2, pp=4), pipeline=8, zero=1)
+        rs = np.random.RandomState(42)
+        bs = [(NDArray(jnp.asarray(rs.rand(32, 8), jnp.float32)),
+               NDArray(jnp.asarray(rs.rand(32, 8), jnp.float32)))
+              for _ in range(6)]
+        if loop:
+            ls = np.concatenate(
+                [np.asarray(step.run_steps(bs[:3])._data),
+                 np.asarray(step.run_steps(bs[3:])._data)])
+        else:
+            ls = np.array([float(step(*b)) for b in bs])
+        step.sync_to_params()
+        ws = {k: np.asarray(p.data()._data)
+              for k, p in net.collect_params().items()}
+        return ls, ws, step
+
+    l0, w0, _ = run(False)
+    l1, w1, stp = run(True)
+    assert stp._pp_staged is not None and stp._step_count == 6
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-6)
+    for k in w0:
+        np.testing.assert_allclose(w0[k], w1[k], rtol=0, atol=1e-6,
+                                   err_msg=k)
+
+
+# -- trace-once / ragged tail ------------------------------------------------
+
+def test_trace_once_across_lr_schedule():
+    """LR advances every step via the traced scheduler, yet five K=3
+    windows compile exactly once: the schedule is a function of the
+    in-carry step counter, not a host-baked constant."""
+    tracing.reset_cache_stats()
+    sched = mx.lr_scheduler.CosineScheduler(max_update=50, base_lr=0.1,
+                                            warmup_steps=4)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           lr_scheduler=sched)
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(), opt)
+    for i in range(5):
+        step.run_steps(_batches(3, seed=i))
+    st = tracing.cache_stats()["per_block"]["train_loop_k3"]
+    assert st["compiles"] == 1 and st["hits"] == 4, st
+
+
+def test_cosine_scheduler_loop_parity():
+    def run(loop):
+        mx.random.seed(0)
+        s = mx.lr_scheduler.CosineScheduler(max_update=50, base_lr=0.1,
+                                            warmup_steps=4)
+        o = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                             lr_scheduler=s)
+        stp = FusedTrainStep(_toy_net(),
+                             mx.gluon.loss.SoftmaxCrossEntropyLoss(), o)
+        bs = _batches(8, seed=7)
+        if loop:
+            ls = np.concatenate(
+                [np.asarray(stp.run_steps(bs[:4])._data),
+                 np.asarray(stp.run_steps(bs[4:])._data)])
+        else:
+            ls = np.array([float(stp(*b).asscalar()) for b in bs])
+        return ls, {k: np.asarray(v) for k, v in stp._tr.items()}
+
+    l0, w0 = run(False)
+    l1, w1 = run(True)
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-6)
+    for k in w0:
+        np.testing.assert_allclose(w0[k], w1[k], rtol=0, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_ragged_tail_second_executable():
+    tracing.reset_cache_stats()
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    bs = _batches(10, seed=9)
+    step.run_steps(bs[:4])
+    step.run_steps(bs[4:8])
+    step.run_steps(bs[8:])          # ragged tail of 2
+    pb = tracing.cache_stats()["per_block"]
+    assert pb["train_loop_k4"]["compiles"] == 1
+    assert pb["train_loop_k4"]["hits"] == 1
+    assert pb["train_loop_k2"]["compiles"] == 1
+    assert len(step._loop_cache) == 2
+    assert step._step_count == 10
+
+
+def test_last_loop_metrics_stacked():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    out = step.run_steps(_batches(3))
+    assert out.shape == (3,)
+    m = step.last_loop_metrics
+    assert np.asarray(m["loss"]._data).shape == (3,)
+    assert np.asarray(m["skipped"]._data).tolist() == [0, 0, 0]
+
+
+# -- loud degrade matrix -----------------------------------------------------
+
+def test_host_stateful_scheduler_degrades_loudly_once():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=0.1)
+    opt = mx.optimizer.SGD(learning_rate=0.1, lr_scheduler=sched)
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(), opt)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = step.run_steps(_batches(3))
+    assert any("degrading" in str(x.message) for x in w)
+    assert out.shape == (3,)            # still trains, K=1 dispatches
+    assert step._step_count == 3
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step.run_steps(_batches(3))
+    assert not any("degrading" in str(x.message) for x in w)  # warn once
+
+
+def test_supports_fused_false_reason():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create("sgld", learning_rate=0.01))
+    assert "supports_fused" in step._loop_fallback_reason()
+
+
+def test_update_on_kvstore_reason():
+    class FakeTrainer:
+        _kvstore = object()
+        _update_on_kvstore = True
+        _sanitizer = None
+        _amp_scaler = None
+
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    step._trainer = FakeTrainer()
+    assert "kvstore" in step._loop_fallback_reason()
+
+
+# -- in-scan nonfinite skip / loss scale -------------------------------------
+
+def test_skip_nonfinite_in_scan():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1,
+                                           momentum=0.9))
+    bs = _batches(4, seed=3)
+    step.run_steps(bs[:1], skip_nonfinite=True)
+    w_ref = {k: np.asarray(v) for k, v in step._tr.items()}
+    out = step.run_steps([_nan_batch(), bs[2]], skip_nonfinite=True)
+    sk = np.asarray(step.last_loop_metrics["skipped"]._data)
+    assert sk.tolist() == [1, 0]
+    assert step._loop_streak == 0       # good tick reset the streak
+    ls = np.asarray(out._data)
+    assert np.isnan(ls[0]) and np.isfinite(ls[1])
+    # the good tick's update applied even though the bad one was skipped
+    name = next(iter(w_ref))
+    assert not np.array_equal(w_ref[name], np.asarray(step._tr[name]))
+
+
+def test_streak_carries_across_k_boundaries():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    bad = _nan_batch()
+    step.run_steps([bad, bad], skip_nonfinite=True)
+    assert step._loop_streak == 2
+    # K=1 with skip semantics still routes through the scan carry
+    step.run_steps([bad], skip_nonfinite=True)
+    assert step._loop_streak == 3
+
+
+def test_sanitizer_budget_raises_at_k_boundary():
+    class FakeTrainer:
+        _kvstore = None
+        _update_on_kvstore = False
+        _amp_scaler = None
+        _sanitizer = GradSanitizer(max_consecutive_skips=2)
+
+    tr = FakeTrainer()
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    step._trainer = tr
+    bad = _nan_batch()
+    with pytest.raises(FloatingPointError, match="consecutive"):
+        step.run_steps([bad, bad, bad])
+    assert tr._sanitizer.consecutive_skips == 3
+
+
+def test_amp_scaler_in_scan_trace_once():
+    """The loss-scale law runs in-scan: scale grows by the host law and
+    growth between windows does NOT retrace (scale rides the carry)."""
+
+    class FakeTrainer:
+        _kvstore = None
+        _update_on_kvstore = False
+        _sanitizer = None
+        _amp_scaler = DynamicLossScaler(init_scale=4.0, scale_factor=2.0,
+                                        scale_window=2)
+
+    tr = FakeTrainer()
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    step._trainer = tr
+    tracing.reset_cache_stats()
+    step.run_steps(_batches(2, seed=1))
+    assert tr._amp_scaler.loss_scale == 8.0     # 2 good ticks -> 1 growth
+    step.run_steps(_batches(2, seed=2))
+    assert tr._amp_scaler.loss_scale == 16.0
+    st = tracing.cache_stats()["per_block"]["train_loop_k2"]
+    assert st["compiles"] == 1 and st["hits"] == 1, st
+
+
+def test_traced_scale_law_matches_host():
+    host = DynamicLossScaler(init_scale=2 ** 8, scale_factor=2.0,
+                             scale_window=3)
+    dev = DynamicLossScaler(init_scale=2 ** 8, scale_factor=2.0,
+                            scale_window=3)
+    ls, unsk = dev.as_carry()
+    for ok in (True, True, True, False, True, True, True, True, False,
+               False):
+        host.update_scale(not ok)
+        ls, unsk = dev.traced_update_scale(jnp.bool_(ok), ls, unsk)
+    dev.sync_from_carry(ls, unsk)
+    assert host.loss_scale == dev.loss_scale
+    assert host._unskipped == dev._unskipped
+
+
+# -- fault sites on K boundaries ---------------------------------------------
+
+def test_fault_sites_fire_once_per_dispatch():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    faults.configure(None)
+    faults.inject("step.kill", at=10 ** 9)      # armed, never fires
+    faults.inject("host.slow", at=10 ** 9)
+    try:
+        step.run_steps(_batches(3, seed=1))
+        step.run_steps(_batches(3, seed=2))
+        assert faults.hits("step.kill") == 2    # once per dispatch,
+        assert faults.hits("host.slow") == 2    # not once per step
+    finally:
+        faults.configure(None)
+
+
+LOOP_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+LOOP_WORKER = _textwrap.dedent("""
+    import sys, os
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import Checkpointer
+    from mxnet_tpu.parallel.data_parallel import FusedTrainStep
+
+    ckdir, k, total, outp = (sys.argv[1], int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"))
+    net.add(mx.gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    step = FusedTrainStep(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+
+    rs = np.random.RandomState(42)
+    bs = [(mx.nd.array(rs.rand(8, 10).astype(np.float32)),
+           mx.nd.array(rs.randint(0, 4, 8).astype(np.float32)))
+          for _ in range(total)]
+
+    ck = Checkpointer(ckdir)
+    meta = ck.restore(net=net, fused_step=step, missing_ok=True)
+    # a restore before the first dispatch is pending until _init_state,
+    # so the data index comes from the manifest, not _step_count
+    start = int(meta["step"]) if meta else 0
+    i = start
+    while i < total:
+        step.run_steps(bs[i:i + k])   # step.kill fires at the dispatch
+        i += min(k, total - i)
+        assert step._step_count == i, (step._step_count, i)
+        ck.save(i, fused_step=step)
+    ck.close()
+    np.savez(outp, **{{n: np.asarray(v) for n, v in step._tr.items()}})
+    print("LOOP_WORKER_DONE", start, i)
+""")
+
+
+def _run_loop_worker(script, args, fault=None, timeout=150):
+    env = dict(_os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_FAULTS", None)
+    if fault:
+        env["MXNET_TPU_FAULTS"] = fault
+    p = _subprocess.Popen(
+        [_sys.executable, "-u", str(script)] + [str(a) for a in args],
+        stdout=_subprocess.PIPE, stderr=_subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+    except _subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("loop worker hung")
+    return p.returncode, out
+
+
+def test_sigkill_resume_on_k_boundary(tmp_path):
+    """SIGKILL the second K=2 dispatch; the restart resumes from the
+    step-2 checkpoint (the last committed K boundary) and lands
+    bit-exact on the uninterrupted run's weights."""
+    script = tmp_path / "loop_worker.py"
+    script.write_text(LOOP_WORKER.format(repo=LOOP_REPO))
+    ref, got = tmp_path / "ref.npz", tmp_path / "got.npz"
+
+    rc, out = _run_loop_worker(script, [tmp_path / "ck_ref", 2, 8, ref])
+    assert rc == 0, out
+    assert "LOOP_WORKER_DONE 0 8" in out
+
+    rc, out = _run_loop_worker(script, [tmp_path / "ck", 2, 8, got],
+                               fault="step.kill:at=2")
+    assert rc == -_signal.SIGKILL, out
+
+    rc, out = _run_loop_worker(script, [tmp_path / "ck", 2, 8, got])
+    assert rc == 0, out
+    assert "LOOP_WORKER_DONE 2 8" in out   # resumed from the K boundary
+
+    r, g = np.load(ref), np.load(got)
+    assert sorted(r.files) == sorted(g.files)
+    for k in r.files:
+        np.testing.assert_array_equal(r[k], g[k], err_msg=k)
+
+
+# -- TrainLoop driver / window_iter ------------------------------------------
+
+def test_window_iter():
+    assert [list(w) for w in window_iter(iter(range(7)), 3)] == \
+        [[0, 1, 2], [3, 4, 5], [6]]
+    assert [list(w) for w in window_iter(iter(range(4)), 4)] == \
+        [[0, 1, 2, 3]]
+    assert list(window_iter(iter([]), 3)) == []
+    with pytest.raises(ValueError):
+        list(window_iter(iter(range(3)), 0))
+
+
+def _loop_data(n, bsz=8, seed=1):
+    rs = np.random.RandomState(seed)
+    return [(mx.nd.array(rs.randn(bsz, 10).astype(np.float32)),
+             mx.nd.array(rs.randint(0, 3, (bsz,)).astype(np.float32)))
+            for _ in range(n)]
+
+
+def test_trainloop_checkpoint_cadence(tmp_path):
+    from mxnet_tpu.checkpoint import Checkpointer, latest_step
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    ck = Checkpointer(str(tmp_path))
+    flushes = []
+    loop = mx.TrainLoop(step, k=4, checkpointer=ck, save_every=4)
+    n = loop.run(_loop_data(11),
+                 on_flush=lambda s, l: flushes.append((s, l.shape)))
+    ck.close()
+    assert n == 11
+    assert flushes == [(4, (4,)), (8, (4,)), (11, (3,))]
+    # saves land on K boundaries at the save_every cadence: 4 and 8
+    assert latest_step(str(tmp_path)) == 8
+    assert not loop.stopped_by_preemption
+
+
+def test_trainloop_max_steps_truncates_window():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    loop = mx.TrainLoop(step, k=4)
+    assert loop.run(_loop_data(11), max_steps=6) == 6
+    assert step._step_count == 6
+
+
+def test_trainloop_rejects_bad_k():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    with pytest.raises(ValueError):
+        mx.TrainLoop(step, k=0)
+
+
+def test_unroll_knob_separate_cache_entry():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    bs = _batches(4, seed=5)
+    step.run_steps(bs)                  # rolled (unroll=1)
+    step.run_steps(bs, unroll=True)     # fully unrolled scan
+    assert len(step._loop_cache) == 2
+    ks = sorted(ckey[-1] for ckey in step._loop_cache)
+    assert ks == [1, 4]
