@@ -13,6 +13,9 @@ the kernel modules at trace time. Sweep space:
 - flash decode: Pallas-vs-reference speedup across cache sizes S;
   the VMEM gate budget is raised only to cover sizes where the
   Pallas kernel actually wins
+- paged decode: in-kernel (scalar-prefetch block table) vs the
+  gather fallback across pool block sizes; winners set the paged
+  VMEM gate and the serving cache's preferred block size
 
 On CPU the kernels run under the Pallas interpreter, so the timings
 validate the harness (and the sweep plumbing) but are NOT advisory for
@@ -337,6 +340,88 @@ def sweep_decode(on_tpu, interpret):
     return {"rows": rows_out}, win
 
 
+def sweep_paged(on_tpu, interpret):
+    """In-kernel paged decode vs the gather fallback across pool
+    block sizes. Winners set the paged kernel's VMEM gate
+    (flash_decode_paged.vmem_budget_bytes — raise only over cell sizes
+    the in-kernel path won at) and the block size serving caches
+    should prefer (preferred_block_size)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kernels import flash_decode as fd
+
+    if on_tpu:
+        B, K, H, d, dtype = 8, 8, 16, 64, jnp.bfloat16
+        S = 2048
+        cands = [16, 32, 64, 128]
+        lo, hi = 4, 12
+    else:
+        B, K, H, d, dtype = 2, 2, 4, 32, jnp.float32
+        S = 128
+        cands = [8, 16]
+        lo, hi = 1, 2
+    scale = 1.0 / (d ** 0.5)
+    rows_out = []
+    best = None          # (ms, block_size, cell_bytes) of the winner
+    for bs in cands:
+        if _remaining() < 25.0:
+            break
+        nb = S // bs
+        N = B * nb + 1   # + scratch block 0
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = (jax.random.normal(kq, (B, H, d)) * 0.1).astype(dtype)
+        kp = (jax.random.normal(kk, (N, K, bs, d)) * 0.1).astype(dtype)
+        vp = (jax.random.normal(kv, (N, K, bs, d)) * 0.1).astype(dtype)
+        bt = jnp.arange(1, N, dtype=jnp.int32).reshape(B, nb)
+        vl = jnp.full((B,), S, jnp.int32)
+        itemsize = jnp.dtype(dtype).itemsize
+        row = {"block_size": bs,
+               "cell_bytes": 4 * bs * d * itemsize}
+
+        def timed_call(fun):
+            f = jax.jit(fun)
+
+            def chain(iters):
+                t0 = time.perf_counter()
+                acc = None
+                for _ in range(iters):
+                    o = f(q, kp, vp, bt, vl)
+                    s = jnp.sum(o.astype(jnp.float32))
+                    acc = s if acc is None else acc + s
+                float(acc)
+                return time.perf_counter() - t0
+
+            chain(1)
+            return _diff_time(chain, lo, hi)
+
+        try:
+            row["inkernel_ms"] = round(timed_call(
+                lambda q_, k_, v_, b_, l_: fd._flash_decode_paged_pallas(
+                    q_, k_, v_, b_, l_, scale, interpret)) * 1e3, 3)
+            # the fallback it replaces: gather to contiguous + the
+            # contiguous flash sweep
+            row["gather_ms"] = round(timed_call(
+                lambda q_, k_, v_, b_, l_: fd.flash_decode(
+                    q_, fd.gather_kv_pages(k_, b_),
+                    fd.gather_kv_pages(v_, b_), l_,
+                    scale=scale)) * 1e3, 3)
+            if row["inkernel_ms"] < row["gather_ms"] \
+                    and (best is None or row["inkernel_ms"] < best[0]):
+                best = (row["inkernel_ms"], bs, row["cell_bytes"])
+        except Exception as e:
+            row["error"] = f"{type(e).__name__}"[:60]
+        rows_out.append(row)
+    win = None
+    if on_tpu and best is not None:
+        # budget covers the winner's double-buffered working set with
+        # one power-of-two of headroom, capped under VMEM
+        win = {"preferred_block_size": best[1],
+               "vmem_budget_bytes": min(max(best[2] * 2, 1 << 20),
+                                        14 << 20)}
+    return {"shape": [B, K, H, d, S], "rows": rows_out}, win
+
+
 def write_tuned(winners, backend, meta):
     from mxnet_tpu.kernels import tuning
 
@@ -363,7 +448,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--write", action="store_true",
                     help="commit winners to mxnet_tpu/kernels/tuned.json")
-    ap.add_argument("--families", default="flash,norm,ce,decode")
+    ap.add_argument("--families", default="flash,norm,ce,decode,paged")
     args = ap.parse_args(argv)
 
     _guard = BudgetGuard("autotune_kernels", "families").install()
@@ -383,7 +468,8 @@ def main(argv=None):
     sweeps = {"flash": ("flash_attention", sweep_flash_attention),
               "norm": ("fused_norm", sweep_norm),
               "ce": ("fused_ce", sweep_ce),
-              "decode": ("flash_decode", sweep_decode)}
+              "decode": ("flash_decode", sweep_decode),
+              "paged": ("flash_decode_paged", sweep_paged)}
     for name in args.families.split(","):
         if name not in sweeps or _remaining() < 25.0:
             continue

@@ -228,21 +228,149 @@ def serve_phase(on_tpu, guard, num_requests=16, arrival_rate=None,
     telemetry.reset()
 
 
+def paged_kernel_phase(on_tpu, guard):
+    """--paged-kernel: decode HBM bytes for the three decode-tick
+    attention variants — contiguous flash-decode (the floor), the
+    gather fallback (pool copy -> contiguous sweep), and the in-kernel
+    paged path (scalar-prefetch block table, blocks DMA'd per grid
+    cell). Floor: in-kernel <= 1.2x contiguous bytes, with the
+    gather's pool-sized copy gone.
+
+    Byte sources: `memory_analysis()` on the compiled executables is
+    reported verbatim for all three. The floor verdict uses those
+    measured numbers when the kernel compiles natively (TPU); on CPU
+    the in-kernel path runs under the Pallas INTERPRETER, whose
+    simulation temps say nothing about the kernel's HBM behavior, so
+    the verdict falls back to the exact analytic traffic model and
+    `bytes_source` says so."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.kernels import flash_decode as fd
+    from mxnet_tpu.serving import InferenceServer
+
+    if on_tpu:
+        B, H, K, d, bs, dtype = 8, 16, 8, 64, 32, jnp.bfloat16
+        S = 2048
+    else:
+        B, H, K, d, bs, dtype = 4, 8, 4, 32, 16, jnp.float32
+        S = 128
+    nb = S // bs
+    N = B * nb + 1                       # + scratch block 0
+    itemsize = jnp.dtype(dtype).itemsize
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, d) * 0.1, dtype)
+    kc = jnp.asarray(rs.randn(B, K, S, d) * 0.1, dtype)
+    vc = jnp.asarray(rs.randn(B, K, S, d) * 0.1, dtype)
+    kp = jnp.asarray(rs.randn(N, K, bs, d) * 0.1, dtype)
+    vp = jnp.asarray(rs.randn(N, K, bs, d) * 0.1, dtype)
+    bt = jnp.arange(1, N, dtype=jnp.int32).reshape(B, nb)
+    vl = jnp.full((B,), S, jnp.int32)
+
+    mode = fd.paged_kernel_mode(kp)
+    if mode is None and not on_tpu:
+        os.environ["MXNET_TPU_FLASH_INTERPRET"] = "1"
+        mode = fd.paged_kernel_mode(kp)
+
+    def mem(f, *args):
+        ma = jax.jit(f).lower(*args).compile().memory_analysis()
+        return {"temp": int(ma.temp_size_in_bytes),
+                "args": int(ma.argument_size_in_bytes),
+                "out": int(ma.output_size_in_bytes)}
+
+    measured = {
+        "contiguous": mem(lambda q_, k_, v_, l_:
+                          fd.flash_decode(q_, k_, v_, l_),
+                          q, kc, vc, vl),
+        "paged_gather": mem(lambda q_, k_, v_, b_, l_:
+                            fd.flash_decode_paged(q_, k_, v_, b_, l_,
+                                                  use_flash=False),
+                            q, kp, vp, bt, vl),
+        "paged_inkernel": mem(lambda q_, k_, v_, b_, l_:
+                              fd.flash_decode_paged(q_, k_, v_, b_, l_),
+                              q, kp, vp, bt, vl),
+    }
+    # exact analytic decode-attention traffic at these shapes: every
+    # path reads q + the B*K*S*d k/v tokens and writes the output; the
+    # gather additionally WRITES the contiguous (B, K, S, d) view and
+    # reads it back in the sweep — the pool-sized round trip the
+    # in-kernel path deletes (paged_gather_bytes counts exactly it)
+    view = 2 * B * K * S * d * itemsize
+    qio = 2 * B * H * d * itemsize
+    gather_extra = fd.paged_gather_bytes(kp.shape, bt.shape, itemsize)
+    analytic = {"contiguous": view + qio,
+                "paged_inkernel": view + qio,
+                "paged_gather": view + qio + 2 * gather_extra}
+
+    native = on_tpu and mode == "compiled"
+    src = {k: (v["temp"] + v["args"] + v["out"])
+           for k, v in measured.items()} if native else analytic
+    ratio = src["paged_inkernel"] / max(src["contiguous"], 1)
+    copy_gone = (src["paged_gather"] - src["paged_inkernel"]) >= view
+    floor_ok = ratio <= 1.2 and copy_gone
+
+    # the serving acceptance rider: the kernel plugs into the server's
+    # persistent decode program with ZERO extra compiles
+    cfg, net = _build_net(on_tpu, serve=True)
+    server = InferenceServer(net, batch_slots=4,
+                             max_len=128 if on_tpu else 64,
+                             block_size=16, max_prompt_len=16)
+    for i in range(6):
+        server.submit(rs.randint(0, cfg.vocab_size, 8 + i).astype(
+            np.int32), max_new_tokens=8)
+    server.run()
+    cs = server.compile_stats()
+
+    guard.best.update({
+        "value": round(ratio, 4),
+        "phase": "paged_kernel",
+        "kernel_mode": mode or "gather-fallback",
+        "bytes_source": "memory_analysis" if native else "analytic",
+        "shape": [B, H, K, d, S, bs],
+        "measured_bytes": measured,
+        "analytic_bytes": analytic,
+        "inkernel_vs_contiguous": round(ratio, 4),
+        "gather_copy_bytes_per_call": int(gather_extra),
+        "gather_copy_gone": bool(copy_gone),
+        "floor_ok": bool(floor_ok),
+        "paged_fallbacks": fd._paged_fallback.count,
+        "serve_decode_compiles": cs["decode_compiles"],
+        "serve_prefill_compiles": cs["prefill_compiles"],
+    })
+    telemetry.enable()
+    for k, v in (("bench_paged_contig_bytes", src["contiguous"]),
+                 ("bench_paged_gather_bytes", src["paged_gather"]),
+                 ("bench_paged_inkernel_bytes", src["paged_inkernel"]),
+                 ("bench_paged_bytes_ratio", ratio)):
+        telemetry.set_gauge(k, float(v), bench="decode_paged")
+    guard.emit()
+    telemetry.disable()
+    telemetry.reset()
+
+
 def main():
     global _guard
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
                     help="continuous-batching serving bench instead of "
                          "the batch decode bench")
+    ap.add_argument("--paged-kernel", action="store_true",
+                    help="decode HBM bytes: in-kernel paged attention "
+                         "vs gather fallback vs contiguous flash-decode")
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--arrival-rate", type=float, default=None,
                     help="Poisson arrival rate, requests/sec")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
-    metric = ("llama_serve_tokens_per_sec" if args.serve
-              else "llama_decode_tokens_per_sec")
-    _guard = guard = BudgetGuard(metric, "tokens/sec").install()
+    if args.paged_kernel:
+        metric, unit = "paged_decode_bytes_ratio", "x"
+    elif args.serve:
+        metric, unit = "llama_serve_tokens_per_sec", "tokens/sec"
+    else:
+        metric, unit = "llama_decode_tokens_per_sec", "tokens/sec"
+    _guard = guard = BudgetGuard(metric, unit).install()
     backend = acquire_backend_once(max_wait=min(120.0,
                                                 guard.budget_s / 3))
     on_tpu = backend not in ("cpu",)
@@ -251,7 +379,9 @@ def main():
     guard.best.update({"backend": backend, "phase": "backend_acquired",
                        "vs_baseline": 0.0})
     guard.emit()
-    if args.serve:
+    if args.paged_kernel:
+        paged_kernel_phase(on_tpu, guard)
+    elif args.serve:
         serve_phase(on_tpu, guard, num_requests=args.requests,
                     arrival_rate=args.arrival_rate, seed=args.seed)
     else:

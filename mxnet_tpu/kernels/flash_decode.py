@@ -29,10 +29,17 @@ __all__ = ["flash_decode", "flash_decode_quantized",
            "quantize_kv", "dequantize_kv",
            "reference_decode_attention",
            "gather_kv_pages", "flash_decode_paged",
-           "flash_decode_paged_quantized"]
+           "flash_decode_paged_quantized",
+           "paged_kernel_mode", "paged_gather_bytes"]
 
 _fallback = KernelFallback("flash-decode",
                            strict_envs=("MXNET_TPU_STRICT_FLASH",))
+
+#: distinct fallback site for the in-kernel paged path, so a paged
+#: regression is visible separately from the contiguous kernel in
+#: telemetry's kernel_fallbacks provider
+_paged_fallback = KernelFallback("flash-decode-paged",
+                                 strict_envs=("MXNET_TPU_STRICT_FLASH",))
 
 
 def __getattr__(name):
@@ -162,12 +169,21 @@ def flash_decode(q, k_cache, v_cache, valid_len, scale=None,
 # -- paged (block-allocated) KV cache ---------------------------------------
 # The serving engine (mxnet_tpu/serving/) stores the cache as a pool of
 # fixed-size blocks shared by all sequences; a per-sequence block table
-# maps logical block index -> physical block id. The decode kernel
-# itself is unchanged: the gather below materializes each sequence's
-# logical (K, S, d) view from its table and the existing flash sweep
-# runs on it. (An in-kernel path that DMAs blocks from HBM by table
-# lookup — no gather materialization — is the TPU follow-up; see
-# ROADMAP.)
+# maps logical block index -> physical block id. Two read paths:
+#
+# - IN-KERNEL (the serving hot path): the block table rides in
+#   scalar-prefetch memory and the Pallas pipeline DMAs each logical
+#   block's k/v straight from the (N, K, bs, d) pool per
+#   (batch, kv-head, block) grid cell — the index map resolves
+#   `bt[b, i]` before the cell runs, so no contiguous (B, K, S, d)
+#   view is ever materialized and decode HBM bytes return to ≈ the
+#   contiguous flash-decode's (vLLM / tpu-inference recipe).
+# - GATHER (fallback): `gather_kv_pages` materializes the contiguous
+#   view with jnp.take, then the contiguous flash sweep runs on it.
+#   Correct everywhere (interpret off, odd shapes, use_flash=False)
+#   but re-creates exactly the pool-sized HBM traffic paging exists
+#   to avoid; every fall-through is counted at the
+#   "flash-decode-paged" site.
 
 def gather_kv_pages(pages, block_tables):
     """Gather per-sequence logical caches from a paged pool.
@@ -183,13 +199,250 @@ def gather_kv_pages(pages, block_tables):
     return g.reshape((B, K, nb * bs) + g.shape[4:])
 
 
+def _paged_grid_spec(pl, pltpu, B, K, nb, rep, bs, d, quantized):
+    """Shared PrefetchScalarGridSpec for both paged kernels: the block
+    table (B, nb) and valid_len (B,) are scalar-prefetched, and the
+    pool specs' index maps resolve `bt[b, i] -> physical block` BEFORE
+    each grid cell runs — Pallas's pipeline emitter turns that into
+    the per-block HBM->VMEM DMA (double-buffered across cells), which
+    is the whole point: no gathered contiguous view exists anywhere."""
+    q_spec = pl.BlockSpec((None, None, rep, d),
+                          lambda b, h, i, bt, vl: (b, h, 0, 0))
+    pool_spec = pl.BlockSpec((None, None, bs, d),
+                             lambda b, h, i, bt, vl: (bt[b, i], h, 0, 0))
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (None, None, bs, 1), lambda b, h, i, bt, vl: (bt[b, i], h,
+                                                          0, 0))
+        in_specs = [q_spec, pool_spec, scale_spec, pool_spec,
+                    scale_spec]
+    else:
+        in_specs = [q_spec, pool_spec, pool_spec]
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, rep, d),
+                               lambda b, h, i, bt, vl: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((rep, 1), jnp.float32),   # m
+                        pltpu.VMEM((rep, 1), jnp.float32),   # l
+                        pltpu.VMEM((rep, d), jnp.float32)])  # acc
+
+
+def _paged_compiler_params(pltpu, interpret):
+    """(batch, kv-head) cells are independent; only the block sweep is
+    order-dependent (the online-softmax carry lives in scratch)."""
+    if interpret:
+        return {}
+    try:
+        return {"compiler_params": pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))}
+    except Exception:           # older/newer param spellings: let the
+        return {}               # compiler default to sequential
+
+
+def _flash_decode_paged_pallas(q, k_pages, v_pages, block_tables,
+                               valid_len, scale, interpret):
+    """In-kernel paged decode: grid (B, K, nb) where cell (b, h, i)
+    owns logical block i of sequence b for kv head h. The online
+    softmax (m, l, acc) carries across the innermost block sweep in
+    VMEM scratch — initialized at i == 0, normalized into o_ref at
+    i == nb - 1 (the same walk as _flash_decode_pallas's fori_loop,
+    unrolled onto the grid so each block can be DMA'd by table
+    lookup). valid_len masks the ragged tail AND every block the
+    table left pointing at the scratch sink 0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, d = q.shape
+    K, bs = k_pages.shape[1], k_pages.shape[2]
+    nb = block_tables.shape[1]
+    rep = H // K
+    qr = q.reshape(B, K, rep, d)
+
+    def kernel(bt_ref, vl_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        i = pl.program_id(2)
+        vl = vl_ref[pl.program_id(0)]
+
+        @pl.when(i == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        @pl.when(i * bs < vl)
+        def _block():
+            qblk = q_ref[...].astype(jnp.float32) * scale    # (rep, d)
+            kblk = k_ref[...].astype(jnp.float32)            # (bs, d)
+            vblk = v_ref[...].astype(jnp.float32)
+            s = qblk @ kblk.T                                # (rep, bs)
+            pos = i * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (rep, bs), 1)
+            s = jnp.where(pos < vl, s, -jnp.inf)
+            m_prev = m_ref[...][:, 0]
+            l_prev = l_ref[...][:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            # comparison instead of jnp.isfinite: Mosaic has no
+            # is_finite lowering (same trick as the contiguous sweep)
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_prev > -jnp.inf,
+                             jnp.exp(m_prev - m_new), 0.0)
+            m_ref[...] = m_new[:, None]
+            l_ref[...] = (corr * l_prev + jnp.sum(p, axis=-1))[:, None]
+            acc_ref[...] = corr[:, None] * acc_ref[...] + p @ vblk
+
+        @pl.when(i == nb - 1)
+        def _finish():
+            l = l_ref[...][:, 0]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[...] = (acc_ref[...] / safe_l[:, None]) \
+                .astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=_paged_grid_spec(pl, pltpu, B, K, nb, rep, bs, d,
+                                   quantized=False),
+        out_shape=jax.ShapeDtypeStruct((B, K, rep, d), q.dtype),
+        interpret=interpret,
+        **_paged_compiler_params(pltpu, interpret),
+    )(block_tables.astype(jnp.int32), valid_len.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return out.reshape(B, H, d)
+
+
+def _flash_decode_paged_pallas_q8(q, k8_pages, ks_pages, v8_pages,
+                                  vs_pages, block_tables, valid_len,
+                                  scale, interpret):
+    """Int8 twin of _flash_decode_paged_pallas: data AND per-token
+    scale blocks are DMA'd by the same table lookup, the int8 block
+    upcasts to fp32 in VMEM, and the scales fold into the score /
+    probability rows exactly like _flash_decode_pallas_q8."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, d = q.shape
+    K, bs = k8_pages.shape[1], k8_pages.shape[2]
+    nb = block_tables.shape[1]
+    rep = H // K
+    qr = q.reshape(B, K, rep, d)
+
+    def kernel(bt_ref, vl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+               o_ref, m_ref, l_ref, acc_ref):
+        i = pl.program_id(2)
+        vl = vl_ref[pl.program_id(0)]
+
+        @pl.when(i == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        @pl.when(i * bs < vl)
+        def _block():
+            qblk = q_ref[...].astype(jnp.float32) * scale    # (rep, d)
+            kblk = k_ref[...].astype(jnp.float32)            # (bs, d)
+            vblk = v_ref[...].astype(jnp.float32)
+            ksb = ks_ref[...][:, 0]                          # (bs,)
+            vsb = vs_ref[...][:, 0]
+            s = (qblk @ kblk.T) * ksb[None, :]               # (rep, bs)
+            pos = i * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (rep, bs), 1)
+            s = jnp.where(pos < vl, s, -jnp.inf)
+            m_prev = m_ref[...][:, 0]
+            l_prev = l_ref[...][:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_prev > -jnp.inf,
+                             jnp.exp(m_prev - m_new), 0.0)
+            ps = p * vsb[None, :]                            # v scale
+            m_ref[...] = m_new[:, None]
+            l_ref[...] = (corr * l_prev + jnp.sum(p, axis=-1))[:, None]
+            acc_ref[...] = corr[:, None] * acc_ref[...] + ps @ vblk
+
+        @pl.when(i == nb - 1)
+        def _finish():
+            l = l_ref[...][:, 0]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[...] = (acc_ref[...] / safe_l[:, None]) \
+                .astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=_paged_grid_spec(pl, pltpu, B, K, nb, rep, bs, d,
+                                   quantized=True),
+        out_shape=jax.ShapeDtypeStruct((B, K, rep, d), q.dtype),
+        interpret=interpret,
+        **_paged_compiler_params(pltpu, interpret),
+    )(block_tables.astype(jnp.int32), valid_len.astype(jnp.int32),
+      qr, k8_pages, ks_pages, v8_pages, vs_pages)
+    return out.reshape(B, H, d)
+
+
+def paged_kernel_mode(pool_operand, quantized=False):
+    """Dispatch gate for the in-kernel paged path — None means "use
+    the gather fallback". Shared by flash_decode_paged(_quantized) at
+    trace time and by the serving layer's host-side probe (the
+    `serving_gather_bytes_avoided_total` accounting must agree with
+    what the executable actually traced).
+
+    Constraints: Mosaic wants the block's sublane dim (block_size) a
+    multiple of 8; the per-cell working set (double-buffered k+v
+    blocks + q + fp32 scratch) must fit the tuned VMEM budget
+    (kernels/tuning.py: flash_decode_paged.vmem_budget_bytes)."""
+    N, K, bs, d = pool_operand.shape
+    if bs % 8 != 0:
+        return None
+    from . import tuning
+
+    per_block = bs * d * pool_operand.dtype.itemsize \
+        + (bs * 4 if quantized else 0)
+    # 2 operands (k, v) x 2 pipeline buffers + q block + scratch
+    cell_bytes = 4 * per_block + 2 * d * 4 + (d + 2) * 4 * 8
+    if cell_bytes > tuning.get("flash_decode_paged",
+                               "vmem_budget_bytes"):
+        return None
+    if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
+        return "interpret"
+    if jax.default_backend() not in ("cpu",):
+        from .dispatch import operand_on_cpu
+
+        return None if operand_on_cpu(pool_operand) else "compiled"
+    return None
+
+
+def paged_gather_bytes(pool_shape, table_shape, itemsize,
+                       quantized=False):
+    """Bytes ONE flash_decode_paged(_quantized) call's gather fallback
+    materializes in HBM (the contiguous (B, K, nb*bs, d) k AND v
+    views, plus fp32 per-token scale views when quantized) — i.e. the
+    per-layer traffic the in-kernel path avoids every decode tick."""
+    N, K, bs, d = pool_shape
+    B, nb = table_shape
+    per = 2 * B * K * nb * bs * d * itemsize
+    if quantized:
+        per += 2 * B * K * nb * bs * 4
+    return per
+
+
 def flash_decode_paged(q, k_pages, v_pages, block_tables, valid_len,
                        scale=None, use_flash=True):
-    """Block-table-aware decode attention: gather the sequences'
-    logical caches from the page pool, then the standard flash sweep.
-    The gathered view is value-identical to a contiguous cache at every
-    position < valid_len, so outputs match the contiguous path
-    exactly."""
+    """Block-table decode attention straight off the page pool: the
+    in-kernel Pallas path when the gate admits it, else gather the
+    contiguous view and run the standard flash sweep. Both paths are
+    value-identical at every position < valid_len."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mode = paged_kernel_mode(k_pages) if use_flash else None
+    if mode is not None:
+        try:
+            return _flash_decode_paged_pallas(
+                q, k_pages, v_pages, block_tables, valid_len, scale,
+                mode == "interpret")
+        except Exception as e:
+            _paged_fallback.note(e)
     k = gather_kv_pages(k_pages, block_tables)
     v = gather_kv_pages(v_pages, block_tables)
     return flash_decode(q, k, v, valid_len, scale=scale,
@@ -199,8 +452,19 @@ def flash_decode_paged(q, k_pages, v_pages, block_tables, valid_len,
 def flash_decode_paged_quantized(q, k8_pages, ks_pages, v8_pages,
                                  vs_pages, block_tables, valid_len,
                                  scale=None, use_flash=True):
-    """Paged variant of flash_decode_quantized: int8 blocks + per-token
-    scale blocks gathered by the same table."""
+    """Paged variant of flash_decode_quantized: int8 data + per-token
+    scale blocks, in-kernel when the gate admits, gathered otherwise."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mode = paged_kernel_mode(k8_pages, quantized=True) if use_flash \
+        else None
+    if mode is not None:
+        try:
+            return _flash_decode_paged_pallas_q8(
+                q, k8_pages, ks_pages, v8_pages, vs_pages,
+                block_tables, valid_len, scale, mode == "interpret")
+        except Exception as e:
+            _paged_fallback.note(e)
     k8 = gather_kv_pages(k8_pages, block_tables)
     ks = gather_kv_pages(ks_pages, block_tables)
     v8 = gather_kv_pages(v8_pages, block_tables)

@@ -32,6 +32,12 @@ DEFAULTS = {
                    "vmem_budget_bytes": 4 << 20},
     "fused_ce": {"row_block_want": 256},
     "flash_decode": {"vmem_cache_budget_bytes": 10 << 20},
+    # in-kernel paged decode: per-grid-cell working set ceiling (the
+    # pipeline double-buffers one (bs, d) k block + one v block per
+    # cell) and the pool block size the serving cache should prefer so
+    # blocks land on Mosaic's (8, 128) tiling
+    "flash_decode_paged": {"vmem_budget_bytes": 8 << 20,
+                           "preferred_block_size": 16},
 }
 
 _cache: Optional[dict] = None

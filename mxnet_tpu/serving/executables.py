@@ -202,11 +202,18 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
                    kv_cache_dtype: str = "model"):
     """Serving executables over a paged pool:
 
-    prefill(params, pages, bt_row, ids, valid_len)
+    prefill(params, pages, bt_row, ids, valid_len, shared_len)
         -> (pages, last_logits):  ONE request (batch 1, right-padded
         to max_prompt_len) through the training-identical layer math,
         k/v written straight into its allocated blocks (padding tokens
-        route to the scratch block).
+        route to the scratch block). Positions below `shared_len` (a
+        traced (1,) int32 — prefix-cache hits share ONE compiled
+        prefill with cold prompts) also sink to scratch: their cache
+        content is already resident in adopted shared blocks.
+
+    copy_block(pages, src, dst) -> pages: device-side block copy for
+        prefix-cache copy-on-write (src/dst traced scalars, so every
+        CoW shares one executable).
 
     decode(params, pages, block_tables, pos, last_logits, keys,
            temps, top_ks, top_ps, active)
@@ -247,13 +254,17 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         return {"k": pg["k"].at[blk_ids, :, offs, :].set(k_rows),
                 "v": pg["v"].at[blk_ids, :, offs, :].set(v_rows)}
 
-    def prefill(params, pages, bt_row, ids, valid_len):
+    def prefill(params, pages, bt_row, ids, valid_len, shared_len):
         B, T = ids.shape                       # B == 1
         x = params["embed"][ids]
         positions = jnp.arange(T)
         t = jnp.arange(T)
-        # padding tokens (t >= valid) sink into scratch block 0
-        blk = jnp.where(t < valid_len[0], bt_row[t // bs], 0)
+        # padding tokens (t >= valid) AND already-cached shared-prefix
+        # tokens (t < shared) sink into scratch block 0; the forward
+        # still runs over the whole prompt (causal attention is
+        # self-contained), only the cache writes are masked
+        blk = jnp.where((t >= shared_len[0]) & (t < valid_len[0]),
+                        bt_row[t // bs], 0)
         offs = t % bs
         new_pages = []
         for lp, pg in zip(params["layers"], pages):
@@ -295,9 +306,17 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         logits = llama_math.final_logits(params, x, cfg.rms_eps)[:, 0]
         return new_pages, tok, logits, keys_next
 
+    def copy_block(pages, src, dst):
+        # dynamic-index gather + scatter: src/dst are traced scalars,
+        # so every copy-on-write rides one executable
+        return [{f: a.at[dst].set(a[src]) for f, a in pg.items()}
+                for pg in pages]
+
     ent = {"prefill": Program("serving_prefill", prefill,
                               donate_argnums=(1,)),
            "decode": Program("serving_decode", decode,
-                             donate_argnums=(1,))}
+                             donate_argnums=(1,)),
+           "copy_block": Program("serving_copy_block", copy_block,
+                                 donate_argnums=(0,))}
     st[key] = ent
     return ent

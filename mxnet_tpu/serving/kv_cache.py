@@ -28,6 +28,15 @@ needs a conditional around its cache writes. It is never allocated.
 Quantized mode ("int8") mirrors the contiguous int8 cache: int8 data
 blocks plus per-token fp32 scale blocks (quantize_kv semantics), so
 paged serving composes with the halved-HBM-traffic decode kernel.
+
+Prefix-cache sharing (prefix_cache=True): prompts that share a prefix
+with resident content — running slots AND finished requests whose
+blocks still sit in the free list — adopt the cached blocks by
+refcount instead of re-writing them. Safe because prefill attention is
+causal (k/v at position t depend only on tokens <= t), so identical
+prefixes produce identical cache content. Writes into a shared block
+go through copy-on-write (prepare_write); the scratch block 0 is never
+registered or shared.
 """
 from __future__ import annotations
 
@@ -54,7 +63,8 @@ class PagedKVCache:
     def __init__(self, *, num_layers: int, num_kv_heads: int,
                  head_dim: int, num_blocks: int, block_size: int,
                  batch_slots: int, max_blocks_per_seq: int,
-                 dtype=jnp.float32, quantized: bool = False):
+                 dtype=jnp.float32, quantized: bool = False,
+                 prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved scratch block)")
@@ -105,6 +115,24 @@ class PagedKVCache:
         self.alloc_count = 0
         self.free_count = 0
 
+        # -- prefix-cache sharing state (refcounts are ALWAYS
+        # maintained so check() can enforce them; the content index
+        # and matching only run when prefix_cache=True) ---------------
+        self.prefix_cache = prefix_cache
+        #: per-block reference count; rc[0] (scratch) stays 0 forever
+        self._refcount = np.zeros(num_blocks, np.int32)
+        #: content index: chain key (parent_key, chunk_tokens) ->
+        #: physical block. A key embeds its whole ancestry, so a hit
+        #: guarantees the ENTIRE prefix up to that block matches, not
+        #: just the block's own tokens.
+        self._chain: dict = {}
+        #: reverse map block -> its chain key (one key per block),
+        #: purged when the block is reallocated or rewritten in place
+        self._block_key: dict = {}
+        self.prefix_hits = 0
+        self.prefix_tokens_shared = 0
+        self.cow_count = 0
+
     # -- accounting ---------------------------------------------------------
 
     @property
@@ -128,7 +156,11 @@ class PagedKVCache:
                 "free_blocks": self.num_free_blocks,
                 "used_blocks": self.num_used_blocks,
                 "utilization": self.num_used_blocks / cap if cap else 0,
-                "allocs": self.alloc_count, "frees": self.free_count}
+                "allocs": self.alloc_count, "frees": self.free_count,
+                "shared_blocks": int((self._refcount > 1).sum()),
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_shared": self.prefix_tokens_shared,
+                "cow_copies": self.cow_count}
 
     def slot_len(self, slot: int) -> int:
         return int(self._slot_len[slot])
@@ -137,6 +169,23 @@ class PagedKVCache:
         return list(self._slot_blocks[slot])
 
     # -- alloc / extend / free ----------------------------------------------
+
+    def _purge(self, blk: int):
+        """Drop the block's content registration (its data is about to
+        be reused or overwritten below the registered length)."""
+        key = self._block_key.pop(blk, None)
+        if key is not None and self._chain.get(key) == blk:
+            del self._chain[key]
+
+    def _pop_free(self) -> int:
+        """Claim a fresh block for private use: registered content (a
+        finished request's cache parked in the free list) is purged
+        here, never earlier — resurrection stays possible until the
+        block is actually reused."""
+        blk = self._free.pop()
+        self._purge(blk)
+        self._refcount[blk] = 1
+        return blk
 
     def alloc(self, slot: int, num_tokens: int) -> bool:
         """Allocate blocks for a fresh sequence of `num_tokens` in
@@ -152,7 +201,7 @@ class PagedKVCache:
                 f"> max_blocks_per_seq={self.max_blocks_per_seq}")
         if len(self._free) < need:
             return False
-        blocks = [self._free.pop() for _ in range(need)]
+        blocks = [self._pop_free() for _ in range(need)]
         self._slot_blocks[slot] = blocks
         self.block_tables[slot, :need] = blocks
         self._slot_len[slot] = num_tokens
@@ -176,7 +225,7 @@ class PagedKVCache:
                              f" * block_size={self.block_size}")
         if not self._free:
             return False
-        blk = self._free.pop()
+        blk = self._pop_free()
         self._slot_blocks[slot].append(blk)
         self.block_tables[slot, held] = blk
         self._slot_len[slot] = pos + 1
@@ -184,25 +233,222 @@ class PagedKVCache:
         return True
 
     def free_slot(self, slot: int):
-        """Return the slot's blocks to the pool and clear its table
-        row (so an evicted slot's reads resolve to the scratch
-        block)."""
+        """Release the slot's block references and clear its table row
+        (so an evicted slot's reads resolve to the scratch block).
+        Shared blocks only return to the pool when the LAST reference
+        drops; registered content parks at the BOTTOM of the LIFO so
+        fresh allocations purge it last (maximizing prefix-cache
+        lifetime)."""
         blocks = self._slot_blocks[slot]
         self.free_count += len(blocks)
-        # LIFO reuse keeps the pool compact under churn
-        self._free.extend(reversed(blocks))
+        for b in reversed(blocks):
+            self._refcount[b] -= 1
+            if self._refcount[b] == 0:
+                if self.prefix_cache and b in self._block_key:
+                    self._free.insert(0, b)
+                else:
+                    # LIFO reuse keeps the pool compact under churn
+                    self._free.append(b)
         self._slot_blocks[slot] = []
         self.block_tables[slot, :] = 0
         self._slot_len[slot] = 0
 
+    # -- prefix-cache sharing -----------------------------------------------
+
+    def match_prefix(self, tokens) -> tuple:
+        """Admit-time longest-common-prefix match of `tokens` against
+        registered resident content (running AND finished-but-not-yet-
+        reused slots). Returns (blocks, shared_len): the physical
+        blocks covering the first shared_len tokens — a chain of
+        full-chunk matches plus at most one tail block where one
+        side's tokens are a prefix of the other's. Never shares on
+        genuine mid-block divergence (that would require overwriting
+        shared content at admit time)."""
+        if not self.prefix_cache or len(tokens) == 0:
+            return [], 0
+        bs = self.block_size
+        toks = tuple(int(t) for t in tokens)
+        blocks: List[int] = []
+        parent = None
+        i = 0
+        limit = min(len(toks), self.max_blocks_per_seq * bs)
+        while i + bs <= limit:
+            key = (parent, toks[i:i + bs])
+            blk = self._chain.get(key)
+            if blk is None:
+                break
+            blocks.append(blk)
+            parent = key
+            i += bs
+        shared_len = i
+        rem = toks[i:limit]
+        if rem:
+            best: Optional[tuple] = None
+            for (pk, chunk), blk in self._chain.items():
+                if pk != parent:
+                    continue
+                n = min(len(rem), len(chunk))
+                if n and chunk[:n] == rem[:n]:
+                    if best is None or n > best[1]:
+                        best = (blk, n)
+            if best is not None:
+                blocks.append(best[0])
+                shared_len += best[1]
+        return blocks, shared_len
+
+    def alloc_shared(self, slot: int, tokens) -> Optional[dict]:
+        """Allocate `slot` for prompt `tokens`, adopting matched
+        prefix blocks (refcount + 1) instead of writing them again.
+        Returns None (nothing allocated) if the pool cannot cover the
+        unshared remainder, else
+            {"shared_len": L, "cow": (src, dst) | None}.
+        `cow` is set when the prompt extends past the shared content
+        mid-block: the caller must device-copy block src -> dst BEFORE
+        the prefill that overwrites positions >= shared_len. When the
+        prompt ENDS inside a shared block (T == shared_len), the block
+        is adopted as-is and the first decode write triggers
+        copy-on-write via prepare_write()."""
+        if self._slot_blocks[slot]:
+            raise ValueError(f"slot {slot} already holds "
+                             f"{len(self._slot_blocks[slot])} blocks")
+        T = len(tokens)
+        need = self.blocks_for(T)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence of {T} tokens needs {need} blocks "
+                f"> max_blocks_per_seq={self.max_blocks_per_seq}")
+        bs = self.block_size
+        shared, shared_len = self.match_prefix(tokens)
+        cow_src = None
+        claim_tail = False
+        if T > shared_len and shared_len % bs != 0:
+            # prompt continues inside the shared tail block: it needs
+            # a private copy up front (unless nobody else holds it —
+            # then claim it outright, content below shared_len intact)
+            tail = shared[-1]
+            if self._refcount[tail] == 0:
+                claim_tail = True  # resurrect privately, no copy
+            else:
+                cow_src = shared.pop()
+        # feasibility BEFORE any mutation: resurrected shared blocks
+        # come out of the free list without consuming "fresh" budget
+        n_resurrect = sum(1 for b in shared if self._refcount[b] == 0)
+        n_fresh = need - len(shared) + (1 if cow_src is not None else 0)
+        if len(self._free) - n_resurrect < n_fresh:
+            return None
+        cow = None
+        blocks: List[int] = []
+        for b in shared:
+            if self._refcount[b] == 0:
+                # resurrect from the free list: content (and its
+                # registration) stays — it is being shared, not reused
+                self._free.remove(b)
+            self._refcount[b] += 1
+            blocks.append(b)
+        if claim_tail:
+            # the tail block becomes private and will be overwritten
+            # past shared_len — its registration is now stale
+            self._purge(blocks[-1])
+        if cow_src is not None:
+            dst = self._pop_free()
+            blocks.append(dst)
+            cow = (cow_src, dst)
+            self.cow_count += 1
+        while len(blocks) < need:
+            blocks.append(self._pop_free())
+        self._slot_blocks[slot] = blocks
+        self.block_tables[slot, :len(blocks)] = blocks
+        self._slot_len[slot] = T
+        self.alloc_count += need
+        if shared_len:
+            self.prefix_hits += 1
+            self.prefix_tokens_shared += shared_len
+        return {"shared_len": shared_len, "cow": cow}
+
+    def prepare_write(self, slot: int, pos: int):
+        """Copy-on-write hook: call before writing token position
+        `pos` into `slot`'s cache. Returns
+          None        — write in place (nothing to do),
+          (src, dst)  — the caller must device-copy block src -> dst
+                        before the write (table already repointed),
+          False       — pool exhausted; preempt something and retry.
+        Also purges a private block's stale registration when the
+        write lands below its registered content length."""
+        idx = pos // self.block_size
+        held = self._slot_blocks[slot]
+        if idx >= len(held):
+            return None  # a fresh block will come from ensure()
+        blk = held[idx]
+        if self._refcount[blk] > 1:
+            if not self._free:
+                return False
+            dst = self._pop_free()
+            self._refcount[blk] -= 1
+            held[idx] = dst
+            self.block_tables[slot, idx] = dst
+            self.cow_count += 1
+            self.alloc_count += 1
+            self.free_count += 1
+            return (blk, dst)
+        key = self._block_key.get(blk)
+        if key is not None \
+                and (pos - idx * self.block_size) < len(key[1]):
+            self._purge(blk)
+        return None
+
+    def register_prefix(self, slot: int, tokens):
+        """Publish `slot`'s prefilled content into the prefix index
+        (call AFTER the prefill that wrote it). Chunks chain onto the
+        canonical path: if identical content is already registered
+        under another block, the existing entry wins and our block
+        stays unregistered (dedup prefers the older copy)."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        toks = tuple(int(t) for t in tokens)
+        parent = None
+        for idx, blk in enumerate(self._slot_blocks[slot]):
+            chunk = toks[idx * bs:(idx + 1) * bs]
+            if not chunk:
+                break
+            key = (parent, chunk)
+            if key not in self._chain and blk not in self._block_key \
+                    and blk != 0:
+                self._chain[key] = blk
+                self._block_key[blk] = key
+            parent = key
+
     def check(self):
-        """Allocator invariants (tests + debugging): no double
-        ownership, scratch never handed out, conservation of blocks."""
+        """Allocator invariants (tests + debugging): refcounts match
+        ownership exactly, scratch never handed out or shared,
+        conservation of blocks, content index consistent."""
         owned = [b for blks in self._slot_blocks for b in blks]
         assert 0 not in owned, "scratch block allocated"
         assert 0 not in self._free, "scratch block in free list"
-        assert len(set(owned)) == len(owned), "double-owned block"
+        counts: dict = {}
+        for b in owned:
+            counts[b] = counts.get(b, 0) + 1
+        for b, c in counts.items():
+            assert int(self._refcount[b]) == c, \
+                f"block {b}: refcount {int(self._refcount[b])} != " \
+                f"{c} owners"
+            assert c == 1 or self.prefix_cache, \
+                f"block {b} shared with prefix_cache disabled"
+        for b in self._free:
+            assert int(self._refcount[b]) == 0, \
+                f"free block {b} has refcount {int(self._refcount[b])}"
+        assert int(self._refcount[0]) == 0, "scratch block refcounted"
+        assert int(self._refcount.sum()) == len(owned), \
+            "refcounts on unreachable blocks"
         assert not (set(owned) & set(self._free)), \
             "block both owned and free"
-        assert len(owned) + len(self._free) == self.num_blocks - 1, \
-            "block leak"
+        assert len(set(owned)) + len(self._free) \
+            == self.num_blocks - 1, "block leak"
+        # content index is a bijection over live blocks
+        for blk, key in self._block_key.items():
+            assert self._chain.get(key) == blk, \
+                f"block {blk} registration out of sync"
+        for key, blk in self._chain.items():
+            assert self._block_key.get(blk) == key, \
+                f"chain entry for block {blk} out of sync"
+            assert blk != 0, "scratch block registered"

@@ -35,6 +35,11 @@ Telemetry (PR-4 registry, enabled via telemetry.enable()):
   serving_preemptions_total   counters
   serving_requests_total{status=...}  labeled terminal outcomes
   serving_watchdog_stalls_total       watchdog trips
+  serving_gather_bytes_avoided_total  counter — HBM bytes the in-kernel
+      paged decode saved vs the gather fallback (0 when the fallback
+      is serving)
+  serving_prefix_hits_total / serving_prefix_tokens_shared_total /
+  serving_cow_copies_total    prefix-cache sharing activity
   per-tick phase spans: serve_admit / serve_decode (chrome trace +
   step_time_breakdown rows)
 
@@ -150,7 +155,8 @@ class InferenceServer:
                  kv_cache_dtype: str = "model",
                  num_blocks: Optional[int] = None,
                  max_preemptions: Optional[int] = 3,
-                 watchdog_ticks: int = 256):
+                 watchdog_ticks: int = 256,
+                 prefix_cache: bool = False):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -161,6 +167,7 @@ class InferenceServer:
         self.block_size = block_size
         self.max_prompt_len = max_prompt_len or min(max_len, 64)
         self.kv_cache_dtype = kv_cache_dtype
+        self.prefix_cache = prefix_cache
         max_blocks = max_len // block_size
         if num_blocks is None:
             num_blocks = batch_slots * max_blocks + 1
@@ -170,11 +177,28 @@ class InferenceServer:
             head_dim=cfg.head_dim, num_blocks=num_blocks,
             block_size=block_size, batch_slots=batch_slots,
             max_blocks_per_seq=max_blocks, dtype=model_dtype,
-            quantized=kv_cache_dtype == "int8")
+            quantized=kv_cache_dtype == "int8",
+            prefix_cache=prefix_cache)
         self.programs = executables.paged_programs(
             net, batch_slots=batch_slots, max_blocks_per_seq=max_blocks,
             block_size=block_size, max_prompt_len=self.max_prompt_len,
             kv_cache_dtype=kv_cache_dtype)
+
+        # host-side probe of the decode kernel's dispatch: traced code
+        # cannot bump counters, so the per-tick HBM bytes the in-kernel
+        # paged path avoids (vs the gather fallback's contiguous view)
+        # are computed here and counted after each decode tick. The
+        # probe is shape/env/backend-deterministic, so it matches the
+        # decision flash_decode_paged makes at trace time.
+        from ..kernels.flash_decode import (paged_kernel_mode,
+                                            paged_gather_bytes)
+        q8 = kv_cache_dtype == "int8"
+        pool_k = self.cache.pages[0]["k"]
+        self._kernel_paged = paged_kernel_mode(pool_k,
+                                               quantized=q8) is not None
+        self._gather_bytes_per_tick = cfg.num_layers * paged_gather_bytes(
+            pool_k.shape, (batch_slots, max_blocks),
+            pool_k.dtype.itemsize, quantized=q8)
 
         from ..models.llama_infer import _params_tree
         self._params = _params_tree(net)
@@ -273,15 +297,35 @@ class InferenceServer:
         return [i for i in range(self.batch_slots)
                 if not self._active[i]]
 
-    def _admit_one(self, slot: int, req: Request):
+    def _copy_block(self, src: int, dst: int):
+        """Device-side CoW copy through the persistent executable."""
+        self.cache.pages = self.programs["copy_block"](
+            self.cache.pages, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+        telemetry.inc("serving_cow_copies_total")
+
+    def _admit_one(self, slot: int, req: Request,
+                   shared_len: int = 0, cow=None):
         T = len(req.prompt)
         ids = np.zeros((1, self.max_prompt_len), np.int32)
         ids[0, :T] = req.prompt
+        if cow is not None:
+            # the prompt extends into a shared block mid-block: give
+            # the slot a private copy BEFORE prefill overwrites the
+            # positions past shared_len
+            self._copy_block(*cow)
         bt_row = jnp.asarray(self.cache.block_tables[slot])
         with telemetry.phase("serve_prefill"):
             self.cache.pages, last = self.programs["prefill"](
                 self._params, self.cache.pages, bt_row,
-                jnp.asarray(ids), jnp.asarray([T], jnp.int32))
+                jnp.asarray(ids), jnp.asarray([T], jnp.int32),
+                jnp.asarray([shared_len], jnp.int32))
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, req.prompt)
+            if shared_len:
+                telemetry.inc("serving_prefix_hits_total")
+                telemetry.inc("serving_prefix_tokens_shared_total",
+                              shared_len)
         self._last_logits = self._last_logits.at[slot].set(
             last[0].astype(self._last_logits.dtype))
         self._keys = self._keys.at[slot].set(
@@ -303,12 +347,24 @@ class InferenceServer:
             req = self.queue[0]
             # the prompt's blocks now; the first decode block comes
             # lazily via ensure()
-            if not self.cache.can_alloc(len(req.prompt)):
-                break
-            self.queue.popleft()
-            slot = free.pop(0)
-            self.cache.alloc(slot, len(req.prompt))
-            self._admit_one(slot, req)
+            if self.prefix_cache:
+                # alloc_shared is its own feasibility check: a prefix
+                # hit can admit where a cold can_alloc would refuse
+                plan = self.cache.alloc_shared(free[0], req.prompt)
+                if plan is None:
+                    break
+                self.queue.popleft()
+                slot = free.pop(0)
+                self._admit_one(slot, req,
+                                shared_len=plan["shared_len"],
+                                cow=plan["cow"])
+            else:
+                if not self.cache.can_alloc(len(req.prompt)):
+                    break
+                self.queue.popleft()
+                slot = free.pop(0)
+                self.cache.alloc(slot, len(req.prompt))
+                self._admit_one(slot, req)
             admitted += 1
         return admitted
 
@@ -353,6 +409,20 @@ class InferenceServer:
                     raise RuntimeError(
                         "KV pool too small for a single sequence — "
                         "raise num_blocks or lower max_len")
+            # copy-on-write: this tick's token lands in a block some
+            # other slot still references
+            while True:
+                pw = self.cache.prepare_write(slot,
+                                              int(self._pos[slot]))
+                if pw is False:
+                    if not self._preempt_youngest(slot):
+                        raise RuntimeError(
+                            "KV pool too small for a single sequence "
+                            "— raise num_blocks or lower max_len")
+                    continue    # retry: the preemption freed blocks
+                if pw is not None:
+                    self._copy_block(*pw)
+                break
 
     def _evict(self, slot: int):
         self.cache.free_slot(slot)
@@ -457,6 +527,11 @@ class InferenceServer:
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
         telemetry.inc("serving_tokens_total", net_new)
+        if self._kernel_paged:
+            # the in-kernel paged path served this tick: credit the
+            # HBM bytes the gather fallback would have materialized
+            telemetry.inc("serving_gather_bytes_avoided_total",
+                          self._gather_bytes_per_tick)
         telemetry.observe("serving_tick_seconds", now - t_tick)
         self._note_progress(admitted + emitted, done0)
         self._update_gauges()
@@ -556,8 +631,10 @@ class InferenceServer:
 
     def compile_stats(self) -> dict:
         p, d = self.programs["prefill"], self.programs["decode"]
+        c = self.programs["copy_block"]
         return {"prefill_compiles": p.compiles, "prefill_calls": p.calls,
-                "decode_compiles": d.compiles, "decode_calls": d.calls}
+                "decode_compiles": d.compiles, "decode_calls": d.calls,
+                "copy_compiles": c.compiles, "copy_calls": c.calls}
 
     def stats(self) -> dict:
         by_status = {s: 0 for s in (_OK, _TIMED_OUT, _PREEMPTED,

@@ -742,3 +742,277 @@ def test_labeled_status_counters(net):
     finally:
         telemetry.disable()
         telemetry.reset()
+
+
+# -- prefix cache -----------------------------------------------------------
+
+def _pcache(**kw):
+    return _cache(prefix_cache=True, **kw)
+
+
+def test_prefix_full_share_refcounts_and_stats():
+    c = _pcache()
+    p = list(range(8))                       # exactly 2 full blocks
+    plan = c.alloc_shared(0, p)
+    assert plan == {"shared_len": 0, "cow": None}   # cold: miss
+    c.register_prefix(0, p)
+    plan = c.alloc_shared(1, p)
+    assert plan["shared_len"] == 8 and plan["cow"] is None
+    assert c.slot_blocks(1) == c.slot_blocks(0)     # zero new blocks
+    st = c.stats()
+    assert st["shared_blocks"] == 2
+    assert st["prefix_hits"] == 1 and st["prefix_tokens_shared"] == 8
+    c.check()
+    # blocks only return to the pool when the LAST reference drops
+    c.free_slot(0)
+    assert c.num_free_blocks == 6
+    c.free_slot(1)
+    assert c.num_free_blocks == 8
+    c.check()
+
+
+def test_prefix_tail_share_then_decode_cow():
+    c = _pcache()
+    p = [5, 6, 7, 8, 9, 1]                   # 1 full block + 2-token tail
+    c.alloc_shared(0, p)
+    c.register_prefix(0, p)
+    plan = c.alloc_shared(1, p)              # identical prompt
+    assert plan["shared_len"] == 6 and plan["cow"] is None
+    tail = c.slot_blocks(0)[1]
+    assert c.slot_blocks(1)[1] == tail
+    # slot 1's first decode write lands in the shared tail -> CoW
+    pw = c.prepare_write(1, 6)
+    assert isinstance(pw, tuple)
+    src, dst = pw
+    assert src == tail and dst == c.slot_blocks(1)[1] and dst != tail
+    assert c.block_tables[1, 1] == dst       # table already repointed
+    # slot 0 is sole owner again: its write goes in place
+    assert c.prepare_write(0, 6) is None
+    assert c.stats()["cow_copies"] == 1
+    c.check()
+
+
+def test_prefix_cow_at_admit_mid_block_extension():
+    c = _pcache()
+    c.alloc_shared(0, [1, 2, 3])             # partial single block
+    c.register_prefix(0, [1, 2, 3])
+    # the new prompt extends past the shared content INSIDE the block:
+    # prefill would overwrite it, so the copy happens at admit time
+    plan = c.alloc_shared(1, [1, 2, 3, 4, 5])
+    assert plan["shared_len"] == 3 and plan["cow"] is not None
+    src, dst = plan["cow"]
+    assert src == c.slot_blocks(0)[0]
+    assert dst == c.slot_blocks(1)[0]
+    assert src not in c.slot_blocks(1)       # private copy, not shared
+    assert c.stats()["cow_copies"] == 1
+    c.check()
+
+
+def test_prefix_never_shares_on_mid_block_divergence():
+    c = _pcache()
+    c.alloc_shared(0, [1, 2, 3, 4])
+    c.register_prefix(0, [1, 2, 3, 4])
+    blocks, L = c.match_prefix([1, 2, 9, 9])  # diverges inside block
+    assert L == 0 and blocks == []
+    plan = c.alloc_shared(1, [1, 2, 9, 9])
+    assert plan["shared_len"] == 0
+    assert not (set(c.slot_blocks(1)) & set(c.slot_blocks(0)))
+    c.check()
+
+
+def test_prefix_shorter_prompt_shares_tail():
+    c = _pcache()
+    p = [1, 2, 3, 4, 5, 6, 7, 8]
+    c.alloc_shared(0, p)
+    c.register_prefix(0, p)
+    blocks, L = c.match_prefix([1, 2, 3, 4, 5, 6])
+    assert L == 6 and len(blocks) == 2       # full block + partial tail
+    plan = c.alloc_shared(1, [1, 2, 3, 4, 5, 6])
+    # prompt ENDS inside the shared block: adopt as-is, CoW deferred to
+    # the first decode write via prepare_write
+    assert plan["shared_len"] == 6 and plan["cow"] is None
+    assert c.slot_blocks(1) == c.slot_blocks(0)
+    c.check()
+
+
+def test_prefix_freed_content_resurrected_then_purged_on_reuse():
+    c = _pcache()
+    p = list(range(8))
+    c.alloc_shared(0, p)
+    c.register_prefix(0, p)
+    blocks = c.slot_blocks(0)
+    c.free_slot(0)
+    assert c.num_free_blocks == 8            # fully freed...
+    plan = c.alloc_shared(1, p)              # ...but content survives
+    assert plan["shared_len"] == 8
+    assert c.slot_blocks(1) == blocks        # resurrected, not rewritten
+    c.check()
+    # once a freed registered block is REUSED its registration purges
+    c.free_slot(1)
+    assert c.alloc(2, 16) and c.alloc(1, 16)  # drain all 8 blocks
+    assert c.match_prefix(p)[1] == 0
+    c.check()
+
+
+def test_prefix_prepare_write_exhaustion_then_sole_owner():
+    c = _pcache(num_blocks=5)                # 4 usable
+    p = list(range(6))
+    c.alloc_shared(0, p)
+    c.register_prefix(0, p)
+    assert c.alloc_shared(1, p)["shared_len"] == 6
+    assert c.num_free_blocks == 2
+    assert c.ensure(0, 8) and c.ensure(0, 12)  # slot 0 drains the pool
+    # CoW for slot 1's tail write has no destination: caller must
+    # preempt something and retry (the scheduler's contract)
+    assert c.prepare_write(1, 6) is False
+    c.free_slot(0)
+    # the sharer died with the pool: slot 1 is now sole owner, so the
+    # retry needs no copy at all
+    assert c.prepare_write(1, 6) is None
+    c.check()
+
+
+def test_prefix_refcount_no_leak_after_churn():
+    c = _pcache(num_blocks=17, batch_slots=4, max_blocks_per_seq=4)
+    rs = np.random.RandomState(3)
+    prompts = [list(rs.randint(0, 5, int(rs.randint(3, 14))))
+               for _ in range(6)]             # tiny vocab -> collisions
+    held = {}
+    for _ in range(80):
+        slot = int(rs.randint(4))
+        if slot in held:
+            c.free_slot(slot)
+            del held[slot]
+        else:
+            p = prompts[int(rs.randint(6))]
+            if c.alloc_shared(slot, p) is not None:
+                c.register_prefix(slot, p)
+                held[slot] = p
+        c.check()
+    assert c.stats()["prefix_hits"] > 0
+    for s in list(held):
+        c.free_slot(s)
+    assert c.num_free_blocks == 16
+    assert int(c._refcount.sum()) == 0        # no leaked references
+    c.check()
+
+
+def test_server_prefix_cache_token_parity(net):
+    """Prefix sharing must be invisible in the tokens: identical,
+    extended, shorter, and cold prompts produce exactly the same
+    outputs with the prefix cache on and off."""
+    rs = np.random.RandomState(23)
+    base = rs.randint(0, 256, 10).astype(np.int32)
+    ext = np.concatenate([base, rs.randint(0, 256, 2).astype(np.int32)])
+    prompts = [base, base.copy(), ext, base[:6].copy(),
+               rs.randint(0, 256, 7).astype(np.int32)]
+    outs = {}
+    for pc in (False, True):
+        server = InferenceServer(net, batch_slots=5, max_len=64,
+                                 block_size=8, max_prompt_len=12,
+                                 prefix_cache=pc)
+        reqs = [server.submit(p, max_new_tokens=6) for p in prompts]
+        server.run()
+        outs[pc] = [list(r.output_tokens) for r in reqs]
+        if pc:
+            st = server.cache.stats()
+            # identical (10) + extension (10) + shorter (6) all hit
+            assert st["prefix_hits"] == 3
+            assert st["prefix_tokens_shared"] == 26
+            assert st["cow_copies"] >= 1      # ext forks mid-block
+        cs = server.compile_stats()
+        assert cs["prefill_compiles"] == 1 and cs["decode_compiles"] == 1
+        assert server.cache.num_used_blocks == 0
+        server.cache.check()
+    assert outs[True] == outs[False]
+
+
+def test_server_prefix_16_requests_one_compile_each(net):
+    """The acceptance workload with the prefix cache ON: half the
+    requests are prefixes of one base prompt; tokens stay identical to
+    one-shot generate() and it is still exactly one prefill + one
+    decode compile (plus at most one for the CoW block copy)."""
+    rs = np.random.RandomState(24)
+    server = InferenceServer(net, batch_slots=4, max_len=64,
+                             block_size=8, max_prompt_len=12,
+                             prefix_cache=True)
+    base = rs.randint(0, 256, 12).astype(np.int32)
+    reqs = []
+    for i in range(16):
+        T = int(rs.randint(3, 13))
+        p = base[:T].copy() if i % 2 == 0 \
+            else rs.randint(0, 256, T).astype(np.int32)
+        new = int(rs.randint(2, 9))
+        reqs.append((p, new, server.submit(p, max_new_tokens=new)))
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] == 1, cs
+    assert cs["decode_compiles"] == 1, cs
+    assert cs["copy_compiles"] <= 1, cs
+    assert server.cache.stats()["prefix_hits"] >= 1
+    for p, new, r in reqs:
+        assert r.state == "finished"
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), one[0, len(p):],
+            err_msg=f"request {r.id} diverged with prefix cache on")
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+# -- in-kernel paged decode in the server -----------------------------------
+
+def test_server_gather_bytes_avoided_telemetry(net, monkeypatch):
+    """With the in-kernel paged path active the server credits the
+    per-tick gather traffic it no longer pays; with the kernel gated
+    off the counter must stay silent."""
+    from mxnet_tpu.kernels.flash_decode import paged_gather_bytes
+
+    monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8)
+        assert server._kernel_paged            # bs=8 passes the gate
+        pool = server.cache.pages[0]["k"]
+        expect = 2 * paged_gather_bytes(       # llama_tiny: 2 layers
+            pool.shape, tuple(server.cache.block_tables.shape),
+            pool.dtype.itemsize)
+        assert server._gather_bytes_per_tick == expect
+        server.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=3)
+        server.run()
+        got = telemetry.snapshot()["counters"][
+            "serving_gather_bytes_avoided_total"]
+        assert got > 0 and got % expect == 0
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_server_block4_stays_on_gather_path(net):
+    # block_size=4 fails the Mosaic sublane gate: same tokens, no
+    # gather-bytes credit, and the paged fallback counter stays flat
+    # (the gather path is the DESIGNED fallback, not an error)
+    from mxnet_tpu.kernels import flash_decode as fd
+
+    before = fd._paged_fallback.count
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(25)
+        p = rs.randint(0, 256, 6).astype(np.int32)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=4, max_prompt_len=8)
+        assert not server._kernel_paged
+        r = server.submit(p, max_new_tokens=4)
+        server.run()
+        one = generate(net, p[None, :], max_new_tokens=4, max_len=32)
+        np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                      one[0, 6:])
+        counters = telemetry.snapshot()["counters"]
+        assert "serving_gather_bytes_avoided_total" not in counters
+        assert fd._paged_fallback.count == before
+    finally:
+        telemetry.disable()
+        telemetry.reset()
