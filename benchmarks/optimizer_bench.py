@@ -364,6 +364,10 @@ def main_zero(stage):
 _TM_HOT = ("phase", "mark_phase", "step_done", "inc", "set_gauge",
            "observe")
 
+#: the flight recorder's hot helpers — B-side no-ops these too, so the
+#: measured A/B gap covers flight recording compiled in but disabled
+_FL_HOT = ("record", "dump")
+
 
 class _NullCtx:
     def __enter__(self):
@@ -392,11 +396,12 @@ def main_telemetry_overhead():
     jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import flight, telemetry
     from mxnet_tpu.parallel.data_parallel import FusedTrainStep
 
     telemetry.disable()
     telemetry.reset()
+    flight.disable()
 
     batch = int(os.environ.get("BENCH_TM_BATCH", "64"))
     hidden = int(os.environ.get("BENCH_TM_HIDDEN", "256"))
@@ -428,6 +433,7 @@ def main_telemetry_overhead():
         return (time.perf_counter() - t0) / reps * 1e3
 
     saved = {n: getattr(telemetry, n) for n in _TM_HOT}
+    saved_fl = {n: getattr(flight, n) for n in _FL_HOT}
     null = _NullCtx()
     noops = {
         "phase": lambda name, device=False: null,
@@ -437,19 +443,25 @@ def main_telemetry_overhead():
         "set_gauge": lambda *a, **k: None,
         "observe": lambda *a, **k: None,
     }
+    fl_noops = {"record": lambda *a, **k: None,
+                "dump": lambda *a, **k: None}
 
     a_ms, b_ms = [], []
     for _ in range(rounds):
         if a_ms and guard.remaining() < 15.0:
             break
-        a_ms.append(timed())  # A: shipped disabled path
+        a_ms.append(timed())  # A: shipped disabled path (tm + flight)
         for name, fn in noops.items():
             setattr(telemetry, name, fn)
+        for name, fn in fl_noops.items():
+            setattr(flight, name, fn)
         try:
             b_ms.append(timed())  # B: helpers are true no-ops
         finally:
             for name, fn in saved.items():
                 setattr(telemetry, name, fn)
+            for name, fn in saved_fl.items():
+                setattr(flight, name, fn)
 
     ratio = min(a_ms) / min(b_ms)
     guard.best.update({

@@ -42,6 +42,7 @@ from . import checkpoint
 from . import train_loop
 from .train_loop import TrainLoop
 from . import faults
+from . import flight
 from . import monitor
 from . import profiler
 from . import telemetry

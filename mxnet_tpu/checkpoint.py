@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from . import faults as _ft
+from . import flight as _fl
 from . import random as _random
 from . import telemetry as _tm
 
@@ -322,6 +323,9 @@ class Checkpointer:
         self._mngr.save(int(step), args=ocp.args.Composite(
             state=ocp.args.StandardSave(arrays),
             meta=ocp.args.JsonSave(meta)))
+        if _fl._ENABLED:
+            _fl.record("checkpoint", "save", step=int(step),
+                       synchronous=not self._async or bool(force_sync))
         if self._async and not force_sync:
             self._pending_manifest[int(step)] = (leaves, trunc)
         else:
@@ -372,7 +376,11 @@ class Checkpointer:
                     f"checkpoint step {s} in {self.directory!r} failed "
                     "manifest verification; falling back to the next "
                     "older verified step")
-                _tm.inc("checkpoint_fallbacks_total")
+                if _tm._ENABLED:
+                    _tm.inc("checkpoint_fallbacks_total")
+                if _fl._ENABLED:
+                    _fl.record("checkpoint", "fallback", step=int(s),
+                               why="manifest")
                 continue
             try:
                 restored = self._mngr.restore(
@@ -388,12 +396,18 @@ class Checkpointer:
                     f"restoring checkpoint step {s} from "
                     f"{self.directory!r} raised; falling back to the "
                     "next older step")
-                _tm.inc("checkpoint_fallbacks_total")
+                if _tm._ENABLED:
+                    _tm.inc("checkpoint_fallbacks_total")
+                if _fl._ENABLED:
+                    _fl.record("checkpoint", "fallback", step=int(s),
+                               why="restore_raised")
         if restored is None:
             raise RuntimeError(
                 f"no restorable checkpoint in {self.directory!r}: all "
                 f"steps {steps[::-1]} failed verification or restore")
         arrays, meta = restored["state"], restored["meta"]
+        if _fl._ENABLED:
+            _fl.record("checkpoint", "restore", step=int(step))
         if "rng_key" in arrays:
             _random._st().key = jnp.asarray(arrays["rng_key"]).astype(
                 jnp.uint32)
@@ -525,6 +539,12 @@ class PreemptionHandler:
     def _handler(self, signum, frame):
         self.preempted = True
         self.signum = signum
+        if _fl._ENABLED:
+            # the dump happens here, not at finalize: a second signal
+            # (the hard kill) can land before the drain completes, and
+            # the ring on disk is the only record of where it caught us
+            _fl.record("preemption", "sigterm", signum=int(signum))
+            _fl.dump(reason="preemption")
 
     def install(self) -> "PreemptionHandler":
         for s in self._signals:

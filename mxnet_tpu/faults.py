@@ -69,6 +69,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import flight as _fl
 from . import telemetry as _tm
 
 __all__ = ["SITES", "FaultInjected", "FaultTimeout",
@@ -226,7 +227,15 @@ def fire(site: str) -> Optional[dict]:
         if sp is None or not sp.should_fire():
             return None
         _tm.inc("faults_injected_total", site=site)
-        return dict(sp.opts)
+        payload = dict(sp.opts)
+        n_fires = sp.fires
+    if _fl._ENABLED:
+        # the injected fault IS the post-mortem headline: record it,
+        # then dump so the ring survives whatever the fault does next
+        # (SIGKILL, raise, poison) — the dump's final event is the fire
+        _fl.record("fault", site, fire=n_fires, **payload)
+        _fl.dump(reason=f"fault.{site}")
+    return payload
 
 
 # -- site behaviors (called from the instrumented lines) --------------------

@@ -1,0 +1,169 @@
+"""Flight recorder: a bounded in-memory ring of structured events that
+explains *why a run died* (the black box the fault-tolerance substrate
+was missing).
+
+The telemetry registry answers "how fast, right now"; this module keeps
+the last N discrete *decisions and transitions* — phase marks, kvstore
+collective entry/exit with byte counts, fault injections, serving
+scheduler admit/preempt/evict, checkpoint save/restore/fallback,
+gradient-sanitizer skips, compile events — as `(t_monotonic, kind,
+site, payload)` tuples in a fixed-capacity deque. When something goes
+wrong the runtime dumps the ring as JSONL so the post-mortem starts
+from the event sequence instead of from a stack trace alone.
+
+Auto-dump triggers wired across the stack (each records the triggering
+event LAST, then dumps, so the tail of the file is the cause):
+
+- the serving watchdog declaring :class:`ServerStalledError`
+- :class:`GradSanitizer` aborting on the consecutive-skip cap (eager
+  and fused-loop paths)
+- :class:`PreemptionHandler` receiving SIGTERM
+- any armed fault site firing (``mxnet_tpu.faults``)
+- an uncaught exception escaping ``TrainLoop.run`` or
+  ``InferenceServer.run``
+
+Cost contract: identical to telemetry — the whole layer is off by
+default and every instrumented call site guards on the module-level
+``_ENABLED`` flag (one attribute load + branch), so the disabled path
+never builds a payload dict or touches the ring
+(``tests/test_telemetry_lint.py`` enforces the gate pattern;
+``benchmarks/optimizer_bench.py --telemetry-overhead`` measures it).
+
+Env: ``MXNET_TPU_FLIGHT=1`` enables at import, ``MXNET_TPU_FLIGHT_DIR``
+picks the dump directory (default: cwd), ``MXNET_TPU_FLIGHT_EVENTS``
+sets the ring capacity (default 4096).
+
+This module deliberately imports nothing from the package so every
+other module (telemetry included) can import it without cycles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+__all__ = ["enable", "disable", "enabled", "record", "events", "clear",
+           "dump", "set_capacity", "capacity", "last_dump_path",
+           "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 4096
+
+#: THE flag. Instrumented call sites guard with `if flight._ENABLED:`
+#: (one module-attribute load + branch) so the disabled path records
+#: nothing and allocates nothing.
+_ENABLED = os.environ.get("MXNET_TPU_FLIGHT", "0") == "1"
+
+_lock = threading.RLock()
+
+
+def _env_capacity() -> int:
+    try:
+        return max(16, int(os.environ.get("MXNET_TPU_FLIGHT_EVENTS",
+                                          DEFAULT_CAPACITY)))
+    except (TypeError, ValueError):
+        return DEFAULT_CAPACITY
+
+
+_EVENTS: deque = deque(maxlen=_env_capacity())
+
+#: path of the most recent dump (None until the first one) — tests and
+#: post-mortem tooling read this instead of globbing the dump dir
+last_dump_path: Optional[str] = None
+
+_DUMP_SEQ = 0
+
+
+def enable(capacity: Optional[int] = None):
+    """Turn the flight recorder on (optionally resizing the ring)."""
+    global _ENABLED
+    if capacity is not None:
+        set_capacity(capacity)
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def capacity() -> int:
+    return _EVENTS.maxlen
+
+
+def set_capacity(capacity: int):
+    """Resize the ring (keeps the newest events that still fit)."""
+    global _EVENTS
+    cap = max(16, int(capacity))
+    with _lock:
+        _EVENTS = deque(_EVENTS, maxlen=cap)
+
+
+def record(kind: str, site: str, **payload):
+    """Append one `(t_monotonic, kind, site, payload)` event. Callers
+    on hot paths must guard with `if flight._ENABLED:` — this re-check
+    only protects direct callers."""
+    if not _ENABLED:
+        return
+    _EVENTS.append((time.monotonic(), kind, site, payload or None))
+
+
+def events() -> List[Tuple[float, str, str, Optional[dict]]]:
+    """Snapshot of the ring, oldest first."""
+    with _lock:
+        return list(_EVENTS)
+
+
+def clear():
+    with _lock:
+        _EVENTS.clear()
+
+
+def dump(reason: str = "manual", path: Optional[str] = None) -> Optional[str]:
+    """Write the ring as JSONL: one header line (reason, pid, clock
+    anchors, capacity) then one line per event, oldest first — the
+    FINAL lines are the newest events, i.e. the trigger of whatever
+    prompted the dump. Returns the path (None while disabled).
+
+    Default location: ``MXNET_TPU_FLIGHT_DIR`` (or cwd) with a
+    per-reason filename, so repeated fires of the same trigger
+    overwrite one file instead of flooding the directory."""
+    global last_dump_path, _DUMP_SEQ
+    if not _ENABLED:
+        return None
+    with _lock:
+        evs = list(_EVENTS)
+        _DUMP_SEQ += 1
+        seq = _DUMP_SEQ
+    if path is None:
+        d = os.environ.get("MXNET_TPU_FLIGHT_DIR") or os.getcwd()
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            d = os.getcwd()
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason) or "manual"
+        path = os.path.join(d, f"flight-{safe}-p{os.getpid()}.jsonl")
+    header = {"flight": 1, "reason": reason, "pid": os.getpid(),
+              "seq": seq, "events": len(evs),
+              "capacity": _EVENTS.maxlen,
+              "t_monotonic": time.monotonic(),
+              "time_unix": time.time()}
+    try:
+        with open(path, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for t, kind, site, payload in evs:
+                line = {"t": t, "kind": kind, "site": site}
+                if payload:
+                    line["payload"] = payload
+                f.write(json.dumps(line, default=str) + "\n")
+    except OSError:
+        return None
+    last_dump_path = path
+    return path

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import faults as _ft
+from .. import flight as _fl
 from .. import multi_tensor as _mt
 from .. import optimizer as opt
 from .. import telemetry as _tm
@@ -111,8 +112,20 @@ class GradSanitizer:
             # the reference DynamicLossScaler skip path
             scaler.update_scale(True)
             trainer._scale = 1.0 / scaler.loss_scale
-        _tm.inc("steps_skipped_nonfinite_total")
+        if _tm._ENABLED:
+            _tm.inc("steps_skipped_nonfinite_total")
+        if _fl._ENABLED:
+            _fl.record("sanitizer_skip", "trainer.step",
+                       consecutive=self.consecutive_skips,
+                       total=self.total_skips,
+                       step=self.last_skip_step)
         if self.consecutive_skips > self.max_consecutive_skips:
+            if _fl._ENABLED:
+                _fl.record("abort", "grad_sanitizer",
+                           consecutive=self.consecutive_skips,
+                           max=self.max_consecutive_skips,
+                           step=self.last_skip_step)
+                _fl.dump(reason="sanitizer_abort")
             raise FloatingPointError(
                 f"gradients non-finite for {self.consecutive_skips} "
                 f"consecutive steps (> max_consecutive_skips="

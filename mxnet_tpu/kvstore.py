@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import faults as _ft
+from . import flight as _fl
 from . import telemetry as _tm
 from .ndarray import NDArray
 from .sparse import RowSparseNDArray
@@ -211,6 +212,22 @@ class KVStore:
                               out[i] if out is not None else None, priority)
             return
         self._count_bytes("reduced", value)
+        if _fl._ENABLED:
+            import time as _time
+            t0 = _time.monotonic()
+            _fl.record("collective", "kvstore.pushpull",
+                       key=str(key), store=self.type,
+                       bytes=int(self._nbytes(value)))
+            try:
+                self._pushpull_one(key, value, out, priority)
+            finally:
+                _fl.record("collective_done", "kvstore.pushpull",
+                           key=str(key),
+                           dur_s=_time.monotonic() - t0)
+            return
+        self._pushpull_one(key, value, out, priority)
+
+    def _pushpull_one(self, key, value, out, priority):
         agg = self._aggregate(value, key)
         if self._optimizer is not None:
             # agg is already aggregated+compressed: applying it via

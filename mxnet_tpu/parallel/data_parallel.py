@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
 from .. import faults as _ft
+from .. import flight as _fl
 from .. import random as _random
 from .. import telemetry as _tm
 from ..ndarray import NDArray
@@ -1811,12 +1812,21 @@ class FusedTrainStep:
         if skip_on:
             self._loop_streak = int(carry_out["streak"])
             nskip = int(jnp.sum(skips))
-            if nskip:
+            if nskip and _tm._ENABLED:
                 _tm.inc("steps_skipped_nonfinite_total", nskip)
+            if nskip and _fl._ENABLED:
+                _fl.record("sanitizer_skip", "run_steps",
+                           skipped=nskip, streak=self._loop_streak,
+                           step=self._step_count)
             if sanitizer is not None:
                 sanitizer.consecutive_skips = self._loop_streak
                 cap = sanitizer.max_consecutive_skips
                 if self._loop_streak > cap:
+                    if _fl._ENABLED:
+                        _fl.record("abort", "grad_sanitizer",
+                                   consecutive=self._loop_streak,
+                                   max=cap, step=self._step_count)
+                        _fl.dump(reason="sanitizer_abort")
                     raise FloatingPointError(
                         f"gradients nonfinite for {self._loop_streak} "
                         f"consecutive steps (> max_consecutive_skips="

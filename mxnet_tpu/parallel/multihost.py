@@ -30,6 +30,7 @@ __all__ = [
     "initialize", "is_initialized", "is_primary", "process_index",
     "process_count", "local_devices", "hybrid_device_mesh",
     "sync_global_devices", "broadcast_from_primary",
+    "kv_set", "kv_get", "client_barrier",
 ]
 
 _initialized = False
@@ -145,3 +146,67 @@ def broadcast_from_primary(tree):
         return tree
     from jax.experimental import multihost_utils
     return multihost_utils.broadcast_one_to_all(tree)
+
+
+# -- coordination-service side channel --------------------------------------
+#
+# The jax.distributed coordination service carries a string KV store
+# and a host-level barrier that involve NO device collective — safe to
+# use from arbitrary host threads (the /metrics scrape thread, signal
+# handlers' aftermath) and under the gloo CPU backend. telemetry's
+# cross-process aggregation and checkpoint's orbax CPU patch both ride
+# this channel.
+
+def _client():
+    """The coordination-service client, or None when this process never
+    joined a multi-process job."""
+    if not _initialized:
+        return None
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def kv_set(key: str, value: str) -> bool:
+    """Publish `key` -> `value` in the coordination-service KV store
+    (last write wins; older jaxlib without overwrite support falls back
+    to delete-then-set). False when there is no service to publish to."""
+    c = _client()
+    if c is None:
+        return False
+    try:
+        c.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:  # jaxlib without allow_overwrite
+        try:
+            c.key_value_delete(key)
+        except Exception:
+            pass
+        c.key_value_set(key, value)
+    return True
+
+
+def kv_get(key: str, timeout_ms: int = 2000) -> Optional[str]:
+    """Read `key` from the KV store, waiting up to `timeout_ms` for it
+    to appear. None on timeout or when no service is up."""
+    c = _client()
+    if c is None:
+        return None
+    try:
+        return c.blocking_key_value_get(key, int(timeout_ms))
+    except Exception:
+        return None
+
+
+def client_barrier(name: str, timeout_ms: int = 60_000):
+    """Host-level barrier through the coordination service — unlike
+    :func:`sync_global_devices` this never launches a device collective,
+    so it is gloo-safe and usable while a computation is in flight on
+    another thread. No-op (True) single-process; True once every
+    process arrived; raises on timeout."""
+    c = _client()
+    if c is None:
+        return True
+    c.wait_at_barrier(name, int(timeout_ms))
+    return True

@@ -54,7 +54,7 @@ cancelled with status ``rejected``).
 from __future__ import annotations
 
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import List, Optional
 
 import numpy as np
@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import faults as _ft
+from .. import flight as _fl
 from .. import telemetry
 from ..ndarray import NDArray
 from .kv_cache import PagedKVCache
@@ -117,6 +118,41 @@ class Request:
         self.t_first_token: Optional[float] = None
         self.t_finish: Optional[float] = None
         self.preemptions = 0
+        # per-request span timeline (tracing): discrete transitions in
+        # `_trace`, decode ticks merged into contiguous windows (one
+        # window per admit, so a preemption splits them). None = the
+        # server is not tracing this request.
+        self.t_admit: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        self.prefix_tokens_shared = 0
+        self.cow_copies = 0
+        self._trace: Optional[List[dict]] = None
+        self._decode_windows: Optional[List[dict]] = None
+        self._trace_seq = 0
+
+    def _tev(self, name: str, t: Optional[float] = None, **kw):
+        """Append one timeline event (no-op when tracing is off)."""
+        if self._trace is not None:
+            ev = {"name": name,
+                  "t": time.perf_counter() if t is None else t}
+            ev.update(kw)
+            self._trace.append(ev)
+
+    def _open_decode_window(self):
+        if self._decode_windows is not None:
+            self._decode_windows.append({"t0": None, "t1": None, "n": 0})
+
+    def _note_decode(self, now: float):
+        self.t_last_token = now
+        if self._decode_windows is None:
+            return
+        if not self._decode_windows:
+            self._decode_windows.append({"t0": None, "t1": None, "n": 0})
+        w = self._decode_windows[-1]
+        if w["t0"] is None:
+            w["t0"] = now
+        w["t1"] = now
+        w["n"] += 1
 
     @property
     def ttft(self) -> Optional[float]:
@@ -156,7 +192,10 @@ class InferenceServer:
                  num_blocks: Optional[int] = None,
                  max_preemptions: Optional[int] = 3,
                  watchdog_ticks: int = 256,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 trace_sample_every: int = 1,
+                 trace_slow_s: Optional[float] = None,
+                 trace_capacity: int = 256):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -232,8 +271,26 @@ class InferenceServer:
         self.max_preemptions = max_preemptions
         self.watchdog_ticks = int(watchdog_ticks)
         self._stall_ticks = 0
+        self._stalled = False
         self._draining = False
         self._shutdown = False
+        # per-request tracing: collect a span timeline for every
+        # request while `trace_sample_every > 0` (or a slow-outlier
+        # threshold is set); at finish, RETAIN the assembled trace only
+        # for every `trace_sample_every`-th submission plus any request
+        # whose latency/TTFT exceeds `trace_slow_s` — the retained
+        # store is an LRU bounded by `trace_capacity`, so tracing can
+        # stay on in production without growing memory
+        self._trace_every = max(0, int(trace_sample_every))
+        self._trace_slow_s = trace_slow_s
+        self._trace_capacity = max(1, int(trace_capacity))
+        self._trace_on = self._trace_every > 0 or trace_slow_s is not None
+        self._traces: "OrderedDict[int, dict]" = OrderedDict()
+        self._submit_seq = 0
+        # /healthz flips to 503 during stall/drain/shutdown; chrome
+        # traces gain the request-span pid (both weakref-held)
+        telemetry.register_health_source(self)
+        telemetry.register_request_trace_source(self)
         # opt-in /metrics endpoint (MXNET_TPU_METRICS_PORT): no-op
         # unless the env var is set
         telemetry.maybe_start_metrics_server()
@@ -256,7 +313,8 @@ class InferenceServer:
         (queue wait included); past it the request finishes with
         status ``timed_out``."""
         if self._shutdown or self._draining:
-            telemetry.inc("serving_requests_total", status=_REJECTED)
+            if telemetry._ENABLED:
+                telemetry.inc("serving_requests_total", status=_REJECTED)
             raise RuntimeError(
                 "InferenceServer is "
                 + ("shut down" if self._shutdown else "draining")
@@ -287,8 +345,15 @@ class InferenceServer:
                 f"{capacity} — raise num_blocks or shrink the request")
         req = Request(prompt, max_new_tokens, temperature, top_k,
                       top_p, eos_id, seed, deadline_s=deadline_s)
+        req._trace_seq = self._submit_seq
+        self._submit_seq += 1
+        if self._trace_on:
+            req._trace = []
+            req._decode_windows = []
+            req._tev("queued", t=req.t_submit)
         self.queue.append(req)
-        telemetry.inc("serving_requests_total")
+        if telemetry._ENABLED:
+            telemetry.inc("serving_requests_total")
         return req
 
     # -- scheduler ----------------------------------------------------------
@@ -297,12 +362,17 @@ class InferenceServer:
         return [i for i in range(self.batch_slots)
                 if not self._active[i]]
 
-    def _copy_block(self, src: int, dst: int):
+    def _copy_block(self, src: int, dst: int,
+                    req: Optional[Request] = None):
         """Device-side CoW copy through the persistent executable."""
         self.cache.pages = self.programs["copy_block"](
             self.cache.pages, jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32))
-        telemetry.inc("serving_cow_copies_total")
+        if telemetry._ENABLED:
+            telemetry.inc("serving_cow_copies_total")
+        if req is not None:
+            req.cow_copies += 1
+            req._tev("cow", src=src, dst=dst)
 
     def _admit_one(self, slot: int, req: Request,
                    shared_len: int = 0, cow=None):
@@ -313,19 +383,31 @@ class InferenceServer:
             # the prompt extends into a shared block mid-block: give
             # the slot a private copy BEFORE prefill overwrites the
             # positions past shared_len
-            self._copy_block(*cow)
+            self._copy_block(*cow, req=req)
+        req.t_admit = time.perf_counter()
+        req._tev("admit", t=req.t_admit, slot=slot,
+                 shared_len=shared_len)
+        if _fl._ENABLED:
+            _fl.record("sched", "serving.admit", request=req.id,
+                       slot=slot, prompt=T, shared_len=shared_len)
         bt_row = jnp.asarray(self.cache.block_tables[slot])
+        t_pf = time.perf_counter()
         with telemetry.phase("serve_prefill"):
             self.cache.pages, last = self.programs["prefill"](
                 self._params, self.cache.pages, bt_row,
                 jnp.asarray(ids), jnp.asarray([T], jnp.int32),
                 jnp.asarray([shared_len], jnp.int32))
+        req._tev("prefill", t=t_pf,
+                 dur_s=time.perf_counter() - t_pf, tokens=T)
+        req._open_decode_window()
         if self.prefix_cache:
             self.cache.register_prefix(slot, req.prompt)
             if shared_len:
-                telemetry.inc("serving_prefix_hits_total")
-                telemetry.inc("serving_prefix_tokens_shared_total",
-                              shared_len)
+                req.prefix_tokens_shared += shared_len
+                if telemetry._ENABLED:
+                    telemetry.inc("serving_prefix_hits_total")
+                    telemetry.inc("serving_prefix_tokens_shared_total",
+                                  shared_len)
         self._last_logits = self._last_logits.at[slot].set(
             last[0].astype(self._last_logits.dtype))
         self._keys = self._keys.at[slot].set(
@@ -379,7 +461,12 @@ class InferenceServer:
         victim = max(running, key=lambda i: self._slot_admit[i])
         req = self._slot_req[victim]
         req.preemptions += 1
-        telemetry.inc("serving_preemptions_total")
+        req._tev("preempt", slot=victim, n=req.preemptions)
+        if telemetry._ENABLED:
+            telemetry.inc("serving_preemptions_total")
+        if _fl._ENABLED:
+            _fl.record("sched", "serving.preempt", request=req.id,
+                       slot=victim, n=req.preemptions)
         if self.max_preemptions is not None \
                 and req.preemptions > self.max_preemptions:
             # retry budget exhausted: fail the request terminally
@@ -421,10 +508,14 @@ class InferenceServer:
                             "— raise num_blocks or lower max_len")
                     continue    # retry: the preemption freed blocks
                 if pw is not None:
-                    self._copy_block(*pw)
+                    self._copy_block(*pw, req=self._slot_req[slot])
                 break
 
     def _evict(self, slot: int):
+        if _fl._ENABLED:
+            req = self._slot_req[slot]
+            _fl.record("sched", "serving.evict", slot=slot,
+                       request=None if req is None else req.id)
         self.cache.free_slot(slot)
         self._active[slot] = False
         self._pos[slot] = 0
@@ -445,9 +536,35 @@ class InferenceServer:
         req.finish_reason = reason
         req.status = status
         req.t_finish = time.perf_counter()
+        req._tev("finish", t=req.t_finish, reason=reason, status=status)
         self.finished.append(req)
-        telemetry.inc("serving_requests_finished")
-        telemetry.inc("serving_requests_total", status=status)
+        if telemetry._ENABLED:
+            telemetry.inc("serving_requests_finished")
+            telemetry.inc("serving_requests_total", status=status)
+        if _fl._ENABLED:
+            _fl.record("sched", "serving.finish", request=req.id,
+                       reason=reason, status=status)
+        self._retain_trace(req)
+
+    def _retain_trace(self, req: Request):
+        """Apply the sampling knob at the terminal transition: keep the
+        assembled trace for sampled / slow requests, drop the raw
+        timeline either way so finished requests stay O(1)."""
+        if req._trace is None:
+            return
+        keep = self._trace_every > 0 \
+            and req._trace_seq % self._trace_every == 0
+        if not keep and self._trace_slow_s is not None:
+            lat = (req.t_finish or 0.0) - req.t_submit
+            ttft = req.ttft
+            keep = lat > self._trace_slow_s or \
+                (ttft is not None and ttft > self._trace_slow_s)
+        if keep:
+            self._traces[req.id] = self._assemble_trace(req)
+            while len(self._traces) > self._trace_capacity:
+                self._traces.popitem(last=False)
+        req._trace = None
+        req._decode_windows = None
 
     def _expire_deadlines(self):
         """Fail every request (queued or running) past its deadline
@@ -515,9 +632,13 @@ class InferenceServer:
             if len(req.output_tokens) > req.tokens_counted:
                 req.tokens_counted = len(req.output_tokens)
                 net_new += 1
+            if self._trace_on:
+                req._note_decode(now)
+            else:
+                req.t_last_token = now
             if req.t_first_token is None:
                 req.t_first_token = now
-                if req.ttft is not None:
+                if telemetry._ENABLED and req.ttft is not None:
                     telemetry.observe("serving_ttft_seconds", req.ttft)
             if req.eos_id >= 0 and t == req.eos_id:
                 self._finish(slot, "eos")
@@ -526,13 +647,14 @@ class InferenceServer:
         self.ticks += 1
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
-        telemetry.inc("serving_tokens_total", net_new)
-        if self._kernel_paged:
-            # the in-kernel paged path served this tick: credit the
-            # HBM bytes the gather fallback would have materialized
-            telemetry.inc("serving_gather_bytes_avoided_total",
-                          self._gather_bytes_per_tick)
-        telemetry.observe("serving_tick_seconds", now - t_tick)
+        if telemetry._ENABLED:
+            telemetry.inc("serving_tokens_total", net_new)
+            if self._kernel_paged:
+                # the in-kernel paged path served this tick: credit the
+                # HBM bytes the gather fallback would have materialized
+                telemetry.inc("serving_gather_bytes_avoided_total",
+                              self._gather_bytes_per_tick)
+            telemetry.observe("serving_tick_seconds", now - t_tick)
         self._note_progress(admitted + emitted, done0)
         self._update_gauges()
         return emitted
@@ -546,11 +668,21 @@ class InferenceServer:
         progress += len(self.finished) - done_before
         if progress > 0 or not (self.queue or self._active.any()):
             self._stall_ticks = 0
+            self._stalled = False
             return
         self._stall_ticks += 1
         if self._stall_ticks >= self.watchdog_ticks:
             stalled, self._stall_ticks = self._stall_ticks, 0
-            telemetry.inc("serving_watchdog_stalls_total")
+            self._stalled = True
+            if telemetry._ENABLED:
+                telemetry.inc("serving_watchdog_stalls_total")
+            if _fl._ENABLED:
+                # record the stall as the ring's final event, THEN dump:
+                # the tail of the JSONL is the cause of death
+                _fl.record("stall", "serving.watchdog", ticks=stalled,
+                           queued=len(self.queue),
+                           active=int(self._active.sum()))
+                _fl.dump(reason="serving_stall")
             raise ServerStalledError(
                 f"serving watchdog: {stalled} consecutive ticks without "
                 f"progress ({len(self.queue)} queued, "
@@ -579,11 +711,20 @@ class InferenceServer:
         the requests finished during this call's ticks."""
         done_before = len(self.finished)
         ticks = 0
-        while self.queue or self._active.any():
-            self.step()
-            ticks += 1
-            if max_ticks is not None and ticks >= max_ticks:
-                break
+        try:
+            while self.queue or self._active.any():
+                self.step()
+                ticks += 1
+                if max_ticks is not None and ticks >= max_ticks:
+                    break
+        except ServerStalledError:
+            raise   # flight ring already dumped at the stall site
+        except BaseException as e:
+            if _fl._ENABLED:
+                _fl.record("exception", "serving.run",
+                           error=repr(e)[:200], tick=self.ticks)
+                _fl.dump(reason="serving_exception")
+            raise
         return self.finished[done_before:]
 
     # -- graceful teardown --------------------------------------------------
@@ -629,6 +770,84 @@ class InferenceServer:
 
     # -- introspection ------------------------------------------------------
 
+    def health(self):
+        """(ok, reason) for the /healthz probe (telemetry registers
+        this at construction): 503-worthy while the watchdog has
+        declared a stall, a drain has stopped admission, or the server
+        is shut down."""
+        if self._stalled:
+            return False, ("stalled: watchdog declared the decode path "
+                           "wedged — restart the server")
+        if self._shutdown:
+            return False, "shutdown: server no longer accepts work"
+        if self._draining:
+            return False, "draining: admission stopped"
+        return True, "ok"
+
+    def _assemble_trace(self, req: Request) -> dict:
+        """The span timeline + derived latency breakdown for one traced
+        request (the per-request view serving comparisons report)."""
+        events = list(req._trace or [])
+        windows = req._decode_windows or []
+        dec_s = 0.0
+        gaps = 0
+        for w in windows:
+            if w["t0"] is None:
+                continue
+            events.append({"name": "decode", "t": w["t0"],
+                           "dur_s": w["t1"] - w["t0"], "tokens": w["n"]})
+            dec_s += w["t1"] - w["t0"]
+            gaps += max(0, w["n"] - 1)
+        events.sort(key=lambda e: e["t"])
+        queue_wait = None if req.t_admit is None \
+            else req.t_admit - req.t_submit
+        if queue_wait is not None:
+            for ev in events:
+                if ev["name"] == "queued":
+                    ev["dur_s"] = queue_wait
+                    break
+        # TPOT from within-window time only, so preemption gaps and
+        # requeue waits don't inflate the per-token decode latency
+        tpot = dec_s / gaps if gaps > 0 else None
+        latency = None if req.t_finish is None \
+            else req.t_finish - req.t_submit
+        return {"request_id": req.id, "state": req.state,
+                "status": req.status, "finish_reason": req.finish_reason,
+                "events": events,
+                "queue_wait_s": queue_wait, "ttft_s": req.ttft,
+                "tpot_s": tpot, "latency_s": latency,
+                "decode_tokens": len(req.output_tokens),
+                "preemptions": req.preemptions,
+                "prefix_tokens_shared": req.prefix_tokens_shared,
+                "cow_copies": req.cow_copies}
+
+    def trace(self, request_id: int) -> Optional[dict]:
+        """The retained (or still-live) span timeline of one request:
+        events (queued/admit/prefill/decode windows/preempt/cow/finish,
+        perf_counter timestamps, `dur_s` on timed spans) plus derived
+        queue_wait_s / ttft_s / tpot_s / latency_s / preemptions /
+        prefix_tokens_shared / cow_copies. None when the request was
+        never traced or its trace was sampled out."""
+        stored = self._traces.get(request_id)
+        if stored is not None:
+            return stored
+        for req in list(self.queue) + [r for r in self._slot_req
+                                       if r is not None]:
+            if req.id == request_id and req._trace is not None:
+                return self._assemble_trace(req)
+        return None
+
+    def request_traces(self) -> List[dict]:
+        """Every retained trace plus the live (running/queued) ones —
+        the source `telemetry.export_chrome_trace` merges under its
+        request-span pid."""
+        out = list(self._traces.values())
+        for req in [r for r in self._slot_req if r is not None] \
+                + list(self.queue):
+            if req._trace is not None:
+                out.append(self._assemble_trace(req))
+        return out
+
     def compile_stats(self) -> dict:
         p, d = self.programs["prefill"], self.programs["decode"]
         c = self.programs["copy_block"]
@@ -641,7 +860,16 @@ class InferenceServer:
                                     _REJECTED)}
         for r in self.finished:
             by_status[r.status or _OK] += 1
+        # queue AGE (not just depth): p50/p95 of how long the queued
+        # requests have been waiting — a router can tell a deep-but-
+        # moving queue from a stuck one
+        now = time.perf_counter()
+        ages = [now - r.t_submit for r in self.queue]
+        age_p50 = float(np.percentile(ages, 50)) if ages else 0.0
+        age_p95 = float(np.percentile(ages, 95)) if ages else 0.0
         return {"ticks": self.ticks,
+                "queue_age_p50_s": age_p50,
+                "queue_age_p95_s": age_p95,
                 "tokens_generated": self.tokens_generated,
                 "queued": len(self.queue),
                 "active": int(self._active.sum()),

@@ -43,10 +43,14 @@ import contextlib
 import json
 import math
 import os
+import statistics
 import threading
 import time
+import weakref
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
+
+from . import flight as _flight
 
 __all__ = ["enable", "disable", "enabled", "reset",
            "Counter", "Gauge", "Histogram",
@@ -57,7 +61,13 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "export_chrome_trace", "note_device_trace",
            "start_metrics_server", "stop_metrics_server",
            "maybe_start_metrics_server",
-           "STEP_PHASES", "SERVE_PHASES"]
+           "register_health_source", "unregister_health_source", "health",
+           "register_request_trace_source",
+           "publish_snapshot", "aggregate_snapshot",
+           "to_prometheus_merged",
+           "publish_step_time", "step_times", "step_time_skew",
+           "stragglers",
+           "STEP_PHASES", "SERVE_PHASES", "REQUEST_PID"]
 
 #: THE flag. Instrumented call sites across the stack guard with
 #: `if telemetry._ENABLED:` (one module-attribute load + branch) so the
@@ -93,9 +103,21 @@ _DEVICE_TRACE_DIRS: List[str] = []
 _SPEED_WINDOW: deque = deque(maxlen=64)
 
 #: chrome pid layout: host phases / profiler scopes on pid 0, device
-#: spans (sync-measured or parsed jax traces) on pid >= 1
+#: spans (sync-measured or parsed jax traces) on pid >= 1; serving
+#: per-request span timelines get their own far-away pid so they can
+#: never collide with parsed device traces
 HOST_PID = 0
 DEVICE_PID = 1
+REQUEST_PID = 9000
+
+#: weakrefs to objects exposing `health() -> (ok, reason)`; consulted
+#: by the /healthz endpoint (InferenceServer registers itself so a
+#: watchdog stall or drain flips the probe to 503)
+_HEALTH_SOURCES: List[weakref.ref] = []
+
+#: weakrefs to objects exposing `request_traces() -> [trace dict]`;
+#: export_chrome_trace merges their span timelines under REQUEST_PID
+_REQUEST_TRACE_SOURCES: List[weakref.ref] = []
 
 
 def enable():
@@ -325,6 +347,8 @@ def mark_phase(name: str, seconds: float, t0: Optional[float] = None,
     if not _ENABLED:
         return
     histogram("step_time_breakdown").labels(phase=name).observe(seconds)
+    if _flight._ENABLED:
+        _flight.record("phase", name, dur_s=seconds)
     start = t0 if t0 is not None else time.perf_counter() - seconds
     _TRACE_EVENTS.append({
         "name": name, "ph": "X", "ts": start * 1e6,
@@ -457,9 +481,13 @@ def to_prometheus() -> str:
     `_bucket{le=...}` cumulative series). Empty string while disabled."""
     if not _ENABLED:
         return ""
+    return _prometheus_text(_REGISTRY)
+
+
+def _prometheus_text(registry: "OrderedDict[str, _Family]") -> str:
     lines: List[str] = []
     with _lock:
-        for fam in _REGISTRY.values():
+        for fam in registry.values():
             if fam.help:
                 lines.append(f"# HELP {fam.name} {fam.help}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
@@ -486,6 +514,307 @@ def to_prometheus() -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# -- cross-process aggregation ----------------------------------------------
+#
+# Each process publishes a JSON serialization of its registry into the
+# jax.distributed coordination-service KV store (the same gloo-safe
+# side channel multihost/checkpoint already use — no device collective
+# involved, so it works mid-training and from the serving thread). The
+# primary pulls the last-published blob of every other process and
+# merges: counters by sum, histograms bucket-wise, gauges one child per
+# process under a `proc` label. A single-process run aggregates to its
+# own registry (gauges gain `proc=0`), so tooling can use one code
+# path.
+
+_KV_PREFIX = "mxtpu/tm"
+
+
+def _proc_info() -> Tuple[int, int]:
+    """(process_index, process_count) without ever triggering backend
+    init: (0, 1) unless multihost.initialize has run."""
+    try:
+        from .parallel import multihost as _mh
+        if _mh.is_initialized():
+            import jax
+            return jax.process_index(), jax.process_count()
+    except Exception:
+        pass
+    return 0, 1
+
+
+def _registry_state() -> dict:
+    """JSON-able serialization of the full registry: name ->
+    {"k": kind, "h": help, "c": [[label_pairs, state], ...]} with
+    counter/gauge state = value and histogram state = its bucket map
+    plus exact count/sum/min/max/zeros."""
+    out: dict = {}
+    with _lock:
+        for fam in _REGISTRY.values():
+            ch = []
+            for key, c in fam.children.items():
+                if fam.kind in ("counter", "gauge"):
+                    state = c.value
+                else:
+                    state = {"b": {str(e): n for e, n in c.buckets.items()},
+                             "c": c.count, "s": c.sum,
+                             "mn": c.min if math.isfinite(c.min) else None,
+                             "mx": c.max if math.isfinite(c.max) else None,
+                             "z": c.zeros}
+                ch.append([[list(kv) for kv in key], state])
+            out[fam.name] = {"k": fam.kind, "h": fam.help, "c": ch}
+    return out
+
+
+def publish_snapshot() -> bool:
+    """Publish this process's registry to the coordination-service KV
+    store so `aggregate_snapshot` on any process (in practice: the
+    primary's /metrics) can merge it. No-op (False) while telemetry is
+    disabled or in a single-process job. TrainLoop calls this at every
+    K-window boundary."""
+    if not _ENABLED:
+        return False
+    pid, n = _proc_info()
+    if n <= 1:
+        return False
+    from .parallel import multihost as _mh
+    return _mh.kv_set(f"{_KV_PREFIX}/reg/{pid}",
+                      json.dumps(_registry_state()))
+
+
+def _merge_registry(blobs: Dict[int, dict]) -> "OrderedDict[str, _Family]":
+    """Merge per-process registry states into fresh (registry-detached)
+    families: counters sum, histograms merge bucket-wise (exact
+    count/sum/min/max/zeros), gauges keep one child per process under a
+    `proc` label."""
+    merged: "OrderedDict[str, _Family]" = OrderedDict()
+    for pid in sorted(blobs):
+        for name, st in blobs[pid].items():
+            kind = st.get("k", "counter")
+            cls = {"counter": Counter, "gauge": Gauge,
+                   "histogram": Histogram}.get(kind, Counter)
+            fam = merged.get(name)
+            if fam is None or fam.kind != kind:
+                if fam is not None:
+                    continue  # kind clash across processes: first wins
+                fam = _Family(name, kind, cls, st.get("h", ""))
+                merged[name] = fam
+            for pairs, state in st.get("c", []):
+                labels = {str(k): str(v) for k, v in pairs}
+                if kind == "gauge":
+                    labels["proc"] = str(pid)
+                ch = fam.labels(**labels)
+                if kind == "counter":
+                    ch.inc(float(state))
+                elif kind == "gauge":
+                    ch.set(float(state))
+                else:
+                    for e, cnt in state.get("b", {}).items():
+                        e = int(e)
+                        ch.buckets[e] = ch.buckets.get(e, 0) + int(cnt)
+                    ch.count += int(state.get("c", 0))
+                    ch.sum += float(state.get("s", 0.0))
+                    mn, mx = state.get("mn"), state.get("mx")
+                    if mn is not None and float(mn) < ch.min:
+                        ch.min = float(mn)
+                    if mx is not None and float(mx) > ch.max:
+                        ch.max = float(mx)
+                    ch.zeros += int(state.get("z", 0))
+    return merged
+
+
+def _gather_states(timeout_ms: int) -> Dict[int, dict]:
+    """This process's live registry plus every other process's
+    last-published blob (processes that never published are skipped —
+    aggregation is best-effort by design: the scrape must not block on
+    a replica that is mid-dispatch)."""
+    pid, n = _proc_info()
+    blobs: Dict[int, dict] = {pid: _registry_state()}
+    if n > 1:
+        from .parallel import multihost as _mh
+        for p in range(n):
+            if p == pid:
+                continue
+            blob = _mh.kv_get(f"{_KV_PREFIX}/reg/{p}",
+                              timeout_ms=timeout_ms)
+            if blob:
+                try:
+                    blobs[p] = json.loads(blob)
+                except (ValueError, TypeError):
+                    pass
+    return blobs
+
+
+def aggregate_snapshot(timeout_ms: int = 2000) -> dict:
+    """The cross-process `snapshot()`: merge this process's registry
+    with every published peer registry (counters summed, histograms
+    merged bucket-wise, gauges labeled `proc=<i>`). Keys mirror
+    `snapshot()` plus `processes` (the indices that contributed).
+    Single-process: own registry with `proc=0` gauges. Empty while
+    disabled."""
+    if not _ENABLED:
+        return {}
+    blobs = _gather_states(timeout_ms)
+    merged = _merge_registry(blobs)
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                 "processes": sorted(blobs)}
+    for fam in merged.values():
+        for key, ch in fam.children.items():
+            label = fam.name + _label_suffix(key)
+            if fam.kind == "counter":
+                out["counters"][label] = ch.value
+            elif fam.kind == "gauge":
+                out["gauges"][label] = ch.value
+            else:
+                out["histograms"][label] = ch.stats()
+    return out
+
+
+def to_prometheus_merged(timeout_ms: int = 2000) -> str:
+    """Prometheus exposition of the merged cross-process registry (the
+    body the primary's /metrics serves). Empty string while
+    disabled."""
+    if not _ENABLED:
+        return ""
+    return _prometheus_text(_merge_registry(_gather_states(timeout_ms)))
+
+
+# -- straggler detection ----------------------------------------------------
+
+def publish_step_time(seconds: float):
+    """Record this process's per-step wall time (the `step_time_seconds`
+    gauge) and publish it to the KV store; on the primary, refresh the
+    `step_time_skew_ratio` gauge (max/median across processes — the
+    first-order pod-scale diagnostic). TrainLoop calls this with
+    window_seconds / K at every K-window boundary."""
+    if not _ENABLED:
+        return
+    set_gauge("step_time_seconds", seconds)
+    pid, n = _proc_info()
+    if n > 1:
+        from .parallel import multihost as _mh
+        _mh.kv_set(f"{_KV_PREFIX}/steptime/{pid}", repr(float(seconds)))
+        if pid == 0:
+            step_time_skew()
+
+
+def step_times(timeout_ms: int = 1000) -> Dict[int, float]:
+    """Last-published per-process step time, keyed by process index
+    (own value read live; peers that never published are skipped)."""
+    if not _ENABLED:
+        return {}
+    pid, n = _proc_info()
+    times: Dict[int, float] = {}
+    fam = _REGISTRY.get("step_time_seconds")
+    if fam is not None:
+        ch = fam.children.get(())
+        if ch is not None:
+            times[pid] = ch.value
+    if n > 1:
+        from .parallel import multihost as _mh
+        for p in range(n):
+            if p == pid:
+                continue
+            raw = _mh.kv_get(f"{_KV_PREFIX}/steptime/{p}",
+                             timeout_ms=timeout_ms)
+            if raw:
+                try:
+                    times[p] = float(raw)
+                except ValueError:
+                    pass
+    return times
+
+
+def step_time_skew(timeout_ms: int = 1000) -> float:
+    """max/median of the per-process step times (1.0 = perfectly even;
+    a straggler drives it up). Sets the `step_time_skew_ratio` gauge
+    plus a `step_time_seconds{proc=i}` gauge per contributing process.
+    0.0 when nothing has been published yet."""
+    times = step_times(timeout_ms)
+    if not times:
+        return 0.0
+    med = statistics.median(times.values())
+    ratio = max(times.values()) / med if med > 0 else 0.0
+    set_gauge("step_time_skew_ratio", ratio)
+    for p, t in times.items():
+        set_gauge("step_time_seconds", t, proc=str(p))
+    return ratio
+
+
+def stragglers(threshold: float = 1.5,
+               timeout_ms: int = 1000) -> List[int]:
+    """Process indices whose step time exceeds `threshold` x the
+    median — the replicas to look at first when skew climbs."""
+    times = step_times(timeout_ms)
+    if len(times) < 2:
+        return []
+    med = statistics.median(times.values())
+    if med <= 0:
+        return []
+    return sorted(p for p, t in times.items() if t > threshold * med)
+
+
+def _prune_register(sources: List[weakref.ref], obj):
+    with _lock:
+        sources[:] = [r for r in sources
+                      if r() is not None and r() is not obj]
+        sources.append(weakref.ref(obj))
+
+
+def _live_sources(sources: List[weakref.ref]) -> list:
+    with _lock:
+        alive = [(r, r()) for r in sources]
+        sources[:] = [r for r, o in alive if o is not None]
+        return [o for _, o in alive if o is not None]
+
+
+def register_health_source(obj):
+    """Register an object exposing `health() -> (ok, reason)`; /healthz
+    answers 503 with the reason while any source reports not-ok. Held
+    by weakref — a collected source unregisters itself."""
+    _prune_register(_HEALTH_SOURCES, obj)
+
+
+def unregister_health_source(obj):
+    with _lock:
+        _HEALTH_SOURCES[:] = [r for r in _HEALTH_SOURCES
+                              if r() is not None and r() is not obj]
+
+
+def health() -> Tuple[bool, str]:
+    """Merged health of every registered source: the first not-ok
+    (ok, reason) wins; (True, "ok") when nothing objects."""
+    for src in _live_sources(_HEALTH_SOURCES):
+        try:
+            ok, reason = src.health()
+        except Exception:
+            continue
+        if not ok:
+            return False, str(reason)
+    return True, "ok"
+
+
+def register_request_trace_source(obj):
+    """Register an object exposing `request_traces() -> [trace dict]`
+    (InferenceServer); export_chrome_trace merges the spans under
+    REQUEST_PID. Held by weakref."""
+    _prune_register(_REQUEST_TRACE_SOURCES, obj)
+
+
+def _metrics_body() -> bytes:
+    """The /metrics payload: the merged cross-process view on the
+    primary of an initialized multi-process job, the local registry
+    everywhere else (and on any aggregation failure)."""
+    try:
+        from .parallel import multihost as _mh
+        if _mh.is_initialized():
+            import jax
+            if jax.process_count() > 1 and jax.process_index() == 0:
+                return to_prometheus_merged().encode()
+    except Exception:
+        pass
+    return to_prometheus().encode()
+
+
 class _MetricsServer:
     """Handle for a running /metrics endpoint: `.port`, `.url`,
     `.close()`. Construction binds and starts the daemon thread."""
@@ -496,14 +825,16 @@ class _MetricsServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path.split("?")[0] == "/metrics":
-                    body = to_prometheus().encode()
+                    body = _metrics_body()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path.split("?")[0] == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
+                    ok, reason = health()
+                    body = b"ok\n" if ok else (reason.rstrip("\n") +
+                                               "\n").encode()
+                    self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
                 else:
                     body = b"not found\n"
@@ -536,14 +867,20 @@ _METRICS_SERVER: Optional[_MetricsServer] = None
 
 
 def start_metrics_server(port: int = 0,
-                         host: str = "127.0.0.1") -> _MetricsServer:
+                         host: Optional[str] = None) -> _MetricsServer:
     """Serve `to_prometheus()` at GET /metrics (plus a /healthz probe)
     from a stdlib ThreadingHTTPServer daemon thread — the pull-based
     exposition for multi-host jobs where every worker scrapes its own
-    process. `port=0` binds an ephemeral port (see `.port`/`.url` on
-    the returned handle). One server per process: repeated calls return
-    the existing handle."""
+    process; the primary of a multi-process job serves the MERGED
+    registry (see `aggregate_snapshot`). `port=0` binds an ephemeral
+    port (see `.port`/`.url` on the returned handle). `host=None`
+    honors MXNET_TPU_METRICS_HOST (default 127.0.0.1 — loopback stays
+    the default; a pod primary sets 0.0.0.0 to expose the merged view).
+    One server per process: repeated calls return the existing
+    handle."""
     global _METRICS_SERVER
+    if host is None:
+        host = os.environ.get("MXNET_TPU_METRICS_HOST", "127.0.0.1")
     with _lock:
         if _METRICS_SERVER is None:
             _METRICS_SERVER = _MetricsServer(port=port, host=host)
@@ -653,6 +990,48 @@ def _device_trace_events() -> List[dict]:
     return events
 
 
+def _request_trace_events() -> List[dict]:
+    """Convert every registered source's per-request span timelines
+    into chrome events on REQUEST_PID: one tid per request, timed
+    events (queued wait, prefill, decode windows) as "X" spans, the
+    discrete transitions (admit, preempt, cow, evict, finish) as
+    instants."""
+    events: List[dict] = []
+    tids = set()
+    for src in _live_sources(_REQUEST_TRACE_SOURCES):
+        try:
+            traces = src.request_traces()
+        except Exception:
+            continue
+        for tr in traces:
+            rid = int(tr.get("request_id", 0))
+            tids.add(rid)
+            for ev in tr.get("events", []):
+                base = {"name": ev.get("name", "?"), "pid": REQUEST_PID,
+                        "tid": rid, "ts": float(ev.get("t", 0.0)) * 1e6}
+                args = {k: v for k, v in ev.items()
+                        if k not in ("name", "t", "dur_s")}
+                if args:
+                    base["args"] = args
+                dur = ev.get("dur_s")
+                if dur is not None:
+                    base["ph"] = "X"
+                    base["dur"] = float(dur) * 1e6
+                else:
+                    base["ph"] = "i"
+                    base["s"] = "t"
+                events.append(base)
+    if events:
+        events.insert(0, {"ph": "M", "pid": REQUEST_PID,
+                          "name": "process_name",
+                          "args": {"name": "serving: request spans"}})
+        for rid in sorted(tids):
+            events.append({"ph": "M", "pid": REQUEST_PID, "tid": rid,
+                           "name": "thread_name",
+                           "args": {"name": f"request {rid}"}})
+    return events
+
+
 def export_chrome_trace(path: str) -> str:
     """Write ONE chrome://tracing-loadable JSON merging:
 
@@ -660,7 +1039,9 @@ def export_chrome_trace(path: str) -> str:
     - host `profiler.scope` spans (pid 0),
     - device spans: sync-measured executable spans (pid 1, recorded by
       FusedTrainStep with `device=True`) and any chrome-format trace a
-      registered `jax.profiler` session produced (pids >= 2).
+      registered `jax.profiler` session produced (pids >= 2),
+    - per-request serving span timelines from registered
+      InferenceServers (pid REQUEST_PID, one tid per request).
 
     Works with whatever has been recorded so far; events only exist
     for spans that ran while telemetry was enabled."""
@@ -676,6 +1057,7 @@ def export_chrome_trace(path: str) -> str:
         events.extend(dict(ev, pid=HOST_PID) for ev in _prof._EVENTS)
     except Exception:
         pass
+    events.extend(_request_trace_events())
     dev = _device_trace_events()
     if dev:
         pids = sorted({ev.get("pid") for ev in dev})

@@ -86,6 +86,9 @@ def record_compile_seconds(name: str, seconds: float):
     from . import telemetry as _tm
     if _tm._ENABLED:
         _tm.observe("compile_seconds", seconds, block=name)
+    from . import flight as _fl
+    if _fl._ENABLED:
+        _fl.record("compile", name, seconds=seconds)
 
 
 def record_compile(name: str, entry) -> None:

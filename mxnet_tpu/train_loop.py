@@ -19,8 +19,10 @@ See docs/compiled_loop.md for when K helps and the degrade matrix.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterable, Optional
 
+from . import flight as _fl
 from . import telemetry as _tm
 from .gluon.data.dataloader import DevicePrefetcher, window_iter
 
@@ -78,25 +80,43 @@ class TrainLoop:
         if not isinstance(data, DevicePrefetcher):
             data = DevicePrefetcher(data, depth=self.prefetch_depth)
         last_saved = step._step_count
-        for window in window_iter(iter(data), self.k):
-            if max_steps is not None:
-                left = max_steps - step._step_count
-                if left <= 0:
+        try:
+            for window in window_iter(iter(data), self.k):
+                if max_steps is not None:
+                    left = max_steps - step._step_count
+                    if left <= 0:
+                        break
+                    window = window[:left]
+                t_win = time.perf_counter()
+                losses = step.run_steps(window)
+                if _tm._ENABLED and window:
+                    # the K boundary is the only place the host sees the
+                    # clock: per-step time (window / K) feeds the
+                    # cross-process skew gauge, and the registry is
+                    # published so the primary's /metrics can merge it
+                    _tm.publish_step_time(
+                        (time.perf_counter() - t_win) / len(window))
+                    _tm.publish_snapshot()
+                if on_flush is not None:
+                    on_flush(step._step_count, losses)
+                last_saved = self._maybe_save(step._step_count,
+                                              last_saved)
+                ph = self.preemption
+                if ph is not None and ph.preempted:
+                    # drain at the K boundary: the window above is fully
+                    # committed, so the final checkpoint is consistent
+                    ph.finalize(step._step_count, fused_step=step)
+                    self.stopped_by_preemption = True
                     break
-                window = window[:left]
-            losses = step.run_steps(window)
-            if on_flush is not None:
-                on_flush(step._step_count, losses)
-            last_saved = self._maybe_save(step._step_count, last_saved)
-            ph = self.preemption
-            if ph is not None and ph.preempted:
-                # drain at the K boundary: the window above is fully
-                # committed, so the final checkpoint is consistent
-                ph.finalize(step._step_count, fused_step=step)
-                self.stopped_by_preemption = True
-                break
-            if max_steps is not None and step._step_count >= max_steps:
-                break
+                if max_steps is not None \
+                        and step._step_count >= max_steps:
+                    break
+        except BaseException as e:
+            if _fl._ENABLED:
+                _fl.record("exception", "train_loop",
+                           error=repr(e)[:200], step=step._step_count)
+                _fl.dump(reason="train_loop_exception")
+            raise
         if _tm._ENABLED:
             _tm.set_gauge("train_loop_k", self.k)
         return step._step_count

@@ -1,0 +1,278 @@
+"""Flight recorder (mxnet_tpu.flight): bounded event ring, JSONL dumps
+whose FINAL lines are the triggering event, and the auto-dump triggers
+wired across the stack (fault fires, sanitizer abort, SIGTERM
+preemption, TrainLoop exceptions) plus the instrumentation feeds
+(telemetry phases, kvstore collectives, checkpoint lifecycle). Runs on
+the 8-virtual-device CPU mesh (conftest)."""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, flight, telemetry as tm
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Every test: recorder off+empty at entry/exit, dumps land in
+    tmp_path, no armed faults, clean telemetry."""
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    flight.disable()
+    flight.clear()
+    flight.set_capacity(flight.DEFAULT_CAPACITY)
+    faults.clear()
+    tm.disable()
+    tm.reset()
+    yield
+    flight.disable()
+    flight.clear()
+    flight.set_capacity(flight.DEFAULT_CAPACITY)
+    faults.clear()
+    tm.disable()
+    tm.reset()
+
+
+def _read_dump(path):
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    return lines[0], lines[1:]
+
+
+# -- ring --------------------------------------------------------------------
+
+def test_disabled_records_nothing():
+    flight.record("phase", "step", dur_s=1.0)
+    assert flight.events() == []
+    assert not flight.enabled()
+    assert flight.dump() is None
+
+
+def test_ring_order_and_payload():
+    flight.enable()
+    flight.record("a", "s1", x=1)
+    flight.record("b", "s2")
+    evs = flight.events()
+    assert [(e[1], e[2]) for e in evs] == [("a", "s1"), ("b", "s2")]
+    assert evs[0][3] == {"x": 1}
+    assert evs[1][3] is None           # empty payload stored as None
+    assert evs[0][0] <= evs[1][0]      # monotonic timestamps
+    flight.clear()
+    assert flight.events() == []
+
+
+def test_ring_is_bounded_and_keeps_newest():
+    flight.enable(capacity=16)
+    assert flight.capacity() == 16
+    for i in range(40):
+        flight.record("k", "site", i=i)
+    evs = flight.events()
+    assert len(evs) == 16
+    assert [e[3]["i"] for e in evs] == list(range(24, 40))
+
+
+def test_set_capacity_floor_and_resize_keeps_tail():
+    flight.enable()
+    for i in range(30):
+        flight.record("k", "s", i=i)
+    flight.set_capacity(4)   # below the floor of 16
+    assert flight.capacity() == 16
+    assert [e[3]["i"] for e in flight.events()] == list(range(14, 30))
+
+
+# -- dump format -------------------------------------------------------------
+
+def test_dump_jsonl_roundtrip(tmp_path):
+    flight.enable()
+    flight.record("phase", "fwd", dur_s=0.5)
+    flight.record("fault", "host.slow", ms=3)
+    path = flight.dump(reason="unit test!")
+    assert path == flight.last_dump_path
+    assert os.path.dirname(path) == str(tmp_path)
+    assert os.path.basename(path) == \
+        f"flight-unit-test--p{os.getpid()}.jsonl"
+    header, evs = _read_dump(path)
+    assert header["flight"] == 1 and header["reason"] == "unit test!"
+    assert header["events"] == 2 and header["pid"] == os.getpid()
+    assert evs[0] == {"t": evs[0]["t"], "kind": "phase", "site": "fwd",
+                      "payload": {"dur_s": 0.5}}
+    # the FINAL line is the newest event
+    assert evs[-1]["kind"] == "fault" and evs[-1]["site"] == "host.slow"
+
+
+def test_dump_per_reason_overwrites(tmp_path):
+    flight.enable()
+    flight.record("k", "s", n=1)
+    p1 = flight.dump(reason="stall")
+    seq1 = _read_dump(p1)[0]["seq"]
+    flight.record("k", "s", n=2)
+    p2 = flight.dump(reason="stall")
+    assert p1 == p2
+    header, evs = _read_dump(p2)
+    assert header["seq"] == seq1 + 1 and len(evs) == 2
+    assert len(list(tmp_path.glob("flight-*.jsonl"))) == 1
+
+
+def test_dump_explicit_path(tmp_path):
+    flight.enable()
+    flight.record("k", "s")
+    p = str(tmp_path / "custom.jsonl")
+    assert flight.dump(path=p) == p
+    header, evs = _read_dump(p)
+    assert header["reason"] == "manual" and len(evs) == 1
+
+
+# -- auto-dump triggers ------------------------------------------------------
+
+def test_fault_fire_records_and_dumps(tmp_path):
+    flight.enable()
+    faults.inject("host.slow", at=2, ms=1)
+    faults.fire("host.slow")           # miss: no event, no dump
+    assert flight.events() == [] and flight.last_dump_path is None or \
+        not str(flight.last_dump_path).startswith(str(tmp_path))
+    faults.fire("host.slow")           # hit
+    evs = flight.events()
+    assert evs[-1][1] == "fault" and evs[-1][2] == "host.slow"
+    assert evs[-1][3]["ms"] == 1 and evs[-1][3]["fire"] == 1
+    path = flight.last_dump_path
+    assert path and os.path.basename(path).startswith("flight-fault-")
+    _, dumped = _read_dump(path)
+    assert dumped[-1]["kind"] == "fault"
+    assert dumped[-1]["site"] == "host.slow"
+
+
+def _net_and_trainer(**kw):
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize(force_reinit=True)
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, **kw)
+    return net, tr
+
+
+def _one_step(net, tr, bs=2):
+    x = mx.nd.ones((bs, 3))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(bs)
+
+
+def test_sanitizer_abort_dumps_with_trigger_last():
+    flight.enable()
+    net, tr = _net_and_trainer(skip_nonfinite=2)
+    faults.inject("grad.nonfinite")    # every step
+    _one_step(net, tr)
+    _one_step(net, tr)
+    with pytest.raises(FloatingPointError):
+        _one_step(net, tr)
+    path = flight.last_dump_path
+    assert path and "sanitizer_abort" in os.path.basename(path)
+    _, evs = _read_dump(path)
+    # acceptance: the final events are the skip streak then the abort
+    assert evs[-1]["kind"] == "abort"
+    assert evs[-1]["site"] == "grad_sanitizer"
+    assert evs[-1]["payload"]["consecutive"] == 3
+    skips = [e for e in evs if e["kind"] == "sanitizer_skip"]
+    assert len(skips) == 3
+    assert evs[-2]["kind"] == "sanitizer_skip"
+
+
+def test_preemption_sigterm_dumps(tmp_path):
+    from mxnet_tpu.checkpoint import Checkpointer, PreemptionHandler
+    flight.enable()
+    net, tr = _net_and_trainer()
+    ck = Checkpointer(str(tmp_path / "ck"))
+    with PreemptionHandler(ck) as ph:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert ph.preempted
+    ck.close()
+    path = flight.last_dump_path
+    assert path and "preemption" in os.path.basename(path)
+    _, evs = _read_dump(path)
+    assert evs[-1]["kind"] == "preemption"
+    assert evs[-1]["site"] == "sigterm"
+    assert evs[-1]["payload"]["signum"] == int(signal.SIGTERM)
+
+
+def test_train_loop_exception_dumps():
+    flight.enable()
+
+    class _BoomStep:
+        _step_count = 0
+
+        def run_steps(self, window):
+            raise RuntimeError("boom in dispatch")
+
+    loop = mx.TrainLoop(_BoomStep(), k=2)
+    data = [(mx.nd.ones((2, 3)), mx.nd.zeros((2,))) for _ in range(4)]
+    with pytest.raises(RuntimeError, match="boom"):
+        loop.run(data)
+    path = flight.last_dump_path
+    assert path and "train_loop_exception" in os.path.basename(path)
+    _, evs = _read_dump(path)
+    assert evs[-1]["kind"] == "exception"
+    assert evs[-1]["site"] == "train_loop"
+    assert "boom in dispatch" in evs[-1]["payload"]["error"]
+
+
+# -- instrumentation feeds ---------------------------------------------------
+
+def test_phase_feeds_flight():
+    flight.enable()
+    tm.enable()
+    with tm.phase("fwd"):
+        pass
+    tm.mark_phase("opt", 0.25)
+    evs = [e for e in flight.events() if e[1] == "phase"]
+    assert [e[2] for e in evs] == ["fwd", "opt"]
+    assert evs[1][3]["dur_s"] == 0.25
+
+
+def test_phase_without_flight_records_nothing():
+    tm.enable()
+    with tm.phase("fwd"):
+        pass
+    assert flight.events() == []
+
+
+def test_kvstore_collective_events():
+    flight.enable()
+    kv = mx.kvstore.create("device")
+    v = mx.nd.ones((64,))
+    kv.init(0, v)
+    flight.clear()                      # drop any init-time noise
+    kv.pushpull(0, mx.nd.ones((64,)), out=v)
+    kinds = [(e[1], e[2]) for e in flight.events()]
+    assert ("collective", "kvstore.pushpull") in kinds
+    assert ("collective_done", "kvstore.pushpull") in kinds
+    ent = next(e for e in flight.events() if e[1] == "collective")
+    done = next(e for e in flight.events() if e[1] == "collective_done")
+    assert ent[3]["bytes"] == 64 * 4 and ent[3]["store"] == "device"
+    assert done[3]["dur_s"] >= 0.0
+
+
+def test_checkpoint_lifecycle_events(tmp_path):
+    from mxnet_tpu.checkpoint import Checkpointer
+    flight.enable()
+    net, tr = _net_and_trainer()
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, net=net)
+    ck.close()
+    ck2 = Checkpointer(str(tmp_path / "ck"))
+    assert ck2.restore(net=net)["step"] == 1
+    ck2.close()
+    kinds = [(e[1], e[2]) for e in flight.events()]
+    assert ("checkpoint", "save") in kinds
+    assert ("checkpoint", "restore") in kinds
+
+
+def test_compile_event_feed():
+    from mxnet_tpu import tracing
+    flight.enable()
+    tracing.record_compile_seconds("blk", 0.125)
+    evs = [e for e in flight.events() if e[1] == "compile"]
+    assert evs and evs[-1][2] == "blk"
+    assert evs[-1][3]["seconds"] == 0.125

@@ -245,3 +245,24 @@ def test_dryrun_zero2_kill_restart_leg():
     # environmental skip is tolerated (loaded CI host); a worker
     # failure raises out of the leg and fails this test
     assert status == "ok" or status.startswith("skipped:"), status
+
+
+@pytest.mark.slow
+def test_dryrun_two_process_telemetry_leg():
+    """The promoted leg (8): two coordination-service processes train
+    locally with a host.slow straggler armed on process 1 — the primary
+    aggregates the merged registry, serves it at /metrics, and fingers
+    process 1 via step_time_skew()/stragglers()."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+
+    status = ge._two_process_telemetry_leg(timeout_s=200)
+    # environmental skip is tolerated (loaded CI host); a worker
+    # failure raises out of the leg and fails this test
+    assert status == "ok" or status.startswith("skipped:"), status

@@ -1016,3 +1016,257 @@ def test_server_block4_stays_on_gather_path(net):
     finally:
         telemetry.disable()
         telemetry.reset()
+
+
+# -- per-request traces, health probe, flight dump (ISSUE 10) ---------------
+
+def test_server_tracing_acceptance(net):
+    """Acceptance bar: a 16-request workload with tracing ON still
+    compiles exactly one prefill + one decode executable, and the
+    reported trace TTFT matches the request's `ttft` property."""
+    rs = np.random.RandomState(41)
+    server = InferenceServer(net, batch_slots=4, max_len=64,
+                             block_size=8, max_prompt_len=12,
+                             trace_sample_every=1)
+    reqs = _mixed_requests(server, rs, 16)
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] == 1, cs
+    assert cs["decode_compiles"] == 1, cs
+    for _, _, r in reqs:
+        tr = server.trace(r.id)
+        assert tr is not None
+        assert tr["ttft_s"] == r.ttft
+        assert tr["latency_s"] == r.t_finish - r.t_submit
+        assert tr["decode_tokens"] == len(r.output_tokens)
+        names = [e["name"] for e in tr["events"]]
+        assert names[0] == "queued" and names[-1] == "finish"
+        assert "admit" in names and "prefill" in names
+        ts = [e["t"] for e in tr["events"]]
+        assert ts == sorted(ts)
+        # timed spans carry durations
+        by_name = {e["name"]: e for e in tr["events"]}
+        assert by_name["queued"]["dur_s"] == tr["queue_wait_s"]
+        assert by_name["prefill"]["dur_s"] > 0
+        if tr["decode_tokens"] > 1:
+            assert "decode" in names
+            assert tr["tpot_s"] is not None and tr["tpot_s"] >= 0
+
+
+def test_trace_sampling_knob(net):
+    """trace_sample_every=N keeps every Nth request (by submit order);
+    the rest are dropped at the terminal transition."""
+    rs = np.random.RandomState(42)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             trace_sample_every=2)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=3) for _ in range(6)]
+    server.run()
+    kept = [r for r in reqs if server.trace(r.id) is not None]
+    assert [r.id for r in kept] == [reqs[0].id, reqs[2].id, reqs[4].id]
+
+
+def test_trace_slow_outlier_always_kept(net):
+    """A request slower than trace_slow_s is retained even when the
+    sampling knob would discard it."""
+    rs = np.random.RandomState(43)
+    srv_all = InferenceServer(net, batch_slots=2, max_len=32,
+                              block_size=8, max_prompt_len=8,
+                              trace_sample_every=0, trace_slow_s=0.0)
+    r = srv_all.submit(rs.randint(0, 256, 4).astype(np.int32),
+                       max_new_tokens=3)
+    srv_all.run()
+    assert srv_all.trace(r.id) is not None   # everything beats 0.0s
+    srv_none = InferenceServer(net, batch_slots=2, max_len=32,
+                               block_size=8, max_prompt_len=8,
+                               trace_sample_every=0, trace_slow_s=1e9)
+    r2 = srv_none.submit(rs.randint(0, 256, 4).astype(np.int32),
+                         max_new_tokens=3)
+    srv_none.run()
+    assert srv_none.trace(r2.id) is None
+
+
+def test_trace_capacity_evicts_oldest(net):
+    rs = np.random.RandomState(44)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             trace_sample_every=1, trace_capacity=2)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=3) for _ in range(5)]
+    server.run()
+    kept = [r.id for r in reqs if server.trace(r.id) is not None]
+    assert kept == [reqs[-2].id, reqs[-1].id]
+
+
+def test_trace_preemption_splits_decode_windows(net):
+    """Preemption shows up in the trace as a `preempt` transition and a
+    second decode window; TPOT only counts within-window time."""
+    rs = np.random.RandomState(45)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=12,
+                             num_blocks=6, trace_sample_every=1)
+    pa = rs.randint(0, 256, 10).astype(np.int32)
+    pb = rs.randint(0, 256, 10).astype(np.int32)
+    ra = server.submit(pa, max_new_tokens=12)
+    rb = server.submit(pb, max_new_tokens=12)
+    server.run()
+    victim = ra if ra.preemptions else rb
+    assert victim.preemptions >= 1
+    tr = server.trace(victim.id)
+    names = [e["name"] for e in tr["events"]]
+    assert names.count("preempt") == victim.preemptions
+    assert names.count("admit") == victim.preemptions + 1
+    assert names.count("prefill") == victim.preemptions + 1
+    assert tr["preemptions"] == victim.preemptions
+    decs = [e for e in tr["events"] if e["name"] == "decode"]
+    assert len(decs) >= 2
+
+
+def test_trace_live_request_visible(net):
+    """trace() works mid-flight: queued and running requests expose
+    their partial timelines before the terminal transition."""
+    rs = np.random.RandomState(46)
+    server = InferenceServer(net, batch_slots=1, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             trace_sample_every=1)
+    r1 = server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                       max_new_tokens=6)
+    r2 = server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                       max_new_tokens=6)
+    server.step()                       # r1 admitted, r2 still queued
+    t1, t2 = server.trace(r1.id), server.trace(r2.id)
+    assert t1["state"] == "running" and t1["latency_s"] is None
+    assert [e["name"] for e in t2["events"]] == ["queued"]
+    assert len(server.request_traces()) == 2
+    server.run()
+
+
+def test_queue_age_percentiles(net):
+    import time as _time
+    rs = np.random.RandomState(47)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    st = server.stats()
+    assert st["queue_age_p50_s"] == 0.0 and st["queue_age_p95_s"] == 0.0
+    for _ in range(4):
+        server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                      max_new_tokens=2)
+    _time.sleep(0.02)
+    st = server.stats()
+    assert st["queue_age_p50_s"] >= 0.02
+    assert st["queue_age_p95_s"] >= st["queue_age_p50_s"]
+    server.run()
+    assert server.stats()["queue_age_p50_s"] == 0.0
+
+
+def test_health_probe_transitions(net):
+    rs = np.random.RandomState(48)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    assert server.health() == (True, "ok")
+    # the server registered itself with telemetry at construction
+    ok, reason = telemetry.health()
+    assert ok and reason == "ok"
+    server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                  max_new_tokens=2)
+    server.drain()
+    ok, reason = server.health()
+    assert not ok and "draining" in reason
+    server.shutdown()
+    ok, reason = server.health()
+    assert not ok and "shutdown" in reason
+    ok, reason = telemetry.health()     # aggregate view goes 503
+    assert not ok
+    telemetry.unregister_health_source(server)
+    assert telemetry.health() == (True, "ok")
+
+
+def test_health_stalled_and_recovers(net):
+    from mxnet_tpu import faults
+    from mxnet_tpu.serving import ServerStalledError
+    rs = np.random.RandomState(49)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             watchdog_ticks=3)
+    server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                  max_new_tokens=3)
+    faults.inject("serving.stall")
+    try:
+        with pytest.raises(ServerStalledError):
+            server.run()
+        ok, reason = server.health()
+        assert not ok and "stalled" in reason
+        faults.clear()
+        server.run()                    # progress clears the flag
+        assert server.health() == (True, "ok")
+    finally:
+        faults.clear()
+        telemetry.unregister_health_source(server)
+
+
+def test_watchdog_stall_flight_dump(net, tmp_path, monkeypatch):
+    """Acceptance bar: an induced watchdog stall leaves a flight dump
+    whose FINAL event is the stall record."""
+    import json
+    from mxnet_tpu import faults, flight
+    from mxnet_tpu.serving import ServerStalledError
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    flight.clear()
+    flight.enable()
+    rs = np.random.RandomState(50)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             watchdog_ticks=4)
+    server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                  max_new_tokens=3)
+    faults.inject("serving.stall")
+    try:
+        with pytest.raises(ServerStalledError):
+            server.run()
+    finally:
+        faults.clear()
+        flight.disable()
+        telemetry.unregister_health_source(server)
+    path = tmp_path / f"flight-serving_stall-p{__import__('os').getpid()}.jsonl"
+    assert path.exists()
+    lines = [json.loads(l) for l in path.open()]
+    assert lines[0]["reason"] == "serving_stall"
+    last = lines[-1]
+    assert last["kind"] == "stall" and last["site"] == "serving.watchdog"
+    assert last["payload"]["ticks"] == 4
+    # the dead ticks leading up to it are the preceding fault records
+    assert any(e.get("site") == "serving.stall" for e in lines[1:-1])
+    flight.clear()
+
+
+def test_chrome_trace_merges_request_spans(net, tmp_path):
+    import json
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(51)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8,
+                                 trace_sample_every=1)
+        reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                              max_new_tokens=3) for _ in range(3)]
+        server.run()
+        out = telemetry.export_chrome_trace(str(tmp_path / "tr.json"))
+        evs = json.load(open(out))["traceEvents"]
+        req_evs = [e for e in evs
+                   if e.get("pid") == telemetry.REQUEST_PID]
+        names = {e["name"] for e in req_evs if e.get("ph") != "M"}
+        assert {"queued", "prefill", "decode", "admit",
+                "finish"} <= names
+        tids = {e.get("tid") for e in req_evs if e.get("ph") != "M"}
+        assert tids == {r.id for r in reqs}
+        # spans are "X" with microsecond durations; transitions are "i"
+        spans = [e for e in req_evs if e.get("ph") == "X"]
+        assert spans and all(e["dur"] >= 0 for e in spans)
+        metas = [e for e in req_evs if e.get("ph") == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        telemetry.unregister_health_source(server)

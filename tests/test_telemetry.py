@@ -603,3 +603,156 @@ def test_metrics_server_live_counters():
         assert "train_loop_k 8" in body
     finally:
         tm.stop_metrics_server()
+
+
+# -- cross-process aggregation + health (ISSUE 10) --------------------------
+
+def test_registry_state_roundtrip_and_merge():
+    """_registry_state serializes the full registry; merging the same
+    blob for two fake processes sums counters, merges histogram
+    buckets, and splits gauges under proc labels."""
+    import json
+    tm.enable()
+    tm.inc("steps_total", 3)
+    tm.inc("comm_bytes_reduced", 128, store="device")
+    tm.set_gauge("queue_depth", 7)
+    for v in (0.5, 1.5, 0.0):
+        tm.observe("tick_seconds", v)
+    state = json.loads(json.dumps(tm._registry_state()))  # wire trip
+    merged = tm._merge_registry({0: state, 1: state})
+    flat = {}
+    for fam in merged.values():
+        for key, ch in fam.children.items():
+            flat[fam.name + tm._label_suffix(key)] = ch
+    assert flat["steps_total"].value == 6.0
+    assert flat["comm_bytes_reduced{store=device}"].value == 256.0
+    # gauges: one child per process, no unlabeled child
+    assert flat["queue_depth{proc=0}"].value == 7.0
+    assert flat["queue_depth{proc=1}"].value == 7.0
+    assert "queue_depth" not in flat
+    h = flat["tick_seconds"]
+    assert h.count == 6 and h.sum == 4.0 and h.zeros == 2
+    assert h.min == 0.0 and h.max == 1.5
+
+
+def test_aggregate_snapshot_single_process():
+    tm.enable()
+    tm.inc("steps_total", 2)
+    tm.set_gauge("train_loop_k", 8)
+    agg = tm.aggregate_snapshot()
+    assert agg["processes"] == [0]
+    assert agg["counters"]["steps_total"] == 2.0
+    assert agg["gauges"]["train_loop_k{proc=0}"] == 8.0
+    tm.disable()
+    assert tm.aggregate_snapshot() == {}
+
+
+def test_publish_snapshot_noop_single_process():
+    tm.enable()
+    tm.inc("steps_total")
+    assert tm.publish_snapshot() is False   # nothing to coordinate with
+    tm.disable()
+    assert tm.publish_snapshot() is False
+
+
+def test_to_prometheus_merged_proc_labels():
+    tm.enable()
+    tm.inc("steps_total", 4)
+    tm.set_gauge("step_time_seconds", 0.25)
+    body = tm.to_prometheus_merged()
+    assert "steps_total 4" in body
+    assert 'step_time_seconds{proc=0} 0.25' in body
+    tm.disable()
+    assert tm.to_prometheus_merged() == ""
+
+
+def test_step_time_skew_single_process():
+    tm.enable()
+    assert tm.step_time_skew() == 0.0       # nothing published yet
+    tm.publish_step_time(0.125)
+    assert tm.step_times() == {0: 0.125}
+    assert tm.step_time_skew() == 1.0       # one proc: max == median
+    assert tm.snapshot()["gauges"]["step_time_skew_ratio"] == 1.0
+    assert tm.stragglers() == []            # needs >= 2 contributors
+    tm.disable()
+    assert tm.step_times() == {} and tm.stragglers() == []
+
+
+def test_metrics_server_honors_host_env(monkeypatch):
+    tm.enable()
+    monkeypatch.setenv("MXNET_TPU_METRICS_HOST", "0.0.0.0")
+    srv = tm.start_metrics_server()
+    try:
+        assert srv.host == "0.0.0.0"
+    finally:
+        tm.stop_metrics_server()
+    # explicit host beats the env
+    srv = tm.start_metrics_server(host="127.0.0.1")
+    try:
+        assert srv.host == "127.0.0.1"
+    finally:
+        tm.stop_metrics_server()
+
+
+def test_metrics_server_default_is_loopback(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_METRICS_HOST", raising=False)
+    tm.enable()
+    srv = tm.start_metrics_server()
+    try:
+        assert srv.host == "127.0.0.1"
+    finally:
+        tm.stop_metrics_server()
+
+
+class _StubHealth:
+    def __init__(self):
+        self.ok = True
+        self.reason = "ok"
+
+    def health(self):
+        return self.ok, self.reason
+
+
+def test_health_aggregates_sources():
+    stub = _StubHealth()
+    tm.register_health_source(stub)
+    try:
+        assert tm.health() == (True, "ok")
+        stub.ok, stub.reason = False, "draining: admission stopped"
+        ok, reason = tm.health()
+        assert not ok and reason == "draining: admission stopped"
+    finally:
+        tm.unregister_health_source(stub)
+    assert tm.health() == (True, "ok")
+
+
+def test_health_source_weakref_drops():
+    import gc
+    stub = _StubHealth()
+    stub.ok = False
+    tm.register_health_source(stub)
+    assert tm.health()[0] is False
+    del stub
+    gc.collect()
+    assert tm.health() == (True, "ok")
+
+
+def test_healthz_endpoint_503(monkeypatch):
+    import urllib.request
+    import urllib.error
+    tm.enable()
+    stub = _StubHealth()
+    stub.ok, stub.reason = False, "stalled: watchdog"
+    tm.register_health_source(stub)
+    srv = tm.start_metrics_server()
+    try:
+        hz = srv.url.replace("/metrics", "/healthz")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(hz, timeout=5)
+        assert ei.value.code == 503
+        assert b"stalled: watchdog" in ei.value.read()
+        stub.ok = True
+        assert urllib.request.urlopen(hz, timeout=5).read() == b"ok\n"
+    finally:
+        tm.stop_metrics_server()
+        tm.unregister_health_source(stub)

@@ -1,0 +1,203 @@
+"""Static-analysis guard for the observability cost contract.
+
+Telemetry and the flight recorder promise that DISABLED instrumentation
+costs one module-attribute load + branch per site. That only holds if
+every call site actually guards on the module flag — one ungated
+`telemetry.inc(...)` on a hot path quietly taxes every production run
+(it builds the label tuple and takes the registry's locking path even
+though the helper's own `if not _ENABLED: return` discards the work).
+
+This test walks the ASTs of every module under `mxnet_tpu/` and fails
+when a call to an observe-family helper (`inc` / `observe` /
+`set_gauge` / `mark_phase` / `step_done` on a telemetry alias,
+`record` / `dump` on a flight alias) is not protected by the
+module-flag gate pattern. Accepted gates:
+
+- an enclosing `if` whose test mentions `_ENABLED` / `_ACTIVE` /
+  `enabled()` / `active()` — directly, or through a local variable
+  assigned from such an expression (`timed = _tm._ENABLED` ...
+  `if timed:`);
+- an earlier early-return guard in the same function, e.g.
+  `if not _tm._ENABLED: return` (the idiom of helper bodies like
+  `KVStore._count_bytes`).
+
+`telemetry.phase(...)` is deliberately NOT in the checked family: the
+context manager gates itself before any timestamping.
+"""
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mxnet_tpu")
+
+#: the helpers whose call sites must be gated, per instrumented module
+FAMILY = {"inc", "observe", "set_gauge", "mark_phase", "step_done",
+          "record", "dump"}
+
+#: substrings that make an `if` test (or a flag-variable initializer)
+#: count as the module-flag gate
+FLAG_MARKERS = ("_ENABLED", "_ACTIVE", "enabled", "active")
+
+#: the modules that IMPLEMENT the helpers — their internal calls are
+#: self-gated by the helpers' own early returns
+EXCLUDED = {"telemetry.py", "flight.py"}
+
+
+def _module_files():
+    out = []
+    for root, _dirs, files in os.walk(PKG):
+        for f in files:
+            if f.endswith(".py") and f not in EXCLUDED:
+                out.append(os.path.join(root, f))
+    return sorted(out)
+
+
+def _instrumentation_aliases(tree):
+    """Names this module binds to the telemetry / flight / faults
+    modules (e.g. `telemetry`, `_tm`, `_fl`)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in ("telemetry", "flight", "faults"):
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                mod = a.name.rsplit(".", 1)[-1]
+                if mod in ("telemetry", "flight", "faults"):
+                    aliases.add(a.asname or a.name.split(".")[0])
+    return aliases
+
+
+def _test_mentions_flag(test_node, flag_names):
+    src = ast.dump(test_node)
+    if any(m in src for m in FLAG_MARKERS):
+        return True
+    return any(isinstance(n, ast.Name) and n.id in flag_names
+               for n in ast.walk(test_node))
+
+
+def _flag_locals(fn_node):
+    """Local names assigned from a flag expression
+    (`timed = _tm._ENABLED`, `enabled = _tm._ENABLED and x`)."""
+    names = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and node.value is not None:
+            if any(m in ast.dump(node.value) for m in FLAG_MARKERS):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def _has_early_return_guard(fn_node, before_line):
+    """An `if <flag...>: return/raise` statement earlier in the
+    function body counts as gating everything after it."""
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.If) or node.lineno >= before_line:
+            continue
+        if not any(m in ast.dump(node.test) for m in FLAG_MARKERS):
+            continue
+        for sub in node.body:
+            for n in ast.walk(sub):
+                if isinstance(n, (ast.Return, ast.Raise)):
+                    return True
+    return False
+
+
+def _violations(path):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    aliases = _instrumentation_aliases(tree)
+    if not aliases:
+        return []
+
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    bad = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in FAMILY
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in aliases):
+            continue
+        # climb the ancestry: gated if any enclosing `if` test (or
+        # `while`, for retry loops) references a flag
+        gated = False
+        enclosing_fn = None
+        cur = node
+        flag_names = set()
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and enclosing_fn is None:
+                enclosing_fn = cur
+                flag_names = _flag_locals(cur)
+        cur = node
+        while cur in parents and not gated:
+            cur = parents[cur]
+            if isinstance(cur, (ast.If, ast.While)) \
+                    and _test_mentions_flag(cur.test, flag_names):
+                gated = True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        if not gated and enclosing_fn is not None:
+            gated = _has_early_return_guard(enclosing_fn, node.lineno)
+        if not gated:
+            rel = os.path.relpath(path, REPO)
+            bad.append(f"{rel}:{node.lineno} ungated "
+                       f"{fn.value.id}.{fn.attr}(...)")
+    return bad
+
+
+def test_all_instrumentation_sites_are_flag_gated():
+    bad = []
+    for path in _module_files():
+        bad.extend(_violations(path))
+    assert not bad, (
+        "instrumentation call sites missing the module-flag gate "
+        "(wrap in `if <module>._ENABLED:` / `if faults._ACTIVE:` or an "
+        "early-return guard so the disabled path stays one attribute "
+        "check):\n  " + "\n  ".join(bad))
+
+
+def test_lint_catches_an_ungated_site(tmp_path):
+    """The guard itself must fail on an ungated call — otherwise a
+    refactor could silently neuter it."""
+    src = (
+        "from . import telemetry as _tm\n"
+        "def hot():\n"
+        "    _tm.inc('x_total')\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert _violations(str(p)) != []
+
+
+def test_lint_accepts_the_gate_idioms(tmp_path):
+    src = (
+        "from . import telemetry as _tm\n"
+        "from . import flight as _fl\n"
+        "def a():\n"
+        "    if _tm._ENABLED:\n"
+        "        _tm.inc('x_total')\n"
+        "def b():\n"
+        "    timed = _tm._ENABLED\n"
+        "    if timed:\n"
+        "        _tm.observe('h', 1.0)\n"
+        "def c():\n"
+        "    if not _tm._ENABLED:\n"
+        "        return\n"
+        "    _tm.set_gauge('g', 1)\n"
+        "def d():\n"
+        "    if _fl._ENABLED:\n"
+        "        _fl.record('k', 's')\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    assert _violations(str(p)) == []

@@ -548,3 +548,26 @@ def test_unroll_knob_separate_cache_entry():
     assert len(step._loop_cache) == 2
     ks = sorted(ckey[-1] for ckey in step._loop_cache)
     assert ks == [1, 4]
+
+
+def test_trainloop_publishes_step_time():
+    """ISSUE 10: the K boundary is where the host sees the clock —
+    TrainLoop must refresh the step_time_seconds gauge per window
+    (single-process: publish_snapshot stays a no-op)."""
+    from mxnet_tpu import telemetry as tm
+    tm.disable()
+    tm.reset()
+    tm.enable()
+    try:
+        step = FusedTrainStep(_toy_net(),
+                              mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mx.optimizer.SGD(learning_rate=0.1))
+        loop = mx.TrainLoop(step, k=2)
+        assert loop.run(_loop_data(4)) == 4
+        g = tm.snapshot()["gauges"]
+        assert g["step_time_seconds"] > 0.0
+        assert g["train_loop_k"] == 2.0
+        assert tm.step_times() == {0: g["step_time_seconds"]}
+    finally:
+        tm.disable()
+        tm.reset()
