@@ -19,6 +19,12 @@ tokens/sec/chip, against a warmed sequential one-shot generate()
 baseline over the identical workload (serve_speedup is the headline
 comparison).
 
+--fleet N runs the resilient-serving bench: the same Poisson workload
+through 1 replica, then N subprocess replicas behind
+mx.serving.FleetRouter (fleet TTFT p50/p95, tokens/sec per replica vs
+single), then N replicas with one SIGKILLed mid-run — zero lost and
+zero duplicated requests is the reported robustness claim.
+
 One JSON line, rc 0, BudgetGuard — same contract as every bench here.
 """
 import argparse
@@ -228,6 +234,189 @@ def serve_phase(on_tpu, guard, num_requests=16, arrival_rate=None,
     telemetry.reset()
 
 
+def _fleet_spawn(d, name, cfg_json, fault=None, max_wall_s=300):
+    """One subprocess fleet replica over the FileKV channel. Workers
+    always run on CPU: this phase measures the ROUTER (failover,
+    shedding, fleet latency), not chip throughput — and N processes
+    cannot share one TPU anyway."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault:
+        env["MXNET_TPU_FAULTS"] = fault
+    log = open(os.path.join(d, f"{name}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-u", "-m", "mxnet_tpu.serving.router",
+         "--dir", d, "--name", name, "--config", cfg_json,
+         "--slots", "4", "--max-len", "64", "--block", "8",
+         "--max-prompt", "16", "--max-wall-s", str(max_wall_s)],
+        stdout=log, stderr=log, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fleet_leg(d, n_workers, cfg_json, workload, arrival_rate, rs,
+               kill=False):
+    """Poisson-drive `workload` through an N-replica subprocess fleet;
+    returns (requests, wall_s, router_stats, worker_rcs, final_stats)."""
+    import signal as _signal
+
+    from mxnet_tpu.serving.router import FileKV, FleetRouter, ProcReplica
+
+    kv = FileKV(d)
+    procs = [_fleet_spawn(
+        d, f"w{i}", cfg_json,
+        fault="replica.kill:at=8" if (kill and i == 0) else None)
+        for i in range(n_workers)]
+    try:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 240:
+            if all(kv.get(f"fleet/w{i}/hb") is not None
+                   for i in range(n_workers)):
+                break
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"fleet worker w{i} died during warmup "
+                        f"(rc={p.returncode}), see {d}/w{i}.log")
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("fleet workers never became healthy")
+
+        fleet = FleetRouter(
+            [ProcReplica(kv, f"w{i}") for i in range(n_workers)],
+            affinity_blocks=0, backoff_base_s=0.01,
+            heartbeat_timeout_s=2.0)
+        gaps = rs.exponential(1.0 / arrival_rate, len(workload))
+        t_start = time.perf_counter()
+        arrivals = t_start + np.cumsum(gaps)
+        pending = list(zip(arrivals, workload))
+        frs = []
+        while pending or fleet._queue or fleet._inflight:
+            now = time.perf_counter()
+            while pending and pending[0][0] <= now:
+                _, (p, n) = pending.pop(0)
+                frs.append(fleet.submit(p, n))
+            if fleet.step() == 0:
+                time.sleep(0.002)
+        wall = time.perf_counter() - t_start
+        stats = fleet.stats()
+        final = fleet.stop_fleet(timeout_ms=30_000)
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=60))
+            except Exception:
+                p.kill()
+                rcs.append(p.wait(timeout=30))
+        return frs, wall, stats, rcs, final
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
+                arrival_rate=None, seed=0):
+    """--fleet N: the resilient-serving bench. Three legs over the same
+    Poisson workload of subprocess replicas on the FileKV channel:
+    one replica (the scaling baseline), N replicas (fleet TTFT p50/p95
+    + tokens/sec per replica vs 1), and N replicas with one SIGKILLed
+    mid-run by `replica.kill` — the robustness claim is ZERO lost and
+    ZERO duplicated requests across the failover."""
+    import tempfile
+
+    from mxnet_tpu import telemetry
+
+    # must match _build_net(serve=True)'s CPU config — the workers
+    # rebuild it from this JSON with the same seed
+    cfg_kw = dict(vocab_size=2048, hidden_size=256,
+                  intermediate_size=1024, num_layers=4, num_heads=8,
+                  num_kv_heads=4, max_seq_len=128, dtype="float32")
+    cfg_json = json.dumps(cfg_kw)
+    arrival_rate = arrival_rate or 200.0
+    mpl, new_choices = 16, (8, 16, 24)
+
+    rs = np.random.RandomState(seed)
+    workload = []
+    for _ in range(num_requests):
+        T = int(rs.randint(4, mpl + 1))
+        p = rs.randint(0, cfg_kw["vocab_size"], T).astype(np.int32)
+        workload.append((p, int(rs.choice(new_choices))))
+    total_new = sum(n for _, n in workload)
+
+    def leg(n_workers, kill):
+        d = tempfile.mkdtemp(prefix="fleet_bench_")
+        return _fleet_leg(d, n_workers, cfg_json, workload,
+                          arrival_rate, np.random.RandomState(seed),
+                          kill=kill)
+
+    # leg 1: single replica (the baseline the fleet is judged against)
+    frs1, wall1, _, _, _ = leg(1, kill=False)
+    single_tps = total_new / wall1
+
+    # leg 2: N replicas, clean — the headline fleet number
+    frsN, wallN, statsN, _, _ = leg(fleet_n, kill=False)
+    fleet_tps = total_new / wallN
+    ttfts = [fr.ttft_s for fr in frsN if fr.ttft_s is not None]
+    ttft_p50 = float(np.percentile(ttfts, 50)) if ttfts else 0.0
+    ttft_p95 = float(np.percentile(ttfts, 95)) if ttfts else 0.0
+
+    # leg 3: N replicas, one SIGKILLed mid-run
+    kill_ok = lost = dup = failovers = 0
+    kill_rc0 = None
+    if guard.remaining() > 30.0:
+        frsK, _, statsK, rcsK, _ = leg(fleet_n, kill=True)
+        kill_ok = sum(1 for fr in frsK if fr.status == "ok")
+        lost = len(workload) - len(frsK) \
+            + sum(1 for fr in frsK if fr.status != "ok")
+        dup = statsK["duplicates"]
+        failovers = statsK["failovers"]
+        kill_rc0 = rcsK[0]
+
+    guard.best.update({
+        "value": round(fleet_tps, 2),
+        "phase": "fleet",
+        "fleet_n": fleet_n,
+        "requests": num_requests,
+        "tokens_generated": total_new,
+        "workers_backend": "cpu",
+        "fleet_wall_s": round(wallN, 3),
+        "fleet_ttft_p50_ms": round(ttft_p50 * 1e3, 2),
+        "fleet_ttft_p95_ms": round(ttft_p95 * 1e3, 2),
+        "single_tokens_per_sec": round(single_tps, 2),
+        "fleet_tokens_per_sec": round(fleet_tps, 2),
+        "fleet_tokens_per_sec_per_replica": round(fleet_tps / fleet_n,
+                                                  2),
+        "fleet_speedup_vs_single": round(fleet_tps / single_tps, 2)
+        if single_tps else 0.0,
+        "fleet_retries": statsN["retries"],
+        "fleet_hedges": statsN["hedges"],
+        "kill_leg_ok": kill_ok,
+        "kill_leg_lost_requests": lost,
+        "kill_leg_duplicates": dup,
+        "kill_leg_failovers": failovers,
+        "kill_leg_worker0_rc": kill_rc0,  # -9 = SIGKILL landed
+        "fleet_zero_lost": bool(kill_rc0 is not None and lost == 0
+                                and dup == 0),
+    })
+    telemetry.enable()
+    for k, v in (("bench_fleet_tokens_per_sec", fleet_tps),
+                 ("bench_fleet_ttft_p50_ms", ttft_p50 * 1e3),
+                 ("bench_fleet_ttft_p95_ms", ttft_p95 * 1e3),
+                 ("bench_fleet_speedup_vs_single",
+                  fleet_tps / single_tps if single_tps else 0.0),
+                 ("bench_fleet_lost_requests", float(lost)),
+                 ("bench_fleet_failovers", float(failovers))):
+        telemetry.set_gauge(k, float(v), bench="decode_fleet")
+    guard.emit()
+    telemetry.disable()
+    telemetry.reset()
+
+
 def paged_kernel_phase(on_tpu, guard):
     """--paged-kernel: decode HBM bytes for the three decode-tick
     attention variants — contiguous flash-decode (the floor), the
@@ -358,6 +547,10 @@ def main():
     ap.add_argument("--paged-kernel", action="store_true",
                     help="decode HBM bytes: in-kernel paged attention "
                          "vs gather fallback vs contiguous flash-decode")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="resilient-fleet bench: N subprocess replicas "
+                         "behind FleetRouter, incl. a kill-one-replica "
+                         "leg asserting zero lost requests")
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--arrival-rate", type=float, default=None,
                     help="Poisson arrival rate, requests/sec")
@@ -366,6 +559,8 @@ def main():
 
     if args.paged_kernel:
         metric, unit = "paged_decode_bytes_ratio", "x"
+    elif args.fleet:
+        metric, unit = "llama_fleet_tokens_per_sec", "tokens/sec"
     elif args.serve:
         metric, unit = "llama_serve_tokens_per_sec", "tokens/sec"
     else:
@@ -381,6 +576,10 @@ def main():
     guard.emit()
     if args.paged_kernel:
         paged_kernel_phase(on_tpu, guard)
+    elif args.fleet:
+        fleet_phase(on_tpu, guard, fleet_n=args.fleet,
+                    num_requests=args.requests,
+                    arrival_rate=args.arrival_rate, seed=args.seed)
     elif args.serve:
         serve_phase(on_tpu, guard, num_requests=args.requests,
                     arrival_rate=args.arrival_rate, seed=args.seed)

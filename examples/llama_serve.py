@@ -2,7 +2,9 @@
 pattern, then push a mixed batch of requests through
 mx.serving.InferenceServer — paged KV cache, one shared decode
 executable, per-request sampling params — and compare a greedy
-request's output against one-shot generate().
+request's output against one-shot generate(). Ends with the same
+model behind a 2-replica mx.serving.FleetRouter (the resilient-fleet
+front door).
 
 Usage: python examples/llama_serve.py [--cpu] [--steps 200]
                                       [--requests 8]
@@ -93,6 +95,35 @@ def main():
           f"{ttft['p95'] * 1e3:.1f}ms over {ttft['count']} requests")
     if not match:
         raise SystemExit("serving output diverged from generate()")
+
+    # -- resilient fleet: the same model behind a 2-replica router ----
+    # (health-gated least-loaded routing; a replica loss mid-run would
+    # fail over with no request lost — see docs/serving.md)
+    fleet = mx.serving.FleetRouter(
+        [mx.serving.LocalReplica(
+            mx.serving.InferenceServer(net, batch_slots=4, max_len=64,
+                                       block_size=8, max_prompt_len=16),
+            name=f"r{i}") for i in range(2)],
+        affinity_blocks=0)
+    frs = []
+    for i in range(args.requests):
+        start = int(rs.randint(0, 50))
+        prompt = ((start + np.arange(5)) % 50).astype(np.int32)
+        frs.append((prompt, fleet.submit(prompt, 6)))
+    fleet.run(timeout_s=300)
+    for prompt, fr in frs:
+        print(f"fleet {fr.token} via {fr.replica}: {prompt.tolist()} "
+              f"-> {fr.output_tokens} ({fr.status})")
+    fst = fleet.stats()
+    print(f"fleet stats: {len(frs)} requests over "
+          f"{sorted(fst['replicas'])}, retries={fst['retries']} "
+          f"failovers={fst['failovers']} shed={fst['shed']}")
+    p0, fr0 = frs[0]
+    one = generate(net, p0[None, :], max_new_tokens=6, max_len=64)
+    fmatch = fr0.output_tokens == one[0, len(p0):].tolist()
+    print("fleet parity with one-shot generate():", fmatch)
+    if not fmatch or any(fr.status != "ok" for _, fr in frs):
+        raise SystemExit("fleet serving diverged or lost a request")
 
 
 if __name__ == "__main__":

@@ -32,6 +32,20 @@ site                   fires at
                         real to catch
 ``multihost.break``     ``multihost.initialize`` — raises, proving the
                         dryrun turns red over a broken multihost path
+``replica.kill``        a fleet worker's serve loop, after a productive
+                        tick (tokens were emitted) — SIGKILLs the worker
+                        mid-stream so the router's failover has real
+                        in-flight requests to rescue; in-process fleets
+                        fire it at the router tick instead (payload
+                        ``replica=i`` picks the handle, which is marked
+                        dead without a process to kill)
+``replica.stall``       same sites — the worker sleeps ``ms`` (heartbeat
+                        goes stale); in-process, the handle skips
+                        ``ticks`` drive ticks (health stays ok, progress
+                        stops — the hedging case, not the failover case)
+``router.drop``         ``FleetRouter`` result intake — discards a
+                        completed attempt's result as if the reply got
+                        lost, exercising the retry + idempotency path
 ====================== ====================================================
 
 Env grammar (``;``-separated entries, ``:``-separated fields, first
@@ -80,7 +94,8 @@ __all__ = ["SITES", "FaultInjected", "FaultTimeout",
 
 #: the named injection sites instrumented across the stack
 SITES = ("checkpoint.truncate", "collective.timeout", "grad.nonfinite",
-         "step.kill", "host.slow", "serving.stall", "multihost.break")
+         "step.kill", "host.slow", "serving.stall", "multihost.break",
+         "replica.kill", "replica.stall", "router.drop")
 
 
 class FaultInjected(RuntimeError):

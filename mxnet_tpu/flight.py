@@ -15,6 +15,8 @@ Auto-dump triggers wired across the stack (each records the triggering
 event LAST, then dumps, so the tail of the file is the cause):
 
 - the serving watchdog declaring :class:`ServerStalledError`
+- the fleet router's watchdog declaring :class:`RouterStalledError`
+  (``router_stall`` — no request made progress for ``watchdog_s``)
 - :class:`GradSanitizer` aborting on the consecutive-skip cap (eager
   and fused-loop paths)
 - :class:`PreemptionHandler` receiving SIGTERM

@@ -30,7 +30,7 @@ __all__ = [
     "initialize", "is_initialized", "is_primary", "process_index",
     "process_count", "local_devices", "hybrid_device_mesh",
     "sync_global_devices", "broadcast_from_primary",
-    "kv_set", "kv_get", "client_barrier",
+    "kv_set", "kv_get", "kv_delete", "kv_dir_get", "client_barrier",
 ]
 
 _initialized = False
@@ -197,6 +197,35 @@ def kv_get(key: str, timeout_ms: int = 2000) -> Optional[str]:
         return c.blocking_key_value_get(key, int(timeout_ms))
     except Exception:
         return None
+
+
+def kv_delete(key: str) -> bool:
+    """Delete `key` (and, per the service's semantics, any keys under
+    the directory `key/`) from the KV store. False when no service."""
+    c = _client()
+    if c is None:
+        return False
+    try:
+        c.key_value_delete(key)
+    except Exception:
+        return False
+    return True
+
+
+def kv_dir_get(prefix: str) -> list:
+    """Non-blocking prefix scan: every ``(key, value)`` currently under
+    `prefix` (the coordination service treats keys as paths, so use a
+    trailing ``/`` to scan a directory). Empty list when nothing is
+    there yet or no service is up. This is the polling primitive the
+    serving fleet's result channel rides — unlike :func:`kv_get` it
+    never blocks waiting for a key to appear."""
+    c = _client()
+    if c is None:
+        return []
+    try:
+        return [(k, v) for k, v in c.key_value_dir_get(prefix)]
+    except Exception:
+        return []
 
 
 def client_barrier(name: str, timeout_ms: int = 60_000):

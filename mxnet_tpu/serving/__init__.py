@@ -20,15 +20,27 @@ request scheduler instead of one-shot `generate()` calls.
             for p in prompts]
     server.run()
 
+- `router.FleetRouter` — the resilient fleet: health-gated routing
+  over N replicas (least-loaded + prefix-affinity) with circuit
+  breakers, failover retries, hedging, load shedding, and drain-aware
+  rolling restarts.
+
 See docs/serving.md for the architecture and the block-table math.
 """
 from . import kv_cache
 from . import sampling
 from . import executables
 from . import server
+from . import router
 from .kv_cache import PagedKVCache
 from .server import InferenceServer, Request, ServerStalledError
+from .router import (FleetRouter, FleetRequest, LocalReplica,
+                     ProcReplica, CircuitBreaker, FileKV, CoordKV,
+                     RouterStalledError, run_fleet_worker)
 
 __all__ = ["PagedKVCache", "InferenceServer", "Request",
            "ServerStalledError",
-           "kv_cache", "sampling", "executables", "server"]
+           "FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
+           "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
+           "run_fleet_worker",
+           "kv_cache", "sampling", "executables", "server", "router"]

@@ -1,0 +1,1312 @@
+"""Resilient serving fleet: a health-gated router over N replicas.
+
+One `InferenceServer` is a replica; this module is the fleet. The
+`FleetRouter` admits requests over N replicas — in the same process
+(`LocalReplica`, the router drives each server's tick itself) or in
+other processes (`ProcReplica`, speaking a kv message channel) — with
+robustness as the first-class design axis:
+
+- **Least-loaded admission** scored from the gauges each replica
+  already exports (`health_detail()`: queue age p50/p95, blocks-free,
+  queued/active vs slots) — the same numbers the `/healthz` JSON body
+  carries, so a replica is scored by ONE probe.
+- **Prefix-affinity routing**: the prompt's leading block-sized chunks
+  are exactly the prefix cache's chain keys (`kv_cache.PagedKVCache`
+  content index), so hashing them routes repeated system prompts to
+  the replica that already holds the shared blocks. Affinity degrades
+  to least-loaded the moment the target is unhealthy or saturated.
+- **Health tracking + circuit breaker** per replica: detail probes and
+  heartbeat staleness classify each replica HEALTHY / DRAINING /
+  UNHEALTHY / DEAD (`router_replica_health` gauge); consecutive
+  failures open a breaker (open → half-open probe → close).
+- **Failover with capped-exponential-backoff retries**: unfinished
+  requests on a dead/stalled replica are resubmitted elsewhere under
+  an idempotency token — first completed attempt wins, late
+  duplicates are ignored, so no request is lost or double-counted
+  (`serve_failovers_total`, `serve_retries_total`).
+- **Hedged requests**: a request stuck in flight past the fleet
+  queue-age p95 (or a fixed threshold) is duplicated on a second
+  replica; first responder wins, the loser is cancelled through
+  `InferenceServer.cancel` (`serve_hedges_total{won}`).
+- **Load shedding**: the fleet queue is bounded; at saturation
+  `submit()` returns the request already terminal with status
+  ``rejected`` instead of queueing forever (`serve_shed_total`).
+- **Drain-aware rolling restart**: flip one replica to draining (its
+  health source now reports not-ready, so admission stops), wait for
+  its in-flight work, restart it, wait until healthy, move on.
+
+The channel behind `ProcReplica` is the PR-10 coordination-service
+side channel's kv semantics (`set` / blocking `get` / `dir` prefix
+scan), with two backends:
+
+- `CoordKV` — `multihost.kv_set/kv_get/kv_dir_get`: for pods, where
+  every replica already joined one `jax.distributed` job. Note the
+  coordination service itself force-terminates surviving clients when
+  a member dies, so this backend suits drain/rolling-restart flows,
+  not SIGKILL failover.
+- `FileKV` — the same semantics over a shared directory with
+  atomic-rename writes: kill-tolerant, so the SIGKILL fleet tests and
+  `decode_bench --fleet` ride it.
+
+Fault sites (armed via `MXNET_TPU_FAULTS`, see `mxnet_tpu.faults`):
+``replica.kill`` (worker dies after a productive tick — in-process,
+the handle is marked dead), ``replica.stall`` (worker sleeps ``ms`` /
+handle skips ``ticks``), ``router.drop`` (a completed attempt's
+result is discarded, exercising retry + idempotency).
+
+Worker side: `run_fleet_worker(channel, name, ...)` drives one server
+against the channel protocol; ``python -m mxnet_tpu.serving.router
+--dir D --name r0`` is the subprocess entry the tests and the fleet
+bench spawn.
+
+Cost contract: all router telemetry/flight calls are gated on the
+module flags (`telemetry._ENABLED` / `_fl._ENABLED` / `_ft._ACTIVE`),
+AST-enforced by tests/test_telemetry_lint.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import faults as _ft
+from .. import flight as _fl
+from .. import telemetry
+from .server import InferenceServer
+
+__all__ = ["FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
+           "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
+           "run_fleet_worker",
+           "HEALTHY", "DRAINING", "UNHEALTHY", "DEAD"]
+
+#: replica health states (the `router_replica_health` gauge value)
+HEALTHY, DRAINING, UNHEALTHY, DEAD = 0, 1, 2, 3
+_STATE_NAMES = {HEALTHY: "healthy", DRAINING: "draining",
+                UNHEALTHY: "unhealthy", DEAD: "dead"}
+
+#: fleet-level terminal statuses; "ok"/"timed_out"/"cancelled" mirror
+#: the server's, "rejected" is the shed outcome, "failed" means the
+#: retry budget ran out
+_OK, _REJECTED, _FAILED, _TIMED_OUT, _CANCELLED = \
+    "ok", "rejected", "failed", "timed_out", "cancelled"
+
+
+class RouterStalledError(RuntimeError):
+    """The fleet made no progress for `watchdog_s` seconds with work
+    pending — every replica is dead/wedged and retries are parked.
+    Raised out of step()/run() so a supervisor restarts the fleet."""
+
+
+# -- the kv channel ----------------------------------------------------------
+
+class FileKV:
+    """The coordination channel's kv semantics over a shared directory:
+    `set` is write-to-temp + atomic rename (readers never see a torn
+    value), `get` polls for the key up to `timeout_ms`, `dir` is a
+    non-blocking prefix scan. Keys are slash-separated paths. Unlike
+    the coordination service, a SIGKILLed participant takes nothing
+    else down — this is the kill-tolerant backend the fleet tests and
+    bench use."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
+        if not p.startswith(self.root):
+            raise ValueError(f"key {key!r} escapes the channel root")
+        return p
+
+    def set(self, key: str, value: str):
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.__tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def get(self, key: str, timeout_ms: int = 0) -> Optional[str]:
+        deadline = time.perf_counter() + timeout_ms / 1e3
+        path = self._path(key)
+        while True:
+            try:
+                with open(path) as f:
+                    return f.read()
+            except OSError:
+                pass
+            if time.perf_counter() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    def dir(self, prefix: str) -> List[tuple]:
+        d = self._path(prefix)
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in sorted(os.listdir(d)):
+            if "__tmp" in name:
+                continue        # in-flight write, not yet renamed
+            full = os.path.join(d, name)
+            if not os.path.isfile(full):
+                continue
+            try:
+                with open(full) as f:
+                    out.append((prefix.rstrip("/") + "/" + name,
+                                f.read()))
+            except OSError:
+                pass
+        return out
+
+    def delete(self, key: str) -> bool:
+        try:
+            os.remove(self._path(key))
+            return True
+        except OSError:
+            return False
+
+
+class CoordKV:
+    """The same channel interface over the jax coordination-service kv
+    store (`multihost.kv_set/kv_get/kv_dir_get`) — for pod fleets where
+    every replica already joined one `jax.distributed` job. The service
+    tears down surviving clients when a member SIGKILLs, so use this
+    backend for drain/rolling-restart flows and `FileKV` for
+    kill-failover testing."""
+
+    def set(self, key: str, value: str):
+        from ..parallel import multihost as _mh
+        _mh.kv_set(key, value)
+
+    def get(self, key: str, timeout_ms: int = 0) -> Optional[str]:
+        from ..parallel import multihost as _mh
+        return _mh.kv_get(key, timeout_ms=max(1, int(timeout_ms)))
+
+    def dir(self, prefix: str) -> List[tuple]:
+        from ..parallel import multihost as _mh
+        return _mh.kv_dir_get(prefix)
+
+    def delete(self, key: str) -> bool:
+        from ..parallel import multihost as _mh
+        return _mh.kv_delete(key)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: `threshold` consecutive failures
+    open it (admission stops); after `cooldown_s` one probe request is
+    allowed through (half-open); that probe's success closes the
+    breaker, its failure re-opens it. All transitions take the caller's
+    `now` so tests drive the state machine with a fake clock."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 1.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = self.CLOSED
+        self.failures = 0
+        self._opened_t = 0.0
+        self._probe_out = False
+
+    def allow(self, now: float) -> bool:
+        """May a request be routed here right now? Consumes the single
+        half-open probe slot when it grants one."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if now - self._opened_t >= self.cooldown_s:
+                self.state = self.HALF_OPEN
+                self._probe_out = True
+                return True
+            return False
+        if not self._probe_out:         # half-open, probe slot free
+            self._probe_out = True
+            return True
+        return False
+
+    def record_success(self):
+        self.state = self.CLOSED
+        self.failures = 0
+        self._probe_out = False
+
+    def record_failure(self, now: float):
+        self.failures += 1
+        if self.state == self.HALF_OPEN or \
+                self.failures >= self.threshold:
+            self.state = self.OPEN
+            self._opened_t = now
+            self._probe_out = False
+
+
+# -- requests ----------------------------------------------------------------
+
+class FleetRequest:
+    """One fleet-level request: prompt + sampling params + lifecycle.
+    `token` is the idempotency token every attempt carries — results
+    are deduped on it, so a request resubmitted after a failover (or
+    hedged) completes exactly once."""
+
+    _next_id = 0
+
+    def __init__(self, prompt, max_new_tokens: int, temperature=0.0,
+                 top_k=0, top_p=0.0, eos_id=None, seed=0,
+                 deadline_s=None):
+        self.id = FleetRequest._next_id
+        FleetRequest._next_id += 1
+        self.token = f"q{self.id}-{uuid.uuid4().hex[:8]}"
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.params = {"temperature": float(temperature),
+                       "top_k": int(top_k), "top_p": float(top_p),
+                       "eos_id": eos_id, "seed": int(seed)}
+        self.state = "queued"           # queued | inflight | finished
+        #: terminal: "ok" | "rejected" | "failed" | "timed_out" |
+        #: "cancelled"; None while live
+        self.status: Optional[str] = None
+        self.finish_reason: Optional[str] = None
+        self.output_tokens: List[int] = []
+        #: fleet-level time-to-first-token of the WINNING attempt:
+        #: router queue wait + the replica's own TTFT (when reported)
+        self.ttft_s: Optional[float] = None
+        self.replica: Optional[str] = None      # who served the winner
+        self.tries = 0                  # attempts started (incl. hedges)
+        self.retries = 0                # re-dispatches after a failure
+        self.hedged = False
+        self.attempts: List["_Attempt"] = []
+        self.next_eligible_t = 0.0
+        self.t_submit = time.time()
+        self.t_deadline = None if deadline_s is None \
+            else self.t_submit + float(deadline_s)
+        self.t_finish: Optional[float] = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.status is not None
+
+    def tokens(self) -> np.ndarray:
+        """prompt + generated tokens, 1-D int32 (server parity)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output_tokens, np.int32)])
+
+    def __repr__(self):
+        return (f"FleetRequest(token={self.token}, state={self.state}, "
+                f"status={self.status}, tries={self.tries})")
+
+
+class _Attempt:
+    """One dispatch of a request to one replica."""
+    __slots__ = ("rep", "sub", "t0", "hedge")
+
+    def __init__(self, rep, sub, t0, hedge):
+        self.rep = rep
+        self.sub = sub
+        self.t0 = t0
+        self.hedge = hedge
+
+
+# -- replica handles ---------------------------------------------------------
+
+class LocalReplica:
+    """An in-process `InferenceServer` behind the replica interface:
+    probes are synchronous `health_detail()` calls, `drive()` runs one
+    scheduler tick, poll/cancel act on the server's Request objects.
+    `factory` (a zero-arg server builder) enables `restart()` for the
+    rolling-restart flow."""
+
+    def __init__(self, server: Optional[InferenceServer] = None,
+                 factory: Optional[Callable[[], InferenceServer]] = None,
+                 name: Optional[str] = None):
+        if server is None:
+            if factory is None:
+                raise ValueError("need a server or a factory")
+            server = factory()
+        self.server = server
+        self.factory = factory
+        self.name = name or f"local{id(server) & 0xffff:x}"
+        self.dead = False
+        self.restarts = 0
+        self._stall_ticks_left = 0
+        self._dropped = set()           # sub ids with discarded results
+
+    def probe(self, now: float) -> Optional[dict]:
+        if self.dead:
+            return None                 # no heartbeat from the dead
+        d = self.server.health_detail()
+        d["t"] = now
+        return d
+
+    def submit(self, fr: FleetRequest, attempt_key: str,
+               deadline_s: Optional[float]):
+        if self.dead:
+            raise RuntimeError(f"replica {self.name} is dead")
+        req = self.server.submit(
+            fr.prompt, fr.max_new_tokens,
+            temperature=fr.params["temperature"],
+            top_k=fr.params["top_k"], top_p=fr.params["top_p"],
+            eos_id=fr.params["eos_id"], seed=fr.params["seed"],
+            deadline_s=deadline_s)
+        return req
+
+    def drive(self) -> int:
+        """One scheduler tick (0 tokens when dead/stalled/idle)."""
+        if self.dead:
+            return 0
+        if self._stall_ticks_left > 0:
+            self._stall_ticks_left -= 1
+            return 0
+        if self.server.queue or self.server._active.any():
+            return self.server.step()
+        return 0
+
+    def poll(self, sub) -> Optional[dict]:
+        if sub.state != "finished" or id(sub) in self._dropped:
+            return None
+        return {"status": sub.status,
+                "tokens": [int(t) for t in sub.output_tokens],
+                "finish_reason": sub.finish_reason,
+                "ttft": getattr(sub, "ttft", None)}
+
+    def discard(self, sub):
+        """Forget a result (the `router.drop` fault's sink)."""
+        self._dropped.add(id(sub))
+
+    def cancel(self, sub):
+        self.server.cancel(sub.id)
+
+    def begin_drain(self):
+        self.server.begin_drain()
+
+    def end_drain(self):
+        self.server.end_drain()
+
+    def restart(self):
+        if self.factory is None:
+            raise RuntimeError(
+                f"replica {self.name} has no factory — cannot restart")
+        telemetry.unregister_health_source(self.server)
+        self.server = self.factory()
+        self.dead = False
+        self._stall_ticks_left = 0
+        self._dropped.clear()
+        self.restarts += 1
+
+
+class ProcReplica:
+    """A replica living in another process, spoken to over the kv
+    channel under namespace ``fleet/<name>``:
+
+    - ``cmd/<seq>``: router → worker command stream (submit / cancel /
+      drain / undrain / restart / stop), consumed in order.
+    - ``res/<attempt-token>``: worker → router per-attempt results.
+    - ``hb``: worker → router heartbeat — the `health_detail()` dict
+      plus a wall-clock stamp; staleness past `heartbeat_timeout_s`
+      (router-side) is how a SIGKILLed worker is detected.
+    """
+
+    def __init__(self, channel, name: str):
+        self.channel = channel
+        self.name = name
+        self.ns = f"fleet/{name}"
+        self.dead = False               # router marks on staleness
+        self._cmd_seq = 0
+        self._results: Dict[str, dict] = {}
+        self._dropped = set()
+
+    def _send(self, obj: dict):
+        self.channel.set(f"{self.ns}/cmd/{self._cmd_seq}",
+                         json.dumps(obj))
+        self._cmd_seq += 1
+
+    def probe(self, now: float) -> Optional[dict]:
+        raw = self.channel.get(f"{self.ns}/hb", timeout_ms=0)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def submit(self, fr: FleetRequest, attempt_key: str,
+               deadline_s: Optional[float]):
+        self._send({"op": "submit", "token": attempt_key,
+                    "prompt": [int(t) for t in fr.prompt],
+                    "max_new": fr.max_new_tokens,
+                    "deadline_s": deadline_s, **fr.params})
+        return attempt_key
+
+    def drive(self) -> int:
+        return 0                        # the worker drives itself
+
+    def fetch_results(self):
+        """Pull newly published results from the channel (one prefix
+        scan per router tick)."""
+        for key, val in self.channel.dir(f"{self.ns}/res/"):
+            tok = key.rsplit("/", 1)[-1]
+            if tok in self._results or tok in self._dropped:
+                continue
+            try:
+                self._results[tok] = json.loads(val)
+            except ValueError:
+                pass
+
+    def poll(self, sub) -> Optional[dict]:
+        return self._results.get(sub)
+
+    def discard(self, sub):
+        self._results.pop(sub, None)
+        self._dropped.add(sub)          # don't re-fetch from the file
+
+    def cancel(self, sub):
+        self._send({"op": "cancel", "token": sub})
+
+    def begin_drain(self):
+        self._send({"op": "drain"})
+
+    def end_drain(self):
+        self._send({"op": "undrain"})
+
+    def restart(self):
+        self._send({"op": "restart"})
+        self.dead = False
+
+    def stop(self):
+        self._send({"op": "stop"})
+
+    def final_stats(self, timeout_ms: int = 10_000) -> Optional[dict]:
+        """The worker's closing `stats()` dump (published on stop)."""
+        raw = self.channel.get(f"{self.ns}/stats",
+                               timeout_ms=timeout_ms)
+        return None if raw is None else json.loads(raw)
+
+
+class _Rep:
+    """Router-side per-replica state: the handle plus everything the
+    router derives about it."""
+    __slots__ = ("handle", "name", "breaker", "state", "detail",
+                 "last_seen", "attempts")
+
+    def __init__(self, handle, breaker, now):
+        self.handle = handle
+        self.name = handle.name
+        self.breaker = breaker
+        self.state = UNHEALTHY          # until the first good probe
+        self.detail: Optional[dict] = None
+        self.last_seen = now            # heartbeat staleness baseline
+        self.attempts: Dict[int, tuple] = {}    # id(att) -> (fr, att)
+
+
+# -- the router --------------------------------------------------------------
+
+class FleetRouter:
+    """Health-gated request router over a fleet of replicas.
+
+        fleet = FleetRouter([LocalReplica(s1), LocalReplica(s2)])
+        reqs = [fleet.submit(p, max_new_tokens=16) for p in prompts]
+        fleet.run()
+        for r in reqs: print(r.status, r.tokens())
+
+    Robustness knobs (see the module docstring for semantics):
+    `max_fleet_queue` bounds the fleet queue (overflow sheds with
+    status ``rejected``); `max_retries` / `backoff_base_s` /
+    `backoff_max_s` shape the capped-exponential retry schedule;
+    `hedge_after_s` (None = off, float = fixed, ``"auto"`` = fleet
+    queue-age p95 floored at `hedge_min_s`) arms hedging;
+    `attempt_timeout_s` bounds one attempt's in-flight time;
+    `heartbeat_timeout_s` declares a silent ProcReplica dead;
+    `breaker_threshold` / `breaker_cooldown_s` shape the circuit
+    breaker; `affinity_blocks` is how many leading prompt blocks feed
+    the prefix-affinity hash (0 disables affinity)."""
+
+    def __init__(self, replicas, *,
+                 max_fleet_queue: int = 256,
+                 per_replica_queue: Optional[int] = None,
+                 max_retries: int = 3,
+                 backoff_base_s: float = 0.02,
+                 backoff_max_s: float = 1.0,
+                 hedge_after_s=None,
+                 hedge_min_s: float = 0.05,
+                 attempt_timeout_s: Optional[float] = None,
+                 heartbeat_timeout_s: float = 2.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 0.5,
+                 affinity_blocks: int = 2,
+                 affinity_capacity: int = 4096,
+                 block_size: int = 16,
+                 watchdog_s: float = 120.0,
+                 poll_s: float = 0.002):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        now = time.time()
+        self._reps = [_Rep(h, CircuitBreaker(breaker_threshold,
+                                             breaker_cooldown_s), now)
+                      for h in replicas]
+        names = [r.name for r in self._reps]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.max_fleet_queue = int(max_fleet_queue)
+        self.per_replica_queue = per_replica_queue
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.hedge_after_s = hedge_after_s
+        self.hedge_min_s = float(hedge_min_s)
+        self.attempt_timeout_s = attempt_timeout_s
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.affinity_blocks = int(affinity_blocks)
+        self.affinity_capacity = int(affinity_capacity)
+        self.block_size = int(block_size)
+        self.watchdog_s = float(watchdog_s)
+        self.poll_s = float(poll_s)
+        self._queue: deque = deque()
+        self._inflight: Dict[str, FleetRequest] = {}
+        self.finished: List[FleetRequest] = []
+        self._affinity: "OrderedDict[int, _Rep]" = OrderedDict()
+        self.ticks = 0
+        self._last_progress_t = now
+        # python-side counters mirroring the telemetry ones, so
+        # stats() answers even with telemetry disabled
+        self.n_shed = 0
+        self.n_retries = 0
+        self.n_failovers = 0
+        self.n_hedges = 0
+        self.n_duplicates = 0
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, eos_id: Optional[int] = None,
+               seed: int = 0,
+               deadline_s: Optional[float] = None) -> FleetRequest:
+        """Enqueue one request on the fleet. Under saturation (the
+        bounded fleet queue is full) the request is returned already
+        terminal with status ``rejected`` — shedding never raises, so
+        drivers can count rejections like any other outcome."""
+        fr = FleetRequest(prompt_ids, max_new_tokens, temperature,
+                          top_k, top_p, eos_id, seed, deadline_s)
+        if len(self._queue) >= self.max_fleet_queue:
+            fr.state = "finished"
+            fr.status = _REJECTED
+            fr.finish_reason = "shed"
+            fr.t_finish = time.time()
+            self.finished.append(fr)
+            self.n_shed += 1
+            if telemetry._ENABLED:
+                telemetry.inc("serve_shed_total")
+            if _fl._ENABLED:
+                _fl.record("route", "router.shed", token=fr.token,
+                           queued=len(self._queue))
+            return fr
+        self._queue.append(fr)
+        return fr
+
+    # -- one scheduling tick -------------------------------------------------
+
+    def step(self) -> int:
+        """One router tick: refresh health, fail over the dead,
+        dispatch, drive local replicas, collect results, hedge.
+        Returns a progress count (dispatches + tokens + deliveries)."""
+        now = time.time()
+        if _ft._ACTIVE:
+            sp = _ft.fire("replica.kill")
+            if sp is not None:
+                self._kill_replica(int(sp.get("replica", 0)))
+            sp = _ft.fire("replica.stall")
+            if sp is not None:
+                h = self._reps[int(sp.get("replica", 0))
+                               % len(self._reps)].handle
+                if hasattr(h, "_stall_ticks_left"):
+                    h._stall_ticks_left = int(sp.get("ticks", 1 << 30))
+        self._refresh(now)
+        progress = self._failover_dead(now)
+        self._expire(now)
+        progress += self._dispatch(now)
+        progress += self._drive(now)
+        progress += self._collect(now)
+        progress += self._hedge(now)
+        self.ticks += 1
+        self._note_progress(progress, now)
+        return progress
+
+    def run(self, max_ticks: Optional[int] = None,
+            timeout_s: Optional[float] = None) -> List[FleetRequest]:
+        """Step until every submitted request is terminal (or a
+        bound). Returns the requests finished during this call."""
+        done0 = len(self.finished)
+        t0 = time.time()
+        ticks = 0
+        while self._queue or self._inflight:
+            progress = self.step()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            if timeout_s is not None and time.time() - t0 > timeout_s:
+                break
+            if not progress:
+                time.sleep(self.poll_s)
+        return self.finished[done0:]
+
+    # -- health --------------------------------------------------------------
+
+    def _refresh(self, now: float):
+        for rep in self._reps:
+            h = rep.handle
+            if isinstance(h, ProcReplica):
+                h.fetch_results()
+            try:
+                d = h.probe(now)
+            except Exception:
+                d = None
+            if d is not None:
+                rep.detail = d
+                rep.last_seen = float(d.get("t", now))
+            if isinstance(h, ProcReplica) and rep.detail is not None:
+                # heartbeat staleness is the liveness signal for a
+                # remote worker — and a fresh beat REVIVES one that was
+                # only stalled (a never-seen worker is "starting", not
+                # dead). LocalReplica.dead stays sticky until restart.
+                h.dead = now - rep.last_seen > self.heartbeat_timeout_s
+            if getattr(h, "dead", False):
+                state = DEAD
+            elif rep.detail is None:
+                state = UNHEALTHY
+            elif rep.detail.get("draining"):
+                state = DRAINING
+            elif not rep.detail.get("ok", False) or \
+                    rep.breaker.state != CircuitBreaker.CLOSED:
+                state = UNHEALTHY
+            else:
+                state = HEALTHY
+            if state != rep.state:
+                if _fl._ENABLED:
+                    _fl.record("route", "router.health",
+                               replica=rep.name,
+                               state=_STATE_NAMES[state],
+                               was=_STATE_NAMES[rep.state])
+                rep.state = state
+        if telemetry._ENABLED:
+            for rep in self._reps:
+                telemetry.set_gauge("router_replica_health", rep.state,
+                                    replica=rep.name)
+            telemetry.set_gauge("router_fleet_queue_depth",
+                                len(self._queue))
+
+    def _kill_replica(self, idx: int):
+        """In-process `replica.kill`: mark the handle dead (there is no
+        separate process to SIGKILL) — failover rescues its work."""
+        rep = self._reps[idx % len(self._reps)]
+        rep.handle.dead = True
+
+    def _failover_dead(self, now: float) -> int:
+        """Resubmit every in-flight request held by a dead replica
+        (the idempotency token makes the resubmission safe even if the
+        old attempt's result later surfaces)."""
+        n = 0
+        for rep in self._reps:
+            if rep.state != DEAD or not rep.attempts:
+                continue
+            for fr, att in list(rep.attempts.values()):
+                self._drop_attempt(fr, att)
+                self.n_failovers += 1
+                n += 1
+                if telemetry._ENABLED:
+                    telemetry.inc("serve_failovers_total")
+                if _fl._ENABLED:
+                    _fl.record("route", "router.failover",
+                               token=fr.token, replica=rep.name)
+                self._retry(fr, now, f"replica {rep.name} dead")
+        return n
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _affinity_key(self, prompt) -> Optional[int]:
+        """Hash of the prompt's leading block-sized chunks — exactly
+        the prefix cache's chain keys, so equal keys mean shareable
+        blocks on whichever replica served the key last."""
+        if self.affinity_blocks <= 0:
+            return None
+        bs = self.block_size
+        for rep in self._reps:          # prefer a replica-reported size
+            if rep.detail and rep.detail.get("block_size"):
+                bs = int(rep.detail["block_size"])
+                break
+        n = (min(len(prompt), self.affinity_blocks * bs) // bs) * bs
+        if n == 0:
+            return None
+        return hash(tuple(int(t) for t in prompt[:n]))
+
+    def _eligible(self, rep: _Rep, now: float) -> bool:
+        if rep.state in (DEAD, DRAINING) or rep.detail is None:
+            return False
+        d = rep.detail
+        if not d.get("ok", False):
+            return False
+        slots = int(d.get("slots", 1))
+        cap = slots + (slots if self.per_replica_queue is None
+                       else self.per_replica_queue)
+        load = max(int(d.get("queued", 0)) + int(d.get("active", 0)),
+                   len(rep.attempts))
+        if load >= cap:
+            return False
+        return rep.breaker.allow(now)
+
+    def _load(self, rep: _Rep) -> tuple:
+        d = rep.detail or {}
+        load = max(int(d.get("queued", 0)) + int(d.get("active", 0)),
+                   len(rep.attempts))
+        return (load, float(d.get("queue_age_p95_s", 0.0)),
+                -int(d.get("blocks_free", 0)))
+
+    def _pick(self, fr: FleetRequest, now: float,
+              exclude=()) -> Optional[_Rep]:
+        elig = [rep for rep in self._reps
+                if rep not in exclude and self._eligible(rep, now)]
+        if not elig:
+            return None
+        key = self._affinity_key(fr.prompt)
+        if key is not None:
+            tgt = self._affinity.get(key)
+            if tgt is not None and tgt in elig:
+                self._affinity.move_to_end(key)
+                return tgt
+        best = min(elig, key=self._load)
+        if key is not None:
+            self._affinity[key] = best
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self.affinity_capacity:
+                self._affinity.popitem(last=False)
+        return best
+
+    def _dispatch(self, now: float) -> int:
+        n = 0
+        work = list(self._queue)
+        self._queue.clear()
+        keep = []
+        for fr in work:
+            if fr.terminal:
+                continue
+            if fr.next_eligible_t > now:
+                keep.append(fr)
+                continue
+            rep = self._pick(fr, now)
+            if rep is None:
+                keep.append(fr)
+                continue
+            if self._send(fr, rep, now):
+                n += 1
+            # on submit failure _send already re-routed fr via _retry
+        for fr in keep:
+            self._queue.append(fr)
+        return n
+
+    def _send(self, fr: FleetRequest, rep: _Rep, now: float,
+              hedge: bool = False) -> bool:
+        attempt_key = f"{fr.token}.{fr.tries}"
+        fr.tries += 1
+        deadline_s = None if fr.t_deadline is None \
+            else max(0.001, fr.t_deadline - now)
+        try:
+            sub = rep.handle.submit(fr, attempt_key, deadline_s)
+        except Exception as e:
+            rep.breaker.record_failure(now)
+            if _fl._ENABLED:
+                _fl.record("route", "router.submit_error",
+                           token=fr.token, replica=rep.name,
+                           error=repr(e)[:120])
+            if not hedge:
+                self._retry(fr, now, f"submit to {rep.name}: {e}")
+            return False
+        att = _Attempt(rep, sub, now, hedge)
+        fr.attempts.append(att)
+        rep.attempts[id(att)] = (fr, att)
+        fr.state = "inflight"
+        self._inflight[fr.token] = fr
+        if _fl._ENABLED:
+            _fl.record("route", "router.dispatch", token=fr.token,
+                       replica=rep.name, attempt=fr.tries - 1,
+                       hedge=hedge)
+        return True
+
+    # -- drive / collect -----------------------------------------------------
+
+    def _drive(self, now: float) -> int:
+        toks = 0
+        for rep in self._reps:
+            try:
+                toks += rep.handle.drive()
+            except Exception as e:
+                # a wedged local server (ServerStalledError etc.):
+                # treat like a death — failover will rescue its work
+                rep.handle.dead = True
+                rep.breaker.record_failure(now)
+                if _fl._ENABLED:
+                    _fl.record("route", "router.replica_error",
+                               replica=rep.name, error=repr(e)[:120])
+        return toks
+
+    def _drop_attempt(self, fr: FleetRequest, att: _Attempt,
+                      cancel: bool = False):
+        if att in fr.attempts:
+            fr.attempts.remove(att)
+        att.rep.attempts.pop(id(att), None)
+        if cancel:
+            try:
+                att.rep.handle.cancel(att.sub)
+            except Exception:
+                pass
+
+    def _retry(self, fr: FleetRequest, now: float, why: str):
+        """Requeue after a failed/lost attempt under capped-exponential
+        backoff; out of budget -> terminal ``failed``."""
+        if fr.terminal or fr.attempts:
+            return                      # a live attempt may still win
+        self._inflight.pop(fr.token, None)
+        if fr.t_deadline is not None and now > fr.t_deadline:
+            self._finalize(fr, _TIMED_OUT, "deadline", now)
+            return
+        if fr.retries >= self.max_retries:
+            self._finalize(fr, _FAILED, f"retries exhausted: {why}",
+                           now)
+            return
+        fr.retries += 1
+        fr.next_eligible_t = now + min(
+            self.backoff_max_s,
+            self.backoff_base_s * (2 ** (fr.retries - 1)))
+        fr.state = "queued"
+        self._queue.appendleft(fr)
+        self.n_retries += 1
+        if telemetry._ENABLED:
+            telemetry.inc("serve_retries_total")
+        if _fl._ENABLED:
+            _fl.record("route", "router.retry", token=fr.token,
+                       n=fr.retries, why=why[:120])
+
+    def _collect(self, now: float) -> int:
+        delivered = 0
+        for fr in list(self._inflight.values()):
+            for att in list(fr.attempts):
+                try:
+                    res = att.rep.handle.poll(att.sub)
+                except Exception:
+                    res = None
+                if res is None:
+                    if self.attempt_timeout_s is not None and \
+                            now - att.t0 > self.attempt_timeout_s:
+                        att.rep.breaker.record_failure(now)
+                        self._drop_attempt(fr, att, cancel=True)
+                        if _fl._ENABLED:
+                            _fl.record("route", "router.attempt_timeout",
+                                       token=fr.token,
+                                       replica=att.rep.name)
+                        self._retry(fr, now,
+                                    f"attempt timeout on {att.rep.name}")
+                    continue
+                if _ft._ACTIVE and \
+                        _ft.fire("router.drop") is not None:
+                    # injected lost reply: forget the result, abandon
+                    # the attempt, and let the retry + idempotency
+                    # machinery prove the request still finishes once
+                    att.rep.handle.discard(att.sub)
+                    self._drop_attempt(fr, att)
+                    self._retry(fr, now, "router.drop")
+                    continue
+                if res.get("status") == "ok":
+                    self._deliver(fr, att, res, now)
+                    delivered += 1
+                else:
+                    # timed_out / preempted / rejected / cancelled at
+                    # the replica: the attempt failed
+                    if res.get("status") != _CANCELLED:
+                        att.rep.breaker.record_failure(now)
+                    self._drop_attempt(fr, att)
+                    self._retry(fr, now,
+                                f"{res.get('status')} on {att.rep.name}")
+        return delivered
+
+    def _deliver(self, fr: FleetRequest, att: _Attempt, res: dict,
+                 now: float):
+        att.rep.breaker.record_success()
+        self._drop_attempt(fr, att)
+        if fr.terminal:
+            # idempotency: a late duplicate (the request already won
+            # elsewhere after a failover/drop) is ignored, not
+            # double-counted
+            self.n_duplicates += 1
+            if telemetry._ENABLED:
+                telemetry.inc("serve_duplicate_results_total")
+            return
+        fr.output_tokens = [int(t) for t in res.get("tokens", [])]
+        fr.replica = att.rep.name
+        if res.get("ttft") is not None:
+            fr.ttft_s = (att.t0 - fr.t_submit) + float(res["ttft"])
+        # hedge resolution: cancel the loser(s) before finalizing
+        for other in list(fr.attempts):
+            self._drop_attempt(fr, other, cancel=True)
+        self._finalize(fr, _OK, res.get("finish_reason"), now,
+                       won=("hedge" if att.hedge else "primary"))
+
+    def _finalize(self, fr: FleetRequest, status: str,
+                  reason: Optional[str], now: float,
+                  won: str = "none"):
+        for att in list(fr.attempts):
+            self._drop_attempt(fr, att, cancel=True)
+        self._inflight.pop(fr.token, None)
+        try:
+            self._queue.remove(fr)
+        except ValueError:
+            pass
+        fr.state = "finished"
+        fr.status = status
+        fr.finish_reason = reason
+        fr.t_finish = now
+        self.finished.append(fr)
+        if fr.hedged and telemetry._ENABLED:
+            telemetry.inc("serve_hedges_total", won=won)
+        if _fl._ENABLED:
+            _fl.record("route", "router.finish", token=fr.token,
+                       status=status, replica=fr.replica,
+                       tries=fr.tries)
+
+    # -- hedging / deadlines -------------------------------------------------
+
+    def _hedge_threshold(self, now: float) -> Optional[float]:
+        if self.hedge_after_s is None:
+            return None
+        if self.hedge_after_s == "auto":
+            p95s = [float(rep.detail.get("queue_age_p95_s", 0.0))
+                    for rep in self._reps if rep.detail is not None]
+            return max([self.hedge_min_s] + p95s)
+        return float(self.hedge_after_s)
+
+    def _hedge(self, now: float) -> int:
+        thr = self._hedge_threshold(now)
+        if thr is None:
+            return 0
+        n = 0
+        for fr in list(self._inflight.values()):
+            if fr.hedged or len(fr.attempts) != 1:
+                continue
+            att = fr.attempts[0]
+            if now - att.t0 < thr:
+                continue
+            rep = self._pick(fr, now, exclude=(att.rep,))
+            if rep is None:
+                continue
+            fr.hedged = True
+            self.n_hedges += 1
+            if _fl._ENABLED:
+                _fl.record("route", "router.hedge", token=fr.token,
+                           stuck_on=att.rep.name, to=rep.name,
+                           after_s=round(now - att.t0, 4))
+            if self._send(fr, rep, now, hedge=True):
+                n += 1
+            else:
+                fr.hedged = False       # try hedging again later
+        return n
+
+    def _expire(self, now: float):
+        for fr in list(self._queue) + list(self._inflight.values()):
+            if fr.t_deadline is not None and now > fr.t_deadline \
+                    and not fr.terminal:
+                self._finalize(fr, _TIMED_OUT, "deadline", now)
+
+    def cancel(self, fr: FleetRequest) -> bool:
+        """Cancel a fleet request wherever it is (queued or in
+        flight); True when it was still live."""
+        if fr.terminal:
+            return False
+        self._finalize(fr, _CANCELLED, "cancel", time.time())
+        return True
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _note_progress(self, progress: int, now: float):
+        if progress > 0 or not (self._queue or self._inflight):
+            self._last_progress_t = now
+            return
+        if now - self._last_progress_t > self.watchdog_s:
+            self._last_progress_t = now
+            if _fl._ENABLED:
+                _fl.record("stall", "router.watchdog",
+                           queued=len(self._queue),
+                           inflight=len(self._inflight))
+                _fl.dump(reason="router_stall")
+            raise RouterStalledError(
+                f"fleet router: no progress for {self.watchdog_s:.0f}s "
+                f"({len(self._queue)} queued, {len(self._inflight)} in "
+                "flight) — every replica is dead or wedged")
+
+    # -- fleet lifecycle -----------------------------------------------------
+
+    def rolling_restart(self, drain_timeout_s: float = 60.0,
+                        restart_timeout_s: float = 60.0):
+        """Drain-aware rolling restart, one replica at a time: flip it
+        to draining (its health source reports not-ready, so dispatch
+        stops), keep stepping the fleet until its work finishes, then
+        restart it and wait until it probes healthy again. Admission
+        to the OTHER replicas continues throughout."""
+        for rep in self._reps:
+            if _fl._ENABLED:
+                _fl.record("route", "router.drain", replica=rep.name)
+            try:
+                rep.handle.begin_drain()
+            except Exception:
+                pass
+            t0 = time.time()
+            while time.time() - t0 < drain_timeout_s:
+                self.step()
+                if rep.state == DEAD:
+                    break
+                d = rep.detail or {}
+                if not rep.attempts and d.get("draining") \
+                        and int(d.get("queued", 0)) == 0 \
+                        and int(d.get("active", 0)) == 0:
+                    break
+                time.sleep(self.poll_s)
+            rep.handle.restart()
+            rep.breaker = CircuitBreaker(rep.breaker.threshold,
+                                         rep.breaker.cooldown_s)
+            rep.detail = None
+            rep.last_seen = time.time()
+            if _fl._ENABLED:
+                _fl.record("route", "router.restart", replica=rep.name)
+            t0 = time.time()
+            while time.time() - t0 < restart_timeout_s:
+                self.step()
+                if rep.state == HEALTHY:
+                    break
+                time.sleep(self.poll_s)
+
+    def stop_fleet(self, timeout_ms: int = 10_000) -> dict:
+        """Send stop to every ProcReplica and collect their closing
+        stats dumps ({name: stats or None})."""
+        out = {}
+        for rep in self._reps:
+            h = rep.handle
+            if isinstance(h, ProcReplica):
+                h.stop()
+        for rep in self._reps:
+            h = rep.handle
+            if isinstance(h, ProcReplica):
+                out[rep.name] = None if h.dead \
+                    else h.final_stats(timeout_ms=timeout_ms)
+        return out
+
+    def stats(self) -> dict:
+        by_status: Dict[str, int] = {}
+        for fr in self.finished:
+            by_status[fr.status or _OK] = \
+                by_status.get(fr.status or _OK, 0) + 1
+        return {"ticks": self.ticks,
+                "queued": len(self._queue),
+                "inflight": len(self._inflight),
+                "finished": len(self.finished),
+                "status_counts": by_status,
+                "shed": self.n_shed, "retries": self.n_retries,
+                "failovers": self.n_failovers, "hedges": self.n_hedges,
+                "duplicates": self.n_duplicates,
+                "replicas": {rep.name: {
+                    "state": _STATE_NAMES[rep.state],
+                    "breaker": rep.breaker.state,
+                    "attempts": len(rep.attempts),
+                    "restarts": getattr(rep.handle, "restarts", 0),
+                } for rep in self._reps}}
+
+
+# -- the worker side ---------------------------------------------------------
+
+def run_fleet_worker(channel, name: str,
+                     server: Optional[InferenceServer] = None,
+                     server_factory=None, *,
+                     hb_interval_s: float = 0.1,
+                     idle_sleep_s: float = 0.002,
+                     max_wall_s: Optional[float] = None,
+                     warmup: bool = True):
+    """Drive one `InferenceServer` as a fleet replica against the kv
+    channel protocol (the counterpart of `ProcReplica`): consume the
+    ``cmd/<seq>`` stream in order, tick the server, publish per-attempt
+    results under ``res/<token>``, heartbeat `health_detail()` every
+    `hb_interval_s`. Results are remembered, so a duplicate submit for
+    an already-finished token republishes instead of recomputing —
+    the worker half of the idempotency contract.
+
+    Fault sites fire here when armed via ``MXNET_TPU_FAULTS`` in the
+    worker's environment: ``replica.kill`` / ``replica.stall`` are hit
+    once per PRODUCTIVE tick (tokens were emitted), so a kill always
+    lands mid-stream with real in-flight work for the router to
+    fail over. Returns the server on a clean ``stop``."""
+    if server is None:
+        if server_factory is None:
+            raise ValueError("need a server or a server_factory")
+        server = server_factory()
+    ns = f"fleet/{name}"
+    next_cmd = 0
+    live: Dict[str, object] = {}        # attempt token -> Request
+    done: Dict[str, str] = {}           # attempt token -> result json
+    last_hb = 0.0
+    t_start = time.time()
+    stopping = False
+    fatal: Optional[str] = None
+
+    if warmup:
+        # compile prefill + decode BEFORE the first heartbeat: the
+        # single-threaded worker cannot beat mid-compile, and a silent
+        # worker reads as dead — warming up front keeps the liveness
+        # signal honest. The compile discipline stays 1+1: this IS the
+        # one compile, every served request reuses it.
+        wreq = server.submit([1, 2], 2)
+        while wreq.state != "finished":
+            server.step()
+
+    def _beat(now, reason=None):
+        d = server.health_detail()
+        d["t"] = now
+        d["name"] = name
+        d["compile"] = server.compile_stats()
+        if reason is not None:
+            d["ok"] = False
+            d["reason"] = reason
+        channel.set(f"{ns}/hb", json.dumps(d))
+
+    while True:
+        now = time.time()
+        while True:                     # drain the command stream
+            raw = channel.get(f"{ns}/cmd/{next_cmd}", timeout_ms=0)
+            if raw is None:
+                break
+            next_cmd += 1
+            cmd = json.loads(raw)
+            op = cmd.get("op")
+            if op == "submit":
+                tok = cmd["token"]
+                if tok in done:         # idempotent republish
+                    channel.set(f"{ns}/res/{tok}", done[tok])
+                elif tok not in live:
+                    try:
+                        live[tok] = server.submit(
+                            cmd["prompt"], cmd["max_new"],
+                            temperature=cmd.get("temperature", 0.0),
+                            top_k=cmd.get("top_k", 0),
+                            top_p=cmd.get("top_p", 0.0),
+                            eos_id=cmd.get("eos_id"),
+                            seed=cmd.get("seed", 0),
+                            deadline_s=cmd.get("deadline_s"))
+                    except Exception as e:
+                        res = json.dumps(
+                            {"status": "rejected", "tokens": [],
+                             "finish_reason": f"submit: {e}"[:200]})
+                        done[tok] = res
+                        channel.set(f"{ns}/res/{tok}", res)
+            elif op == "cancel":
+                req = live.get(cmd.get("token"))
+                if req is not None:
+                    server.cancel(req.id)
+            elif op == "drain":
+                server.begin_drain()
+            elif op == "undrain":
+                server.end_drain()
+            elif op == "restart":
+                if server_factory is not None:
+                    telemetry.unregister_health_source(server)
+                    server = server_factory()
+                    live.clear()
+                else:
+                    server.end_drain()  # best effort: reopen admission
+            elif op == "stop":
+                stopping = True
+        emitted = 0
+        if server.queue or server._active.any():
+            try:
+                emitted = server.step()
+            except Exception as e:      # wedged server: report + die
+                fatal = repr(e)[:200]
+        if _ft._ACTIVE and emitted:
+            _ft.kill_point("replica.kill")
+            sp = _ft.fire("replica.stall")
+            if sp is not None:
+                time.sleep(float(sp.get("ms", 500)) / 1e3)
+        for tok, req in list(live.items()):
+            if req.state == "finished":
+                res = json.dumps(
+                    {"status": req.status,
+                     "tokens": [int(t) for t in req.output_tokens],
+                     "finish_reason": req.finish_reason,
+                     "ttft": getattr(req, "ttft", None)})
+                done[tok] = res
+                channel.set(f"{ns}/res/{tok}", res)
+                live.pop(tok)
+        if fatal is not None:
+            _beat(now, reason=f"fatal: {fatal}")
+            raise RuntimeError(f"fleet worker {name}: {fatal}")
+        if now - last_hb >= hb_interval_s or stopping:
+            _beat(now)
+            last_hb = now
+        if stopping:
+            channel.set(f"{ns}/stats",
+                        json.dumps({"name": name, **server.stats()}))
+            return server
+        if max_wall_s is not None and now - t_start > max_wall_s:
+            raise RuntimeError(f"fleet worker {name}: max_wall_s "
+                               f"{max_wall_s} exceeded")
+        if not emitted:
+            time.sleep(idle_sleep_s)
+
+
+def _worker_main(argv=None):
+    """Subprocess fleet-worker entry::
+
+        python -m mxnet_tpu.serving.router --dir /tmp/fleet --name r0 \\
+            --model llama_tiny --slots 4 --max-len 64 --block 8 \\
+            --max-prompt 16
+
+    Builds the model deterministically (seeded), then serves over a
+    `FileKV` channel rooted at ``--dir`` until a ``stop`` command.
+    ``--config`` takes LlamaConfig kwargs as JSON instead of a model
+    zoo name (the bench uses this to match its serve config)."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--model", default="llama_tiny")
+    ap.add_argument("--config", default=None,
+                    help="LlamaConfig kwargs as JSON (overrides --model)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--block", type=int, default=8)
+    ap.add_argument("--max-prompt", type=int, default=16)
+    ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-wall-s", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    mx.random.seed(args.seed)
+    if args.config:
+        from ..models.llama import LlamaConfig, LlamaForCausalLM
+        net = LlamaForCausalLM(LlamaConfig(**json.loads(args.config)))
+        net.initialize()
+    else:
+        net = mx.models.get_model(args.model)
+        net.initialize()
+    net(mx.nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize
+
+    def factory():
+        return InferenceServer(
+            net, batch_slots=args.slots, max_len=args.max_len,
+            block_size=args.block, max_prompt_len=args.max_prompt,
+            prefix_cache=args.prefix_cache)
+
+    run_fleet_worker(FileKV(args.dir), args.name,
+                     server_factory=factory,
+                     max_wall_s=args.max_wall_s)
+
+
+if __name__ == "__main__":
+    _worker_main()

@@ -49,7 +49,12 @@ requests finish with status ``timed_out``), a preemption retry cap
 consecutive zero-progress ticks with work pending, and
 :meth:`InferenceServer.drain` / :meth:`InferenceServer.shutdown` for
 graceful teardown (``submit`` after shutdown raises; stragglers are
-cancelled with status ``rejected``).
+cancelled with status ``rejected``). :meth:`InferenceServer.cancel`
+kills one queued/running request (status ``cancelled``, blocks freed
+with prefix refcounts respected) — the hedging loser's exit;
+:meth:`InferenceServer.begin_drain` / :meth:`end_drain` flip admission
+without stepping, and :meth:`health_detail` is the structured /healthz
+body the fleet router scores replicas by.
 """
 from __future__ import annotations
 
@@ -73,8 +78,8 @@ __all__ = ["Request", "InferenceServer", "ServerStalledError"]
 
 _QUEUED, _RUNNING, _FINISHED = "queued", "running", "finished"
 #: terminal statuses — set exactly once when a request leaves the system
-_OK, _TIMED_OUT, _PREEMPTED, _REJECTED = \
-    "ok", "timed_out", "preempted", "rejected"
+_OK, _TIMED_OUT, _PREEMPTED, _REJECTED, _CANCELLED = \
+    "ok", "timed_out", "preempted", "rejected", "cancelled"
 
 
 class ServerStalledError(RuntimeError):
@@ -108,7 +113,7 @@ class Request:
         self.tokens_counted = 0
         self.finish_reason: Optional[str] = None
         #: terminal outcome: "ok" | "timed_out" | "preempted" |
-        #: "rejected"; None while the request is still live
+        #: "rejected" | "cancelled"; None while the request is live
         self.status: Optional[str] = None
         self.t_submit = time.perf_counter()
         self.deadline_s = None if deadline_s is None else float(deadline_s)
@@ -727,7 +732,43 @@ class InferenceServer:
             raise
         return self.finished[done_before:]
 
+    def cancel(self, request_id: int) -> bool:
+        """Cancel one queued or running request: free its slot and KV
+        blocks (prefix-cache refcounts respected — shared blocks stay
+        registered for other holders) and finish it with status
+        ``cancelled``. True when the request was found live; False for
+        unknown / already-finished ids. This is the hedging loser's
+        exit and the operator's per-request kill switch."""
+        for slot in range(self.batch_slots):
+            req = self._slot_req[slot]
+            if req is not None and req.id == request_id:
+                self._finish(slot, "cancel", status=_CANCELLED)
+                self._update_gauges()
+                return True
+        for req in self.queue:
+            if req.id == request_id:
+                self.queue.remove(req)
+                self._terminate(req, "cancel", _CANCELLED)
+                self._update_gauges()
+                return True
+        return False
+
     # -- graceful teardown --------------------------------------------------
+
+    def begin_drain(self):
+        """Flip to draining WITHOUT stepping: submit() starts raising
+        and :meth:`health` reports not-ready, but already-accepted work
+        keeps running through the caller's own step()/run() loop. The
+        non-blocking half of :meth:`drain` — a fleet router uses it to
+        stop routing at a replica while it finishes in-flight work."""
+        self._draining = True
+
+    def end_drain(self):
+        """Reopen admission after :meth:`begin_drain` (a cancelled
+        rolling restart). Raises if the server is already shut down."""
+        if self._shutdown:
+            raise RuntimeError("cannot end_drain a shut-down server")
+        self._draining = False
 
     def drain(self, max_ticks: Optional[int] = None,
               deadline_s: Optional[float] = None) -> List[Request]:
@@ -783,6 +824,30 @@ class InferenceServer:
         if self._draining:
             return False, "draining: admission stopped"
         return True, "ok"
+
+    def health_detail(self) -> dict:
+        """Structured readiness detail for the /healthz JSON body (and
+        the fleet heartbeat): everything a router needs to score this
+        replica in ONE probe — readiness + why, drain state, queue ages,
+        blocks free, load, and the admission geometry."""
+        ok, reason = self.health()
+        now = time.perf_counter()
+        ages = [now - r.t_submit for r in self.queue]
+        return {"ok": ok, "reason": reason,
+                "draining": self._draining,
+                "shutdown": self._shutdown,
+                "stalled": self._stalled,
+                "queue_age_p50_s":
+                    float(np.percentile(ages, 50)) if ages else 0.0,
+                "queue_age_p95_s":
+                    float(np.percentile(ages, 95)) if ages else 0.0,
+                "blocks_free": self.cache.num_free_blocks,
+                "queued": len(self.queue),
+                "active": int(self._active.sum()),
+                "slots": self.batch_slots,
+                "block_size": self.block_size,
+                "max_prompt_len": self.max_prompt_len,
+                "max_len": self.max_len}
 
     def _assemble_trace(self, req: Request) -> dict:
         """The span timeline + derived latency breakdown for one traced
@@ -857,7 +922,7 @@ class InferenceServer:
 
     def stats(self) -> dict:
         by_status = {s: 0 for s in (_OK, _TIMED_OUT, _PREEMPTED,
-                                    _REJECTED)}
+                                    _REJECTED, _CANCELLED)}
         for r in self.finished:
             by_status[r.status or _OK] += 1
         # queue AGE (not just depth): p50/p95 of how long the queued

@@ -62,6 +62,7 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "start_metrics_server", "stop_metrics_server",
            "maybe_start_metrics_server",
            "register_health_source", "unregister_health_source", "health",
+           "health_report",
            "register_request_trace_source",
            "publish_snapshot", "aggregate_snapshot",
            "to_prometheus_merged",
@@ -793,6 +794,35 @@ def health() -> Tuple[bool, str]:
     return True, "ok"
 
 
+def health_report() -> dict:
+    """The structured /healthz body: merged ``ok``/``reason`` (as in
+    :func:`health`) plus one detail dict per registered source — from
+    its ``health_detail()`` when it has one (InferenceServer's carries
+    drain state, queue age p50/p95, blocks-free), else the bare
+    (ok, reason) pair. Routers and operators read this ONE probe
+    instead of scraping /metrics for the same numbers."""
+    ok, reason = True, "ok"
+    sources = []
+    for src in _live_sources(_HEALTH_SOURCES):
+        try:
+            s_ok, s_reason = src.health()
+        except Exception:
+            continue
+        detail = None
+        hd = getattr(src, "health_detail", None)
+        if hd is not None:
+            try:
+                detail = hd()
+            except Exception:
+                detail = None
+        if detail is None:
+            detail = {"ok": bool(s_ok), "reason": str(s_reason)}
+        sources.append(detail)
+        if ok and not s_ok:
+            ok, reason = False, str(s_reason)
+    return {"ok": ok, "reason": reason, "sources": sources}
+
+
 def register_request_trace_source(obj):
     """Register an object exposing `request_traces() -> [trace dict]`
     (InferenceServer); export_chrome_trace merges the spans under
@@ -831,11 +861,10 @@ class _MetricsServer:
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path.split("?")[0] == "/healthz":
-                    ok, reason = health()
-                    body = b"ok\n" if ok else (reason.rstrip("\n") +
-                                               "\n").encode()
-                    self.send_response(200 if ok else 503)
-                    self.send_header("Content-Type", "text/plain")
+                    rep = health_report()
+                    body = (json.dumps(rep) + "\n").encode()
+                    self.send_response(200 if rep["ok"] else 503)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found\n"
                     self.send_response(404)

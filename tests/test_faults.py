@@ -264,3 +264,34 @@ def test_multihost_break_site(monkeypatch):
     with pytest.raises(RuntimeError, match="deliberately broken"):
         multihost.initialize()
     assert not multihost._initialized
+
+
+# -- fleet sites (replica.* / router.*) --------------------------------------
+
+def test_fleet_sites_registered():
+    for s in ("replica.kill", "replica.stall", "router.drop"):
+        assert s in faults.SITES
+
+
+def test_fleet_site_env_grammar_with_payloads():
+    faults.configure("replica.kill:at=6:replica=0;"
+                     "replica.stall:ms=20:replica=1;router.drop:at=2")
+    sp = faults.specs()
+    assert sp["replica.kill"] == {"at": 6, "replica": 0}
+    assert sp["replica.stall"] == {"ms": 20, "replica": 1}
+    assert sp["router.drop"] == {"at": 2}
+    tm.enable()
+    assert faults.fire("router.drop") is None      # hit 1 of at=2
+    pay = faults.fire("router.drop")
+    assert pay == {"at": 2}
+    assert faults.fire("router.drop") is None      # at= implies times=1
+    snap = tm.snapshot()["counters"]
+    assert snap["faults_injected_total{site=router.drop}"] == 1.0
+
+
+def test_replica_stall_payload_rides_through_fire():
+    faults.inject("replica.stall", replica=1, ticks=7)
+    pay = faults.fire("replica.stall")
+    assert pay == {"replica": 1, "ticks": 7}
+    pay = faults.fire("replica.stall")             # bare trigger: again
+    assert pay == {"replica": 1, "ticks": 7}

@@ -1,0 +1,622 @@
+"""Resilient serving fleet (mxnet_tpu.serving.router): circuit-breaker
+state machine, FileKV channel semantics, least-loaded + prefix-affinity
+dispatch, load shedding accounting, failover/retry with idempotent
+result dedupe, hedging, drain-aware rolling restart, and the router
+watchdog. Fast scenario tests run against fake replica handles; the
+token-parity and fault-site tests run real `InferenceServer` replicas
+on the CPU mesh (conftest)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.models.llama_infer import generate
+from mxnet_tpu.serving import InferenceServer
+from mxnet_tpu.serving.router import (
+    CircuitBreaker, FileKV, FleetRouter, LocalReplica, ProcReplica,
+    RouterStalledError, run_fleet_worker,
+    HEALTHY, DRAINING, UNHEALTHY, DEAD)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    n = mx.models.get_model("llama_tiny")
+    n.initialize()
+    n(mx.nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize
+    return n
+
+
+# -- fake replica handles ----------------------------------------------------
+
+class _FakeSub:
+    def __init__(self, fr, ticks):
+        self.ticks_left = ticks
+        self.cancelled = False
+        # deterministic function of the prompt: any replica computes
+        # the same output (the greedy-determinism stand-in)
+        self.tokens = [(int(fr.prompt[0]) + i + 1) % 97
+                       for i in range(fr.max_new_tokens)]
+
+
+class FakeReplica:
+    """Minimal replica handle: each request finishes after
+    `latency_ticks` drive() calls."""
+
+    def __init__(self, name, latency_ticks=1, slots=4):
+        self.name = name
+        self.dead = False
+        self.draining = False
+        self.restarts = 0
+        self.slots = slots
+        self.latency_ticks = latency_ticks
+        self.fail_submits = 0           # raise on the next N submits
+        self.submitted = 0
+        self._stall_ticks_left = 0
+        self._subs = []
+        self._dropped = set()
+
+    def _active(self):
+        return sum(1 for s in self._subs
+                   if s.ticks_left > 0 and not s.cancelled)
+
+    def probe(self, now):
+        if self.dead:
+            return None
+        return {"ok": not self.draining,
+                "reason": "draining" if self.draining else "ok",
+                "draining": self.draining, "queue_age_p50_s": 0.0,
+                "queue_age_p95_s": 0.0, "blocks_free": 100,
+                "queued": 0, "active": self._active(),
+                "slots": self.slots, "block_size": 4, "t": now}
+
+    def submit(self, fr, attempt_key, deadline_s):
+        if self.dead:
+            raise RuntimeError(f"{self.name} is dead")
+        if self.fail_submits > 0:
+            self.fail_submits -= 1
+            raise RuntimeError("injected submit failure")
+        sub = _FakeSub(fr, self.latency_ticks)
+        self._subs.append(sub)
+        self.submitted += 1
+        return sub
+
+    def drive(self):
+        if self.dead:
+            return 0
+        if self._stall_ticks_left > 0:
+            self._stall_ticks_left -= 1
+            return 0
+        toks = 0
+        for s in self._subs:
+            if s.ticks_left > 0 and not s.cancelled:
+                s.ticks_left -= 1
+                toks += 1
+        return toks
+
+    def poll(self, sub):
+        if sub.ticks_left > 0 or sub.cancelled \
+                or id(sub) in self._dropped:
+            return None
+        return {"status": "ok", "tokens": sub.tokens,
+                "finish_reason": "length"}
+
+    def discard(self, sub):
+        self._dropped.add(id(sub))
+
+    def cancel(self, sub):
+        if sub.ticks_left > 0:          # finished results stay (like a
+            sub.cancelled = True        # server's completed Request)
+
+    def begin_drain(self):
+        self.draining = True
+
+    def end_drain(self):
+        self.draining = False
+
+    def restart(self):
+        self.dead = False
+        self.draining = False
+        self._stall_ticks_left = 0
+        self._subs = []
+        self._dropped = set()
+        self.restarts += 1
+
+
+def _fleet(reps, **kw):
+    kw.setdefault("affinity_blocks", 0)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.01)
+    kw.setdefault("watchdog_s", 5.0)
+    return FleetRouter(reps, **kw)
+
+
+def _prompt(v, n=4):
+    return np.full(n, v, np.int32)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_opens_at_threshold_and_half_open_probe():
+    br = CircuitBreaker(threshold=3, cooldown_s=1.0)
+    assert br.state == br.CLOSED and br.allow(0.0)
+    br.record_failure(0.0)
+    br.record_failure(0.1)
+    assert br.state == br.CLOSED and br.allow(0.1)
+    br.record_failure(0.2)              # third consecutive: open
+    assert br.state == br.OPEN
+    assert not br.allow(0.5)            # still cooling down
+    assert br.allow(1.3)                # cooldown over: half-open probe
+    assert br.state == br.HALF_OPEN
+    assert not br.allow(1.3)            # single probe slot consumed
+    br.record_success()
+    assert br.state == br.CLOSED and br.failures == 0
+    assert br.allow(1.4)
+
+
+def test_breaker_half_open_failure_reopens():
+    br = CircuitBreaker(threshold=1, cooldown_s=0.5)
+    br.record_failure(0.0)
+    assert br.state == br.OPEN
+    assert br.allow(0.6)                # probe
+    br.record_failure(0.6)              # probe failed: reopen
+    assert br.state == br.OPEN
+    assert not br.allow(1.0)            # cooldown restarted at 0.6
+    assert br.allow(1.2)
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(threshold=2)
+    br.record_failure(0.0)
+    br.record_success()
+    br.record_failure(0.1)              # streak restarted: stays closed
+    assert br.state == br.CLOSED
+
+
+# -- FileKV channel ----------------------------------------------------------
+
+def test_filekv_set_get_dir_delete(tmp_path):
+    kv = FileKV(str(tmp_path))
+    assert kv.get("missing") is None
+    t0 = time.perf_counter()
+    assert kv.get("missing", timeout_ms=30) is None
+    assert time.perf_counter() - t0 >= 0.025
+    kv.set("fleet/r0/hb", "beat")
+    assert kv.get("fleet/r0/hb") == "beat"
+    kv.set("fleet/r0/hb", "beat2")      # atomic overwrite
+    assert kv.get("fleet/r0/hb") == "beat2"
+    kv.set("fleet/r0/res/a", "1")
+    kv.set("fleet/r0/res/b", "2")
+    got = kv.dir("fleet/r0/res")
+    assert got == [("fleet/r0/res/a", "1"), ("fleet/r0/res/b", "2")]
+    assert kv.dir("fleet/r0/nothing") == []
+    assert kv.delete("fleet/r0/res/a")
+    assert not kv.delete("fleet/r0/res/a")
+    assert kv.get("fleet/r0/res/a") is None
+
+
+def test_filekv_key_escape_guard(tmp_path):
+    kv = FileKV(str(tmp_path / "root"))
+    with pytest.raises(ValueError, match="escapes"):
+        kv.set("../outside", "x")
+
+
+def test_filekv_dir_skips_inflight_tmp_writes(tmp_path):
+    kv = FileKV(str(tmp_path))
+    kv.set("res/a", "1")
+    # a writer mid-set: temp file present, rename not yet done
+    (tmp_path / "res" / "b.__tmp999").write_text("torn")
+    assert kv.dir("res") == [("res/a", "1")]
+
+
+# -- dispatch: least-loaded + prefix affinity --------------------------------
+
+def test_least_loaded_dispatch_spreads_work():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1])
+    frs = [fleet.submit(_prompt(i), 4) for i in range(4)]
+    fleet.run(timeout_s=5)
+    assert [fr.status for fr in frs] == ["ok"] * 4
+    assert r0.submitted == 2 and r1.submitted == 2
+    assert sorted({fr.replica for fr in frs}) == ["r0", "r1"]
+
+
+def test_affinity_routes_shared_prefix_to_same_replica():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1], affinity_blocks=1, block_size=4)
+    P, Q, R = _prompt(9), _prompt(1), _prompt(2)
+    a = fleet.submit(P, 4)              # first pick: r0 (tie)
+    b = fleet.submit(Q, 4)              # least-loaded: r1
+    d = fleet.submit(R, 4)              # tie again: r0 (now load 2 vs 1)
+    c = fleet.submit(P, 4)              # affinity beats least-loaded
+    fleet.run(timeout_s=5)
+    assert a.replica == "r0" and b.replica == "r1"
+    assert c.replica == "r0", "shared prefix must follow its cache"
+    assert d.replica == "r0"
+
+
+def test_affinity_degrades_when_target_unhealthy_and_rebinds():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1], affinity_blocks=1, block_size=4)
+    P = _prompt(9)
+    a = fleet.submit(P, 4)
+    fleet.run(timeout_s=5)
+    assert a.replica == "r0"
+    r0.begin_drain()                    # affinity target goes not-ready
+    b = fleet.submit(P, 4)
+    fleet.run(timeout_s=5)
+    assert b.replica == "r1", "must degrade to least-loaded"
+    r0.end_drain()                      # target healthy again...
+    c = fleet.submit(P, 4)
+    fleet.run(timeout_s=5)
+    assert c.replica == "r1", "...but the prefix re-bound to r1"
+
+
+def test_affinity_key_respects_block_math():
+    fleet = _fleet([FakeReplica("r0")], affinity_blocks=2)
+    # FakeReplica probes report block_size=4 once refreshed; before any
+    # probe the router's configured block_size applies
+    fleet.step()
+    assert fleet._affinity_key(np.arange(3)) is None   # < one block
+    k1 = fleet._affinity_key(np.arange(8))
+    k2 = fleet._affinity_key(np.arange(8))
+    assert k1 == k2 is not None
+    # only the leading affinity_blocks*block_size tokens matter
+    long = np.concatenate([np.arange(8), np.array([99, 98])])
+    assert fleet._affinity_key(long) == k1
+    fleet.affinity_blocks = 0
+    assert fleet._affinity_key(np.arange(8)) is None
+
+
+# -- load shedding -----------------------------------------------------------
+
+def test_shed_rejects_over_bounded_queue_and_accounts_all():
+    telemetry.enable()
+    r0 = FakeReplica("r0")
+    fleet = _fleet([r0], max_fleet_queue=2)
+    frs = [fleet.submit(_prompt(i), 4) for i in range(5)]
+    shed = [fr for fr in frs if fr.status == "rejected"]
+    assert len(shed) == 3
+    for fr in shed:                     # shed never raises: terminal
+        assert fr.terminal and fr.state == "finished"
+        assert fr.finish_reason == "shed" and fr.output_tokens == []
+    # every rejection is accounted, nowhere else
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve_shed_total"] == 3.0
+    assert fleet.n_shed == 3 == fleet.stats()["shed"]
+    fleet.run(timeout_s=5)
+    assert [fr.status for fr in frs if fr not in shed] == ["ok", "ok"]
+    assert fleet.stats()["status_counts"] == {"rejected": 3, "ok": 2}
+
+
+# -- failover / retries / idempotency ----------------------------------------
+
+def test_failover_rescues_inflight_from_dead_replica():
+    telemetry.enable()
+    r0 = FakeReplica("r0", latency_ticks=10 ** 6)   # never finishes
+    r1 = FakeReplica("r1", latency_ticks=1)
+    fleet = _fleet([r0, r1])
+    fr = fleet.submit(_prompt(7), 4)
+    fleet.step()                        # dispatched to r0 (tie)
+    assert r0.submitted == 1
+    r0.dead = True                      # SIGKILL stand-in
+    fleet.run(timeout_s=5)
+    assert fr.status == "ok" and fr.replica == "r1"
+    assert fr.retries == 1 and fleet.n_failovers == 1
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve_failovers_total"] == 1.0
+    assert snap["serve_retries_total"] == 1.0
+    assert fleet.stats()["replicas"]["r0"]["state"] == "dead"
+
+
+def test_late_duplicate_result_is_ignored_not_double_counted():
+    telemetry.enable()
+    # both attempts of a hedged request finish on the same tick (the
+    # hedge dispatches one tick after the primary, one tick faster):
+    # the second result hits a terminal request and must be dropped
+    r0 = FakeReplica("r0", latency_ticks=2)
+    r1 = FakeReplica("r1", latency_ticks=1)
+    fleet = _fleet([r0, r1], hedge_after_s=0.0)
+    fr = fleet.submit(_prompt(3), 4)
+    fleet.run(timeout_s=5)
+    assert fr.status == "ok" and fr.hedged
+    assert r0.submitted == 1 and r1.submitted == 1
+    assert fleet.n_duplicates == 1
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve_duplicate_results_total"] == 1.0
+    # exactly one delivery: the fleet finished exactly one request
+    assert len(fleet.finished) == 1
+
+
+def test_retry_budget_exhaustion_fails_request():
+    r0 = FakeReplica("r0")
+    r0.fail_submits = 10 ** 6
+    fleet = _fleet([r0], max_retries=2, breaker_threshold=10 ** 6)
+    fr = fleet.submit(_prompt(5), 4)
+    fleet.run(timeout_s=5)
+    assert fr.status == "failed"
+    assert fr.retries == 2 == fleet.n_retries
+    assert "retries exhausted" in fr.finish_reason
+
+
+def test_attempt_timeout_retries_elsewhere():
+    r0 = FakeReplica("r0", latency_ticks=10 ** 6)
+    r1 = FakeReplica("r1", latency_ticks=1)
+    fleet = _fleet([r0, r1], attempt_timeout_s=0.05,
+                   breaker_threshold=1)
+    fr = fleet.submit(_prompt(6), 4)
+    fleet.step()
+    assert r0.submitted == 1
+    fleet.run(timeout_s=5)
+    assert fr.status == "ok" and fr.replica == "r1"
+    assert fleet.n_retries == 1
+    # the stuck attempt was cancelled at its replica
+    assert r0._subs[0].cancelled
+
+
+def test_router_drop_fault_retries_and_completes_once():
+    telemetry.enable()
+    r0 = FakeReplica("r0", latency_ticks=1)
+    fleet = _fleet([r0], breaker_threshold=10 ** 6)
+    faults.inject("router.drop", at=1)
+    fr = fleet.submit(_prompt(8), 4)
+    fleet.run(timeout_s=5)
+    assert fr.status == "ok"
+    assert r0.submitted == 2            # dropped reply forced a retry
+    assert fleet.n_retries == 1 and fleet.n_duplicates == 0
+    assert len(fleet.finished) == 1
+    snap = telemetry.snapshot()["counters"]
+    assert snap["faults_injected_total{site=router.drop}"] == 1.0
+
+
+# -- circuit breaker in the routing loop -------------------------------------
+
+def test_submit_failures_open_breaker_and_divert_traffic():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    r0.fail_submits = 2
+    fleet = _fleet([r0, r1], breaker_threshold=2,
+                   breaker_cooldown_s=60.0)
+    frs = [fleet.submit(_prompt(i), 4) for i in range(3)]
+    fleet.run(timeout_s=5)
+    assert [fr.status for fr in frs] == ["ok"] * 3
+    assert all(fr.replica == "r1" for fr in frs)
+    assert r0.submitted == 0
+    st = fleet.stats()["replicas"]["r0"]
+    assert st["breaker"] == "open" and st["state"] == "unhealthy"
+
+
+def test_breaker_half_open_probe_recloses_after_recovery():
+    r0 = FakeReplica("r0")
+    r0.fail_submits = 1
+    fleet = _fleet([r0], breaker_threshold=1, breaker_cooldown_s=0.05,
+                   max_retries=5)
+    fr = fleet.submit(_prompt(4), 4)
+    fleet.run(timeout_s=5)              # fail -> open -> probe -> ok
+    assert fr.status == "ok" and r0.submitted == 1
+    assert fleet._reps[0].breaker.state == CircuitBreaker.CLOSED
+
+
+# -- hedging -----------------------------------------------------------------
+
+def test_hedge_duplicates_stuck_request_and_cancels_loser():
+    telemetry.enable()
+    r0 = FakeReplica("r0", latency_ticks=10 ** 6)   # wedged but alive
+    r1 = FakeReplica("r1", latency_ticks=1)
+    fleet = _fleet([r0, r1], hedge_after_s=0.02)
+    fr = fleet.submit(_prompt(2), 4)
+    fleet.step()
+    assert r0.submitted == 1            # primary went to r0
+    fleet.run(timeout_s=5)
+    assert fr.status == "ok" and fr.replica == "r1" and fr.hedged
+    assert fleet.n_hedges == 1
+    assert r0._subs[0].cancelled, "losing attempt must be cancelled"
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve_hedges_total{won=hedge}"] == 1.0
+
+
+def test_hedge_auto_threshold_uses_fleet_queue_age_p95():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1], hedge_after_s="auto", hedge_min_s=0.07)
+    fleet.step()
+    assert fleet._hedge_threshold(0.0) == 0.07      # floored
+    fleet._reps[0].detail["queue_age_p95_s"] = 0.5
+    assert fleet._hedge_threshold(0.0) == 0.5
+    fleet.hedge_after_s = None
+    assert fleet._hedge_threshold(0.0) is None
+
+
+# -- lifecycle: cancel, drain, rolling restart, watchdog ---------------------
+
+def test_fleet_cancel_queued_and_inflight():
+    r0 = FakeReplica("r0", latency_ticks=10 ** 6)
+    fleet = _fleet([r0])
+    a = fleet.submit(_prompt(1), 4)
+    assert fleet.cancel(a)              # still queued
+    assert a.status == "cancelled" and a.state == "finished"
+    assert not fleet.cancel(a)          # already terminal
+    b = fleet.submit(_prompt(2), 4)
+    fleet.step()                        # now in flight on r0
+    assert fleet.cancel(b)
+    assert b.status == "cancelled"
+    assert r0._subs[-1].cancelled       # cancel propagated down
+    assert not fleet._queue and not fleet._inflight
+
+
+def test_rolling_restart_drains_then_restarts_each_replica():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1])
+    frs = [fleet.submit(_prompt(i), 4) for i in range(4)]
+    fleet.run(timeout_s=5)
+    fleet.rolling_restart(drain_timeout_s=2, restart_timeout_s=2)
+    assert r0.restarts == 1 and r1.restarts == 1
+    st = fleet.stats()["replicas"]
+    assert st["r0"]["state"] == "healthy"
+    assert st["r1"]["state"] == "healthy"
+    assert not r0.draining and not r1.draining  # drain was lifted
+    more = [fleet.submit(_prompt(i + 10), 4) for i in range(2)]
+    fleet.run(timeout_s=5)
+    assert all(fr.status == "ok" for fr in frs + more)
+
+
+def test_draining_replica_gets_no_new_work():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1])
+    r0.begin_drain()
+    frs = [fleet.submit(_prompt(i), 4) for i in range(4)]
+    fleet.run(timeout_s=5)
+    assert all(fr.replica == "r1" for fr in frs)
+    assert r0.submitted == 0
+    assert fleet.stats()["replicas"]["r0"]["state"] == "draining"
+
+
+def test_watchdog_trips_when_whole_fleet_is_dead():
+    r0 = FakeReplica("r0")
+    r0.dead = True
+    fleet = _fleet([r0], watchdog_s=0.05)
+    fleet.submit(_prompt(1), 4)
+    with pytest.raises(RouterStalledError, match="no progress"):
+        fleet.run(timeout_s=5)
+
+
+def test_replica_names_must_be_unique():
+    with pytest.raises(ValueError, match="unique"):
+        _fleet([FakeReplica("r"), FakeReplica("r")])
+
+
+def test_health_state_gauges_exported():
+    telemetry.enable()
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    r1.dead = True
+    fleet = _fleet([r0, r1])
+    fleet.step()
+    g = telemetry.snapshot()["gauges"]
+    assert g["router_replica_health{replica=r0}"] == HEALTHY
+    assert g["router_replica_health{replica=r1}"] == DEAD
+    assert g["router_fleet_queue_depth"] == 0.0
+
+
+# -- real replicas: token parity, in-process fault sites ---------------------
+
+def _mk_server(net, **kw):
+    args = dict(batch_slots=4, max_len=64, block_size=8,
+                max_prompt_len=12)
+    args.update(kw)
+    return InferenceServer(net, **args)
+
+
+def _mixed(fleet, rs, n):
+    out = []
+    for _ in range(n):
+        p = rs.randint(0, 256, rs.randint(2, 10)).astype(np.int32)
+        new = int(rs.randint(4, 12))
+        out.append((p, new, fleet.submit(p, new)))
+    return out
+
+
+def test_fleet_local_token_parity_both_replicas(net):
+    """Routing must not change tokens: greedy requests served by a
+    2-replica fleet match per-request one-shot generate()."""
+    rs = np.random.RandomState(41)
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a"),
+                         LocalReplica(_mk_server(net), name="b")],
+                        affinity_blocks=0)
+    reqs = _mixed(fleet, rs, 10)
+    fleet.run(timeout_s=120)
+    assert {fr.replica for _, _, fr in reqs} == {"a", "b"}
+    for p, new, fr in reqs:
+        assert fr.status == "ok", fr
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(fr.output_tokens), one[0, len(p):],
+            err_msg=f"{fr.token} diverged from one-shot generate")
+
+
+def test_fleet_inprocess_replica_kill_failover(net):
+    """`replica.kill` on an in-process fleet marks the handle dead at
+    the router tick; every rescued request still finishes with the
+    same tokens as one-shot generate()."""
+    rs = np.random.RandomState(42)
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a"),
+                         LocalReplica(_mk_server(net), name="b")],
+                        affinity_blocks=0, backoff_base_s=0.001)
+    reqs = _mixed(fleet, rs, 6)
+    fleet.step()                        # spread the first dispatches
+    faults.inject("replica.kill", at=3, replica=0)
+    fleet.run(timeout_s=120)
+    assert fleet.n_failovers >= 1, fleet.stats()
+    assert fleet.stats()["replicas"]["a"]["state"] == "dead"
+    for p, new, fr in reqs:
+        # nothing lost, nothing duplicated, tokens unchanged — whether
+        # the request finished on `a` before the kill or was rescued
+        assert fr.status == "ok", fr
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(fr.output_tokens), one[0, len(p):])
+    assert len(fleet.finished) == 6
+
+
+def test_fleet_inprocess_replica_stall_hedges(net):
+    """`replica.stall` wedges one replica without killing its health
+    probe — exactly the case failover can't see and hedging can."""
+    rs = np.random.RandomState(43)
+    telemetry.enable()
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a"),
+                         LocalReplica(_mk_server(net), name="b")],
+                        affinity_blocks=0, hedge_after_s=0.05)
+    reqs = _mixed(fleet, rs, 4)
+    faults.inject("replica.stall", replica=0, ticks=10 ** 6)
+    fleet.run(timeout_s=120)
+    assert fleet.n_hedges >= 1, fleet.stats()
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("serve_hedges_total{won=hedge}", 0) >= 1
+    for p, new, fr in reqs:
+        assert fr.status == "ok", fr
+        assert fr.replica == "b"
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(fr.output_tokens), one[0, len(p):])
+
+
+def test_proc_replica_protocol_over_filekv_thread(net, tmp_path):
+    """The kv-channel protocol end to end without subprocess cost: a
+    worker thread serves over FileKV, the router speaks ProcReplica."""
+    kv = FileKV(str(tmp_path))
+    t = threading.Thread(
+        target=run_fleet_worker, args=(kv, "w0"),
+        kwargs=dict(server=_mk_server(net), hb_interval_s=0.02,
+                    max_wall_s=120.0),
+        daemon=True)
+    t.start()
+    try:
+        fleet = FleetRouter([ProcReplica(kv, "w0")],
+                            heartbeat_timeout_s=60.0,
+                            affinity_blocks=0)
+        rs = np.random.RandomState(44)
+        reqs = _mixed(fleet, rs, 3)
+        fleet.run(timeout_s=120)
+        for p, new, fr in reqs:
+            assert fr.status == "ok", fr
+            one = generate(net, p[None, :], max_new_tokens=new,
+                           max_len=64)
+            np.testing.assert_array_equal(
+                np.asarray(fr.output_tokens), one[0, len(p):])
+        final = fleet.stop_fleet(timeout_ms=30_000)
+        assert final["w0"] is not None
+        assert final["w0"]["status_counts"]["ok"] >= 3
+    finally:
+        t.join(timeout=30)
+    assert not t.is_alive(), "worker must exit on stop"

@@ -575,7 +575,8 @@ def test_request_terminal_status_ok(net):
     server.run()
     assert all(r.status == "ok" for r in reqs)
     st = server.stats()["status_counts"]
-    assert st == {"ok": 3, "timed_out": 0, "preempted": 0, "rejected": 0}
+    assert st == {"ok": 3, "timed_out": 0, "preempted": 0, "rejected": 0,
+                  "cancelled": 0}
 
 
 def test_deadline_expires_queued_request(net):
@@ -1241,7 +1242,12 @@ def test_watchdog_stall_flight_dump(net, tmp_path, monkeypatch):
 
 
 def test_chrome_trace_merges_request_spans(net, tmp_path):
+    import gc
     import json
+    # the export merges EVERY live trace source (weakref registry) —
+    # collect cyclic garbage so earlier tests' dead servers are gone
+    # before the exact-equality tid assertion below
+    gc.collect()
     telemetry.reset()
     telemetry.enable()
     try:
@@ -1270,3 +1276,163 @@ def test_chrome_trace_merges_request_spans(net, tmp_path):
         telemetry.disable()
         telemetry.reset()
         telemetry.unregister_health_source(server)
+
+
+# -- cancel / drain / health detail (fleet satellites) -----------------------
+
+def test_server_cancel_running_and_queued(net):
+    rs = np.random.RandomState(50)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    reqs = [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                          max_new_tokens=8) for _ in range(3)]
+    server.step()                      # r0, r1 running; r2 queued
+    used = server.cache.num_used_blocks
+    assert server.cancel(reqs[0].id)
+    assert reqs[0].state == "finished"
+    assert reqs[0].status == "cancelled"
+    assert reqs[0].finish_reason == "cancel"
+    assert server.cache.num_used_blocks < used   # blocks released
+    assert server.cancel(reqs[2].id)   # cancel straight out of the queue
+    assert reqs[2].status == "cancelled"
+    assert not server.cancel(reqs[0].id)         # already finished
+    assert not server.cancel(10 ** 9)            # unknown id
+    server.run()
+    assert reqs[1].status == "ok"      # the survivor is unaffected
+    assert server.stats()["status_counts"]["cancelled"] == 2
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_server_health_detail_structure(net):
+    import time as _time
+    rs = np.random.RandomState(51)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8)
+    d = server.health_detail()
+    assert d["ok"] is True and d["reason"] == "ok"
+    assert not d["draining"] and not d["shutdown"] and not d["stalled"]
+    assert d["slots"] == 2 and d["block_size"] == 8
+    assert d["max_prompt_len"] == 8 and d["max_len"] == 32
+    assert d["queued"] == 0 and d["active"] == 0
+    assert d["blocks_free"] == server.cache.num_free_blocks
+    server.begin_drain()               # non-blocking drain flip
+    d = server.health_detail()
+    assert d["draining"] and d["ok"] is False
+    assert "draining" in d["reason"]
+    server.end_drain()
+    assert server.health_detail()["ok"] is True
+    [server.submit(rs.randint(0, 256, 4).astype(np.int32),
+                   max_new_tokens=4) for _ in range(5)]
+    server.step()
+    _time.sleep(0.01)
+    d = server.health_detail()
+    assert d["active"] == 2 and d["queued"] == 3
+    assert d["queue_age_p95_s"] >= d["queue_age_p50_s"] > 0
+    server.run()
+    server.shutdown()
+    with pytest.raises(RuntimeError, match="shut-down"):
+        server.end_drain()
+
+
+# -- subprocess fleet: SIGKILL one replica, zero requests lost ---------------
+
+import os as _os
+import signal
+import subprocess as _subprocess
+import sys as _sys
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+def _spawn_fleet_worker(d, name, fault=None, max_wall_s=240):
+    env = dict(_os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_FAULTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault:
+        env["MXNET_TPU_FAULTS"] = fault
+    log = open(_os.path.join(d, f"{name}.log"), "w")
+    return _subprocess.Popen(
+        [_sys.executable, "-u", "-m", "mxnet_tpu.serving.router",
+         "--dir", d, "--name", name, "--slots", "4", "--max-len", "64",
+         "--block", "8", "--max-prompt", "12",
+         "--max-wall-s", str(max_wall_s)],
+        stdout=log, stderr=log, env=env, cwd=_REPO)
+
+
+def test_fleet_subprocess_kill_failover_zero_lost(net, tmp_path):
+    """The fleet acceptance bar: two subprocess replicas over the
+    FileKV channel, one SIGKILLed mid-stream by `replica.kill` — every
+    request still finishes exactly once with tokens identical to
+    one-shot generate(), and the survivor stays at ONE prefill + ONE
+    decode compile (its warmup)."""
+    import time as _time
+    from mxnet_tpu.serving.router import FileKV, FleetRouter, ProcReplica
+
+    d = str(tmp_path)
+    kv = FileKV(d)
+    procs = [_spawn_fleet_worker(d, "w0",
+                                 fault="replica.kill:at=6"),
+             _spawn_fleet_worker(d, "w1")]
+    try:
+        # wait until both replicas warmed up and published a heartbeat
+        # (workers warm-compile BEFORE the first beat), so the kill
+        # target is guaranteed to receive live traffic
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 180:
+            if all(kv.get(f"fleet/w{i}/hb") is not None
+                   for i in range(2)):
+                break
+            for i, p in enumerate(procs):
+                if p.poll() is not None:   # died before serving
+                    pytest.fail(f"worker w{i} exited rc={p.returncode} "
+                                "during warmup: " + open(_os.path.join(
+                                    d, f"w{i}.log")).read()[-2000:])
+            _time.sleep(0.05)
+        else:
+            pytest.fail("fleet workers never became healthy: "
+                        + open(_os.path.join(d, "w0.log")).read()[-2000:])
+
+        fleet = FleetRouter([ProcReplica(kv, "w0"),
+                             ProcReplica(kv, "w1")],
+                            affinity_blocks=0, backoff_base_s=0.01,
+                            heartbeat_timeout_s=2.0)
+        rs = np.random.RandomState(52)
+        reqs = []
+        for _ in range(8):
+            p = rs.randint(0, 256, rs.randint(2, 10)).astype(np.int32)
+            new = int(rs.randint(8, 14))
+            reqs.append((p, new, fleet.submit(p, new)))
+        fleet.run(timeout_s=240)
+
+        # zero lost, zero duplicated
+        assert len(fleet.finished) == 8
+        for p, new, fr in reqs:
+            assert fr.status == "ok", (fr, fleet.stats())
+        assert fleet.stats()["duplicates"] == 0
+        assert fleet.n_failovers >= 1, fleet.stats()
+
+        # the injected kill really SIGKILLed w0 mid-run
+        assert procs[0].wait(timeout=60) == -signal.SIGKILL
+        # survivor: clean stop, warmup was its only compile
+        final = fleet.stop_fleet(timeout_ms=60_000)
+        assert final["w0"] is None
+        assert final["w1"] is not None
+        assert final["w1"]["prefill_compiles"] == 1, final["w1"]
+        assert final["w1"]["decode_compiles"] == 1, final["w1"]
+        assert procs[1].wait(timeout=60) == 0
+
+        # token parity: replica-independent greedy decoding (the
+        # workers build the same seeded llama_tiny as the fixture)
+        for p, new, fr in reqs:
+            one = generate(net, p[None, :], max_new_tokens=new,
+                           max_len=64)
+            np.testing.assert_array_equal(
+                np.asarray(fr.output_tokens), one[0, len(p):],
+                err_msg=f"{fr.token} diverged after failover")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)

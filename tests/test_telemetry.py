@@ -566,7 +566,9 @@ def test_metrics_server_serves_prometheus():
         assert "steps_total 5" in body
         hz = urllib.request.urlopen(
             srv.url.replace("/metrics", "/healthz"), timeout=5).read()
-        assert hz == b"ok\n"
+        rep = json.loads(hz)
+        assert rep["ok"] is True and rep["reason"] == "ok"
+        assert isinstance(rep["sources"], list)
         with pytest.raises(Exception):
             urllib.request.urlopen(
                 srv.url.replace("/metrics", "/nope"), timeout=5)
@@ -752,7 +754,11 @@ def test_healthz_endpoint_503(monkeypatch):
         assert ei.value.code == 503
         assert b"stalled: watchdog" in ei.value.read()
         stub.ok = True
-        assert urllib.request.urlopen(hz, timeout=5).read() == b"ok\n"
+        rep = json.loads(urllib.request.urlopen(hz, timeout=5).read())
+        assert rep["ok"] is True
+        # the bare-health stub has no health_detail(): its (ok, reason)
+        # pair still shows up as a structured source entry
+        assert any(s.get("reason") for s in rep["sources"])
     finally:
         tm.stop_metrics_server()
         tm.unregister_health_source(stub)

@@ -201,3 +201,12 @@ def test_lint_accepts_the_gate_idioms(tmp_path):
     p = tmp_path / "mod.py"
     p.write_text(src)
     assert _violations(str(p)) == []
+
+
+def test_router_module_is_scanned_and_clean():
+    """The fleet router is heavily instrumented (route decisions,
+    retries, hedges, shedding) — it must be inside the lint's walk and
+    free of ungated sites."""
+    path = os.path.join(PKG, "serving", "router.py")
+    assert path in _module_files(), "router.py missing from lint walk"
+    assert _violations(path) == []
