@@ -30,7 +30,11 @@ __all__ = ["flash_decode", "flash_decode_quantized",
            "reference_decode_attention",
            "gather_kv_pages", "flash_decode_paged",
            "flash_decode_paged_quantized",
-           "paged_kernel_mode", "paged_gather_bytes"]
+           "paged_kernel_mode", "paged_gather_bytes",
+           "reference_paged_window_attention",
+           "flash_decode_paged_window",
+           "flash_decode_paged_window_quantized",
+           "paged_window_mode"]
 
 _fallback = KernelFallback("flash-decode",
                            strict_envs=("MXNET_TPU_STRICT_FLASH",))
@@ -471,6 +475,195 @@ def flash_decode_paged_quantized(q, k8_pages, ks_pages, v8_pages,
     vs = gather_kv_pages(vs_pages, block_tables)
     return flash_decode_quantized(q, k8, ks, v8, vs, valid_len,
                                   scale=scale, use_flash=use_flash)
+
+
+# -- multi-position window attention off the page pool ----------------------
+# Chunked prefill and speculative verify both attend a small window of
+# W query positions (a prefill chunk, or 1 sampled token + k draft
+# candidates) against the SAME paged pool decode reads. Causality
+# inside the window never needs a (W, S) causal mask: each query row
+# carries its own valid length (global position + 1), so row j simply
+# cannot see rows > j — the identical masking contract the single-
+# position path uses, lifted to a (B, W) valid-length matrix. That
+# keeps the window math elementwise-identical to W independent
+# single-position calls, which is what makes speculative greedy decode
+# token-identical to the plain tick.
+
+def reference_paged_window_attention(q, k_cache, v_cache, valid_lens,
+                                     scale=None):
+    """jnp window reference on gathered (B, K, S, d) caches: q is
+    (B, W, H, d), valid_lens (B, W) gives EACH query row its own
+    attendable length. Same no-repeat GQA einsum as
+    reference_decode_attention with a window axis carried through."""
+    B, W, H, d = q.shape
+    K, S = k_cache.shape[1], k_cache.shape[2]
+    rep = H // K
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qr = q.reshape(B, W, K, rep, d).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    s = jnp.einsum("bwkrd,bksd->bwkrs", qr, kf) * scale
+    mask = jnp.arange(S)[None, None, :] < valid_lens[:, :, None]
+    s = jnp.where(mask[:, :, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bwkrs,bksd->bwkrd", p, vf)
+    return out.reshape(B, W, H, d).astype(q.dtype)
+
+
+def paged_window_mode(pool_operand, window, quantized=False):
+    """Dispatch gate for the in-kernel windowed path. Same constraints
+    as paged_kernel_mode with the q/scratch VMEM terms scaled by the
+    window width; the int8 window path always takes the gathered
+    dequantize reference (in-kernel q8 window is a chip-window
+    follow-up), so quantized=True returns None."""
+    if quantized:
+        return None
+    N, K, bs, d = pool_operand.shape
+    if bs % 8 != 0:
+        return None
+    from . import tuning
+
+    per_block = bs * d * pool_operand.dtype.itemsize
+    cell_bytes = 4 * per_block \
+        + int(window) * (2 * d * 4 + (d + 2) * 4 * 8)
+    if cell_bytes > tuning.get("flash_decode_paged",
+                               "vmem_budget_bytes"):
+        return None
+    if os.environ.get("MXNET_TPU_FLASH_INTERPRET", "0") == "1":
+        return "interpret"
+    if jax.default_backend() not in ("cpu",):
+        from .dispatch import operand_on_cpu
+
+        return None if operand_on_cpu(pool_operand) else "compiled"
+    return None
+
+
+def _flash_decode_paged_window_pallas(q, k_pages, v_pages,
+                                      block_tables, valid_lens, scale,
+                                      interpret):
+    """Windowed twin of _flash_decode_paged_pallas: the W window
+    positions fold into the rep axis, so one (b, h, i) grid cell
+    carries (W*rep, d) query rows through the same per-block DMA sweep
+    with per-ROW valid lengths (row w*rep+r masks at valid_lens[b, w])
+    instead of one per-sequence scalar."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, W, H, d = q.shape
+    K, bs = k_pages.shape[1], k_pages.shape[2]
+    nb = block_tables.shape[1]
+    rep = H // K
+    R = W * rep
+    qr = q.reshape(B, W, K, rep, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, K, R, d)
+
+    def kernel(bt_ref, vl_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        i = pl.program_id(2)
+        vlw = vl_ref[pl.program_id(0)]                   # (W,)
+        vl_rows = jnp.repeat(vlw, rep)                   # (R,)
+
+        @pl.when(i == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        @pl.when(i * bs < jnp.max(vlw))
+        def _block():
+            qblk = q_ref[...].astype(jnp.float32) * scale  # (R, d)
+            kblk = k_ref[...].astype(jnp.float32)          # (bs, d)
+            vblk = v_ref[...].astype(jnp.float32)
+            s = qblk @ kblk.T                              # (R, bs)
+            pos = i * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (R, bs), 1)
+            s = jnp.where(pos < vl_rows[:, None], s, -jnp.inf)
+            m_prev = m_ref[...][:, 0]
+            l_prev = l_ref[...][:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            p = jnp.where((m_new > -jnp.inf)[:, None], p, 0.0)
+            corr = jnp.where(m_prev > -jnp.inf,
+                             jnp.exp(m_prev - m_new), 0.0)
+            m_ref[...] = m_new[:, None]
+            l_ref[...] = (corr * l_prev + jnp.sum(p, axis=-1))[:, None]
+            acc_ref[...] = corr[:, None] * acc_ref[...] + p @ vblk
+
+        @pl.when(i == nb - 1)
+        def _finish():
+            l = l_ref[...][:, 0]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[...] = (acc_ref[...] / safe_l[:, None]) \
+                .astype(o_ref.dtype)
+
+    q_spec = pl.BlockSpec((None, None, R, d),
+                          lambda b, h, i, bt, vl: (b, h, 0, 0))
+    pool_spec = pl.BlockSpec((None, None, bs, d),
+                             lambda b, h, i, bt, vl: (bt[b, i], h, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, nb),
+        in_specs=[q_spec, pool_spec, pool_spec],
+        out_specs=pl.BlockSpec((None, None, R, d),
+                               lambda b, h, i, bt, vl: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((R, 1), jnp.float32),   # m
+                        pltpu.VMEM((R, 1), jnp.float32),   # l
+                        pltpu.VMEM((R, d), jnp.float32)])  # acc
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, R, d), q.dtype),
+        interpret=interpret,
+        **_paged_compiler_params(pltpu, interpret),
+    )(block_tables.astype(jnp.int32), valid_lens.astype(jnp.int32),
+      qr, k_pages, v_pages)
+    return out.reshape(B, K, W, rep, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, W, H, d)
+
+
+def flash_decode_paged_window(q, k_pages, v_pages, block_tables,
+                              valid_lens, scale=None, use_flash=True):
+    """W-position window attention straight off the page pool
+    (chunked prefill / speculative verify): in-kernel Pallas when the
+    gate admits it, else gather the contiguous view and run the window
+    reference. Value-identical to W single-position flash_decode_paged
+    calls at matching valid lengths."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mode = paged_window_mode(k_pages, q.shape[1]) if use_flash \
+        else None
+    if mode is not None:
+        try:
+            return _flash_decode_paged_window_pallas(
+                q, k_pages, v_pages, block_tables, valid_lens, scale,
+                mode == "interpret")
+        except Exception as e:
+            _paged_fallback.note(e)
+    k = gather_kv_pages(k_pages, block_tables)
+    v = gather_kv_pages(v_pages, block_tables)
+    return reference_paged_window_attention(q, k, v, valid_lens,
+                                            scale)
+
+
+def flash_decode_paged_window_quantized(q, k8_pages, ks_pages,
+                                        v8_pages, vs_pages,
+                                        block_tables, valid_lens,
+                                        scale=None, use_flash=True):
+    """Window attention against the int8 pool: gather + dequantize to
+    fp32, then the window reference (paged_window_mode gates the
+    in-kernel path off for quantized pools). Cast back to q.dtype so
+    the executable's activation dtype matches the unquantized path."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    k8 = gather_kv_pages(k8_pages, block_tables)
+    ks = gather_kv_pages(ks_pages, block_tables)
+    v8 = gather_kv_pages(v8_pages, block_tables)
+    vs = gather_kv_pages(vs_pages, block_tables)
+    return reference_paged_window_attention(
+        q, dequantize_kv(k8, ks, jnp.float32),
+        dequantize_kv(v8, vs, jnp.float32), valid_lens,
+        scale).astype(q.dtype)
 
 
 # -- int8-quantized KV cache ------------------------------------------------

@@ -24,23 +24,30 @@ request scheduler instead of one-shot `generate()` calls.
   over N replicas (least-loaded + prefix-affinity) with circuit
   breakers, failover retries, hedging, load shedding, and drain-aware
   rolling restarts.
+- `speculative.NgramProposer` — self-drafting n-gram draft proposer
+  for speculative decoding (`InferenceServer(speculative=k)` verifies
+  k drafts per tick in one dispatch; chunked prefill rides
+  `prefill_chunk_tokens=C` — both tail-latency levers in one tick).
 
 See docs/serving.md for the architecture and the block-table math.
 """
 from . import kv_cache
 from . import sampling
 from . import executables
+from . import speculative
 from . import server
 from . import router
 from .kv_cache import PagedKVCache
 from .server import InferenceServer, Request, ServerStalledError
+from .speculative import NgramProposer
 from .router import (FleetRouter, FleetRequest, LocalReplica,
                      ProcReplica, CircuitBreaker, FileKV, CoordKV,
                      RouterStalledError, run_fleet_worker)
 
 __all__ = ["PagedKVCache", "InferenceServer", "Request",
-           "ServerStalledError",
+           "ServerStalledError", "NgramProposer",
            "FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
            "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
            "run_fleet_worker",
-           "kv_cache", "sampling", "executables", "server", "router"]
+           "kv_cache", "sampling", "executables", "server", "router",
+           "speculative"]

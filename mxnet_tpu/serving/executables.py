@@ -199,7 +199,8 @@ def _quant_rows(rows):
 
 def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
                    block_size: int, max_prompt_len: int,
-                   kv_cache_dtype: str = "model"):
+                   kv_cache_dtype: str = "model",
+                   prefill_chunk: int = 0, spec_k: int = 0):
     """Serving executables over a paged pool:
 
     prefill(params, pages, bt_row, ids, valid_len, shared_len)
@@ -222,23 +223,62 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         all batch slots, paged cache write, per-row PRNG advance.
         Inactive slots compute against the scratch block and their
         outputs are discarded by the scheduler.
+
+    prefill_chunk(params, pages, bt_row, ids, chunk_start, chunk_len)
+        -> (pages, last_logits)  [when prefill_chunk > 0]: ONE
+        token-budgeted slice of a prefill. `ids` is (1, C) with C the
+        STATIC chunk width; `(chunk_start, chunk_len)` are traced (1,)
+        int32 — every chunk of every prompt shares one executable.
+        Window rows attend the page pool (earlier chunks + adopted
+        shared-prefix blocks are already resident) with per-row valid
+        lengths, so causality needs no (C, C) mask; k/v land in the
+        pool before the window reads it. The returned last-position
+        logits only matter on the final chunk.
+
+    verify(params, pages, block_tables, pos, last_logits, keys,
+           temps, top_ks, top_ps, active, draft, draft_len)
+        -> (pages, window_tokens, n_accepted, logits, keys)
+        [when spec_k > 0]: a speculative decode tick. Samples token 0
+        from the previous logits EXACTLY like decode (same PRNG
+        split), then scores the k draft candidates at the following
+        positions in the SAME dispatch; the accept mask (greedy
+        longest-prefix match, gated on traced temps <= 0 and
+        per-row draft_len) is traced, so every accept length shares
+        this one executable. Rows with draft_len == 0 compute the
+        decode tick bit-for-bit (token 0 + position-0 write +
+        logits[:, 0]); the scheduler discards rejected-suffix writes
+        by not advancing pos (stale rows are masked by valid lengths
+        and overwritten later).
     """
     st = program_store(net)
     key = ("paged", batch_slots, max_blocks_per_seq, block_size,
-           max_prompt_len, kv_cache_dtype)
+           max_prompt_len, kv_cache_dtype, prefill_chunk, spec_k)
     ent = st.get(key)
     if ent is not None:
         return ent
 
     from ..models import llama_math
-    from ..kernels.flash_decode import (flash_decode_paged,
-                                        flash_decode_paged_quantized)
+    from ..kernels.flash_decode import (
+        flash_decode_paged, flash_decode_paged_quantized,
+        flash_decode_paged_window, flash_decode_paged_window_quantized)
     from .sampling import sample_tokens
 
     cfg = net.model.cfg
     H, K, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     q8 = kv_cache_dtype == "int8"
     bs = block_size
+    nb = max_blocks_per_seq
+
+    def window_attention(q, npg, block_tables, vl):
+        """(B, W) window rows against the pool with per-row valid
+        lengths — the attention core shared by prefill_chunk and
+        verify."""
+        if q8:
+            return flash_decode_paged_window_quantized(
+                q, npg["k"], npg["ks"], npg["v"], npg["vs"],
+                block_tables, vl)
+        return flash_decode_paged_window(q, npg["k"], npg["v"],
+                                         block_tables, vl)
 
     def write_rows(pg, blk_ids, offs, k_rows, v_rows):
         """Scatter per-token rows into the pool. blk_ids/offs (T,),
@@ -306,6 +346,88 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         logits = llama_math.final_logits(params, x, cfg.rms_eps)[:, 0]
         return new_pages, tok, logits, keys_next
 
+    def make_prefill_chunk(C):
+        def prefill_chunk_fn(params, pages, bt_row, ids, chunk_start,
+                             chunk_len):
+            t = jnp.arange(C)
+            gpos = chunk_start[0] + t                    # global pos
+            valid = t < chunk_len[0]
+            # rows past the chunk (and their out-of-range gpos) sink
+            # into scratch block 0, like prefill's padding rows
+            blk = jnp.where(valid,
+                            bt_row[jnp.clip(gpos // bs, 0, nb - 1)], 0)
+            offs = jnp.where(valid, gpos % bs, 0)
+            vl = jnp.where(valid, gpos + 1, 1)[None, :]  # (1, C)
+            x = params["embed"][ids]
+            positions = gpos[None, :]
+            bt2 = bt_row[None, :]
+            new_pages = []
+            for lp, pg in zip(params["layers"], pages):
+                qh, k, v = llama_math.layer_qkv(
+                    lp, x, positions, cfg.rms_eps, cfg.rope_base,
+                    H, K, d)
+                npg = write_rows(pg, blk, offs, k[0], v[0])
+                att = window_attention(qh, npg, bt2, vl)
+                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+                new_pages.append(npg)
+            x = llama_math.rms(x, params["norm"], cfg.rms_eps)
+            idx = jnp.maximum(chunk_len - 1, 0)
+            last = jnp.take_along_axis(x, idx[:, None, None],
+                                       axis=1)[:, 0]
+            return new_pages, last @ params["head"].T
+
+        return prefill_chunk_fn
+
+    def make_verify(W):
+        def verify(params, pages, block_tables, pos, last_logits,
+                   keys, temps, top_ks, top_ps, active, draft,
+                   draft_len):
+            # token 0: the SAME split + sample as decode, so sampled
+            # rows' PRNG streams are tick-for-tick identical
+            split = jax.vmap(partial(jax.random.split, num=2))(keys)
+            keys_sample, keys_next = split[:, 0], split[:, 1]
+            t0 = sample_tokens(last_logits, keys_sample, temps,
+                               top_ks, top_ps)
+            w = jnp.concatenate([t0[:, None], draft], axis=1)
+            rows = jnp.arange(batch_slots)
+            j = jnp.arange(W)
+            P = pos[:, None] + j[None, :]                  # (B, W)
+            valid = active[:, None] & (j[None, :]
+                                       <= draft_len[:, None])
+            blk = jnp.where(
+                valid,
+                block_tables[rows[:, None],
+                             jnp.clip(P // bs, 0, nb - 1)], 0)
+            offs = jnp.where(valid, P % bs, 0)
+            vl = jnp.where(valid, P + 1, 1)                # (B, W)
+            x = params["embed"][w]                         # (B, W, D)
+            fb, fo = blk.reshape(-1), offs.reshape(-1)
+            new_pages = []
+            for lp, pg in zip(params["layers"], pages):
+                qh, k, v = llama_math.layer_qkv(
+                    lp, x, P, cfg.rms_eps, cfg.rope_base, H, K, d)
+                npg = write_rows(pg, fb, fo, k.reshape(-1, K, d),
+                                 v.reshape(-1, K, d))
+                att = window_attention(qh, npg, block_tables, vl)
+                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+                new_pages.append(npg)
+            logits = llama_math.final_logits(params, x, cfg.rms_eps)
+            # greedy accept: candidate j survives iff every candidate
+            # <= j matched the model's argmax at its position
+            pred = jnp.argmax(logits[:, :-1, :], axis=-1) \
+                .astype(jnp.int32)
+            spec_ok = active & (temps <= 0.0)
+            match = (pred == draft) \
+                & (j[1:][None, :] <= draft_len[:, None]) \
+                & spec_ok[:, None]
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            n_acc = jnp.sum(acc, axis=1).astype(jnp.int32)
+            new_last = jnp.take_along_axis(
+                logits, n_acc[:, None, None], axis=1)[:, 0]
+            return new_pages, w, n_acc, new_last, keys_next
+
+        return verify
+
     def copy_block(pages, src, dst):
         # dynamic-index gather + scatter: src/dst are traced scalars,
         # so every copy-on-write rides one executable
@@ -318,5 +440,12 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
                              donate_argnums=(1,)),
            "copy_block": Program("serving_copy_block", copy_block,
                                  donate_argnums=(0,))}
+    if prefill_chunk:
+        ent["prefill_chunk"] = Program(
+            "serving_prefill_chunk", make_prefill_chunk(prefill_chunk),
+            donate_argnums=(1,))
+    if spec_k:
+        ent["verify"] = Program("serving_verify", make_verify(spec_k + 1),
+                                donate_argnums=(1,))
     st[key] = ent
     return ent

@@ -232,6 +232,41 @@ class PagedKVCache:
         self.alloc_count += 1
         return True
 
+    def append_span(self, slot: int, pos: int, n: int) -> int:
+        """Multi-token (speculative) append: make blocks available for
+        writing positions pos .. pos+n-1. Allocates as many as the
+        pool can cover and returns how many positions are backed
+        (possibly < n under pool pressure — the scheduler then shrinks
+        the draft instead of preempting; rewind() returns the blocks
+        if the tokens are rejected)."""
+        covered = 0
+        for p in range(pos, pos + n):
+            if not self.ensure(slot, p):
+                break
+            covered += 1
+        return covered
+
+    def rewind(self, slot: int, num_tokens: int):
+        """Roll the slot's logical length back to `num_tokens`
+        (speculative rejected-suffix rewind): trailing blocks that
+        hold ONLY positions >= num_tokens are released, refcount-
+        aware like free_slot. Stale rows inside the kept tail block
+        are masked by valid lengths and overwritten by later writes."""
+        keep = self.blocks_for(num_tokens)
+        held = self._slot_blocks[slot]
+        while len(held) > keep:
+            b = held.pop()
+            self.block_tables[slot, len(held)] = 0
+            self._refcount[b] -= 1
+            self.free_count += 1
+            if self._refcount[b] == 0:
+                if self.prefix_cache and b in self._block_key:
+                    self._free.insert(0, b)
+                else:
+                    self._free.append(b)
+        self._slot_len[slot] = min(int(self._slot_len[slot]),
+                                   max(num_tokens, 0))
+
     def free_slot(self, slot: int):
         """Release the slot's block references and clear its table row
         (so an evicted slot's reads resolve to the scratch block).

@@ -761,7 +761,12 @@ class FleetRouter:
         d = rep.detail or {}
         load = max(int(d.get("queued", 0)) + int(d.get("active", 0)),
                    len(rep.attempts))
+        # prefill_backlog_tokens: un-prefilled prompt tokens (queued +
+        # mid-chunk) the replica still owes its chunk budget to — a
+        # chunked-prefill replica digesting a long prompt scores worse
+        # than an equally-loaded one that is already all-decode
         return (load, float(d.get("queue_age_p95_s", 0.0)),
+                int(d.get("prefill_backlog_tokens", 0)),
                 -int(d.get("blocks_free", 0)))
 
     def _pick(self, fr: FleetRequest, now: float,

@@ -40,8 +40,37 @@ Telemetry (PR-4 registry, enabled via telemetry.enable()):
       is serving)
   serving_prefix_hits_total / serving_prefix_tokens_shared_total /
   serving_cow_copies_total    prefix-cache sharing activity
-  per-tick phase spans: serve_admit / serve_decode (chrome trace +
-  step_time_breakdown rows)
+  serving_prefill_skipped_total  counter — admissions whose prompt the
+      prefix cache fully covered (no prefill dispatch at all)
+  serving_chunk_budget_utilization  gauge — fraction of the per-tick
+      chunked-prefill token budget spent (chunked mode only)
+  serving_tpot_seconds{spec=on|off}  histogram — per-request TPOT at
+      finish, labeled by whether speculation was enabled
+  serving_draft_accept_rate   gauge — rolling accepted/proposed drafts
+  serving_spec_tokens_accepted_total / serving_spec_tokens_rejected_total
+      counters — draft tokens the verify pass kept / threw away
+  per-tick phase spans: serve_admit / serve_prefill / serve_decode
+  (chrome trace + step_time_breakdown rows)
+
+Tail-latency machinery (chunked prefill + speculative decoding):
+
+- ``prefill_chunk_tokens=C`` switches prefill to SplitFuse/Sarathi-
+  style chunking: every prompt prefills as ceil(T / C) bounded slices
+  through ONE windowed executable (traced (chunk_start, chunk_len)),
+  spent from a per-tick budget of C tokens between admit and decode —
+  decode cadence stays bounded no matter the prompt-length mix. A
+  request mid-prefill holds its slot and blocks (state visible in
+  health_detail()["prefill_backlog_tokens"]) but doesn't decode; it is
+  preemptable and deadline-expirable like any running request.
+- ``speculative=k`` (or a proposer object) turns each greedy row's
+  decode tick into a verify tick when the proposer has candidates: k
+  draft tokens are scored in ONE dispatch alongside the sampled token
+  (traced accept masks — every accept length shares the executable),
+  accepted runs write straight into the page pool, and the rejected
+  suffix is rewound by NOT advancing pos (kv_cache.rewind returns
+  over-allocated blocks; stale rows are masked by valid lengths).
+  Greedy output is token-identical to the plain tick; sampled rows
+  never ride drafts.
 
 Robustness (fault tolerance PR): per-request deadlines (expired
 requests finish with status ``timed_out``), a preemption retry cap
@@ -200,7 +229,9 @@ class InferenceServer:
                  prefix_cache: bool = False,
                  trace_sample_every: int = 1,
                  trace_slow_s: Optional[float] = None,
-                 trace_capacity: int = 256):
+                 trace_capacity: int = 256,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 speculative=None):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -212,6 +243,15 @@ class InferenceServer:
         self.max_prompt_len = max_prompt_len or min(max_len, 64)
         self.kv_cache_dtype = kv_cache_dtype
         self.prefix_cache = prefix_cache
+        if prefill_chunk_tokens is not None:
+            prefill_chunk_tokens = int(prefill_chunk_tokens)
+            if prefill_chunk_tokens < 1:
+                raise ValueError("prefill_chunk_tokens must be >= 1")
+            prefill_chunk_tokens = min(prefill_chunk_tokens,
+                                       self.max_prompt_len)
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        from .speculative import as_proposer
+        self._spec = as_proposer(speculative)
         max_blocks = max_len // block_size
         if num_blocks is None:
             num_blocks = batch_slots * max_blocks + 1
@@ -226,7 +266,9 @@ class InferenceServer:
         self.programs = executables.paged_programs(
             net, batch_slots=batch_slots, max_blocks_per_seq=max_blocks,
             block_size=block_size, max_prompt_len=self.max_prompt_len,
-            kv_cache_dtype=kv_cache_dtype)
+            kv_cache_dtype=kv_cache_dtype,
+            prefill_chunk=prefill_chunk_tokens or 0,
+            spec_k=self._spec.k if self._spec is not None else 0)
 
         # host-side probe of the decode kernel's dispatch: traced code
         # cannot bump counters, so the per-tick HBM bytes the in-kernel
@@ -264,6 +306,17 @@ class InferenceServer:
         self._slot_req: List[Optional[Request]] = [None] * B
         self._admit_seq = 0                 # admission order stamp
         self._slot_admit = np.zeros(B, np.int64)
+        # chunked-prefill / speculative per-slot state: a prefilling
+        # slot holds blocks + request but isn't decode-active yet; a
+        # warm slot's next tick re-feeds the last prompt token (full
+        # prefix-cache cover skipped the prefill dispatch entirely)
+        self._prefilling = np.zeros(B, bool)
+        self._prefill_pos = np.zeros(B, np.int32)
+        self._warm = np.zeros(B, bool)
+        self.prefills_skipped = 0
+        self.spec_tokens_accepted = 0
+        self.spec_tokens_rejected = 0
+        self._spec_window: deque = deque(maxlen=256)
         self.queue: deque = deque()
         self.finished: List[Request] = []
         self.ticks = 0
@@ -365,7 +418,7 @@ class InferenceServer:
 
     def _free_slots(self):
         return [i for i in range(self.batch_slots)
-                if not self._active[i]]
+                if not self._active[i] and not self._prefilling[i]]
 
     def _copy_block(self, src: int, dst: int,
                     req: Optional[Request] = None):
@@ -379,11 +432,26 @@ class InferenceServer:
             req.cow_copies += 1
             req._tev("cow", src=src, dst=dst)
 
+    def _note_prefix_hit(self, req: Request, shared_len: int):
+        if shared_len:
+            req.prefix_tokens_shared += shared_len
+            if telemetry._ENABLED:
+                telemetry.inc("serving_prefix_hits_total")
+                telemetry.inc("serving_prefix_tokens_shared_total",
+                              shared_len)
+
+    def _seed_slot(self, slot: int, req: Request):
+        """Decode activation: PRNG row + per-row sampling params."""
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(jax.random.PRNGKey(req.seed), jnp.uint32))
+        self._active[slot] = True
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+
     def _admit_one(self, slot: int, req: Request,
                    shared_len: int = 0, cow=None):
         T = len(req.prompt)
-        ids = np.zeros((1, self.max_prompt_len), np.int32)
-        ids[0, :T] = req.prompt
         if cow is not None:
             # the prompt extends into a shared block mid-block: give
             # the slot a private copy BEFORE prefill overwrites the
@@ -395,6 +463,46 @@ class InferenceServer:
         if _fl._ENABLED:
             _fl.record("sched", "serving.admit", request=req.id,
                        slot=slot, prompt=T, shared_len=shared_len)
+        self._slot_req[slot] = req
+        self._slot_admit[slot] = self._admit_seq
+        self._admit_seq += 1
+        req.state = _RUNNING
+
+        if self.prefix_cache and shared_len >= T:
+            # the prefix cache fully covers the prompt — every k/v row
+            # is already resident in adopted blocks, so skip the
+            # prefill dispatch entirely. Seed a WARM tick instead:
+            # pos = T-1 with one-hot logits on the last prompt token,
+            # so the next decode tick deterministically re-feeds that
+            # token (argmax AND categorical: every other logit is
+            # -1e30, whose exp underflows to exactly 0), recomputes
+            # its k/v into a CoW'd private block, and yields the true
+            # last-prompt logits. The re-fed token is NOT emitted.
+            self.prefills_skipped += 1
+            if telemetry._ENABLED:
+                telemetry.inc("serving_prefill_skipped_total")
+            self._note_prefix_hit(req, T)
+            one = np.full((self.cfg.vocab_size,), -1e30, np.float32)
+            one[int(req.prompt[-1])] = 0.0
+            self._last_logits = self._last_logits.at[slot].set(
+                jnp.asarray(one).astype(self._last_logits.dtype))
+            self._pos[slot] = T - 1
+            self._warm[slot] = True
+            self._seed_slot(slot, req)
+            req._tev("prefill_skip", tokens=T)
+            req._open_decode_window()
+            return
+
+        if self.prefill_chunk_tokens is not None:
+            # chunked mode: hold the slot in the in-prefill state; the
+            # chunks run from step()'s per-tick token budget
+            self._prefilling[slot] = True
+            self._prefill_pos[slot] = shared_len
+            self._note_prefix_hit(req, shared_len)
+            return
+
+        ids = np.zeros((1, self.max_prompt_len), np.int32)
+        ids[0, :T] = req.prompt
         bt_row = jnp.asarray(self.cache.block_tables[slot])
         t_pf = time.perf_counter()
         with telemetry.phase("serve_prefill"):
@@ -407,25 +515,11 @@ class InferenceServer:
         req._open_decode_window()
         if self.prefix_cache:
             self.cache.register_prefix(slot, req.prompt)
-            if shared_len:
-                req.prefix_tokens_shared += shared_len
-                if telemetry._ENABLED:
-                    telemetry.inc("serving_prefix_hits_total")
-                    telemetry.inc("serving_prefix_tokens_shared_total",
-                                  shared_len)
+            self._note_prefix_hit(req, shared_len)
         self._last_logits = self._last_logits.at[slot].set(
             last[0].astype(self._last_logits.dtype))
-        self._keys = self._keys.at[slot].set(
-            jnp.asarray(jax.random.PRNGKey(req.seed), jnp.uint32))
         self._pos[slot] = T
-        self._active[slot] = True
-        self._temps[slot] = req.temperature
-        self._top_ks[slot] = req.top_k
-        self._top_ps[slot] = req.top_p
-        self._slot_req[slot] = req
-        self._slot_admit[slot] = self._admit_seq
-        self._admit_seq += 1
-        req.state = _RUNNING
+        self._seed_slot(slot, req)
 
     def _admit(self):
         admitted = 0
@@ -460,7 +554,8 @@ class InferenceServer:
         `protect`) back to the queue head. Returns False if there is
         nothing to preempt."""
         running = [i for i in range(self.batch_slots)
-                   if self._active[i] and i != protect]
+                   if (self._active[i] or self._prefilling[i])
+                   and i != protect]
         if not running:
             return False
         victim = max(running, key=lambda i: self._slot_admit[i])
@@ -516,6 +611,127 @@ class InferenceServer:
                     self._copy_block(*pw, req=self._slot_req[slot])
                 break
 
+    # -- chunked prefill + speculative drafting ------------------------------
+
+    def _prefill_tick(self) -> int:
+        """Spend this tick's chunk budget (prefill_chunk_tokens) on
+        in-prefill slots, oldest admission first. Returns tokens
+        prefilled (watchdog progress units)."""
+        C = self.prefill_chunk_tokens
+        budget = C
+        order = sorted((i for i in range(self.batch_slots)
+                        if self._prefilling[i]),
+                       key=lambda i: self._slot_admit[i])
+        any_work = False
+        for slot in order:
+            while budget > 0 and self._prefilling[slot]:
+                budget -= self._prefill_chunk(slot, budget)
+                any_work = True
+        used = C - budget
+        if telemetry._ENABLED and any_work:
+            telemetry.set_gauge("serving_chunk_budget_utilization",
+                                used / C)
+        return used
+
+    def _prefill_chunk(self, slot: int, budget: int) -> int:
+        """One windowed prefill dispatch for `slot`: at most
+        min(budget, C, remaining prompt) tokens starting at the slot's
+        prefill cursor. Completes the prefill (activates decode) when
+        the cursor reaches the prompt end."""
+        req = self._slot_req[slot]
+        C = self.prefill_chunk_tokens
+        T = len(req.prompt)
+        start = int(self._prefill_pos[slot])
+        n = min(T - start, budget, C)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = req.prompt[start:start + n]
+        bt_row = jnp.asarray(self.cache.block_tables[slot])
+        t_pf = time.perf_counter()
+        with telemetry.phase("serve_prefill"):
+            self.cache.pages, last = self.programs["prefill_chunk"](
+                self._params, self.cache.pages, bt_row,
+                jnp.asarray(ids), jnp.asarray([start], jnp.int32),
+                jnp.asarray([n], jnp.int32))
+        req._tev("prefill_chunk", t=t_pf,
+                 dur_s=time.perf_counter() - t_pf, tokens=n,
+                 start=start)
+        if _fl._ENABLED:
+            _fl.record("sched", "serving.prefill_chunk",
+                       request=req.id, slot=slot, start=start,
+                       tokens=n)
+        self._prefill_pos[slot] = start + n
+        if start + n >= T:
+            self._prefilling[slot] = False
+            if self.prefix_cache:
+                self.cache.register_prefix(slot, req.prompt)
+            self._last_logits = self._last_logits.at[slot].set(
+                last[0].astype(self._last_logits.dtype))
+            self._pos[slot] = T
+            self._seed_slot(slot, req)
+            req._open_decode_window()
+        return n
+
+    def _propose_drafts(self):
+        """Ask the proposer for draft tokens for every active GREEDY
+        slot and back the speculative window with pool blocks (CoW'd
+        where shared). Returns (drafts (B, k), draft_lens (B,)) or
+        (None, None) when no slot drafted this tick."""
+        k = self._spec.k
+        B = self.batch_slots
+        drafts = np.zeros((B, k), np.int32)
+        dlens = np.zeros(B, np.int32)
+        any_draft = False
+        for slot in range(B):
+            req = self._slot_req[slot]
+            if not self._active[slot] or req.temperature > 0:
+                continue
+            pos = int(self._pos[slot])
+            # budget: drafts become real output tokens, so never
+            # propose past max_new_tokens; the window's first position
+            # is the sampled token (or the warm re-feed, which emits
+            # nothing), and every position must fit below max_len
+            room = min(k,
+                       req.max_new_tokens - len(req.output_tokens)
+                       - (0 if self._warm[slot] else 1),
+                       self.max_len - pos - 1)
+            if room <= 0:
+                continue
+            prop = np.asarray(self._spec.propose(req.tokens()),
+                              np.int32).reshape(-1)
+            if not self._warm[slot]:
+                # the proposer's first guess targets the very token
+                # this tick computes itself (window position 0), so
+                # drafts ride one position later; on a WARM tick
+                # position 0 is the known last prompt token and the
+                # guesses align as-is
+                prop = prop[1:]
+            prop = prop[:room]
+            if prop.size == 0:
+                continue
+            # back positions pos+1 .. pos+n with blocks; under pool
+            # pressure SHRINK the draft instead of preempting — a
+            # short draft is still correct, just less speculative
+            n = self.cache.append_span(slot, pos + 1, int(prop.size))
+            m = 0
+            while m < n:
+                pw = self.cache.prepare_write(slot, pos + 1 + m)
+                if pw is False:
+                    break
+                if pw is not None:
+                    self._copy_block(*pw, req=req)
+                m += 1
+            if m < int(prop.size):
+                # return the blocks the shrunken tail had grabbed
+                self.cache.rewind(slot, pos + 1 + m)
+            if m <= 0:
+                continue
+            drafts[slot, :m] = prop[:m]
+            dlens[slot] = m
+            any_draft = True
+        if not any_draft:
+            return None, None
+        return drafts, dlens
+
     def _evict(self, slot: int):
         if _fl._ENABLED:
             req = self._slot_req[slot]
@@ -527,6 +743,9 @@ class InferenceServer:
         self._temps[slot] = 0.0
         self._top_ks[slot] = 0
         self._top_ps[slot] = 0.0
+        self._prefilling[slot] = False
+        self._prefill_pos[slot] = 0
+        self._warm[slot] = False
         self._slot_req[slot] = None
 
     def _finish(self, slot: int, reason: str, status: str = _OK):
@@ -546,6 +765,13 @@ class InferenceServer:
         if telemetry._ENABLED:
             telemetry.inc("serving_requests_finished")
             telemetry.inc("serving_requests_total", status=status)
+            n = len(req.output_tokens)
+            if req.t_first_token is not None \
+                    and req.t_last_token is not None and n > 1:
+                telemetry.observe(
+                    "serving_tpot_seconds",
+                    (req.t_last_token - req.t_first_token) / (n - 1),
+                    spec="on" if self._spec is not None else "off")
         if _fl._ENABLED:
             _fl.record("sched", "serving.finish", request=req.id,
                        reason=reason, status=status)
@@ -594,7 +820,10 @@ class InferenceServer:
     # -- the tick -----------------------------------------------------------
 
     def step(self) -> int:
-        """Admit + one decode tick + evict. Returns tokens emitted."""
+        """Admit + one decode tick + evict. Returns tokens emitted
+        (on ticks that only ran prefill chunks, the chunk tokens
+        processed — drive loops must see prefill-only ticks as
+        progress, not idleness)."""
         t_tick = time.perf_counter()
         done0 = len(self.finished)
         self._expire_deadlines()
@@ -606,20 +835,45 @@ class InferenceServer:
             return 0
         with telemetry.phase("serve_admit"):
             admitted = self._admit()
+        prefilled = 0
+        if self.prefill_chunk_tokens is not None \
+                and self._prefilling.any():
+            prefilled = self._prefill_tick()
         if not self._active.any():
-            self._note_progress(admitted, done0)
+            self._note_progress(admitted + prefilled, done0)
             self._update_gauges()
-            return 0
+            return prefilled
         self._ensure_blocks()
+        drafts = dlens = None
+        if self._spec is not None:
+            drafts, dlens = self._propose_drafts()
         with telemetry.phase("serve_decode"):
-            (self.cache.pages, tok, self._last_logits,
-             self._keys) = self.programs["decode"](
-                self._params, self.cache.pages,
-                jnp.asarray(self.cache.block_tables),
-                jnp.asarray(self._pos), self._last_logits, self._keys,
-                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps), jnp.asarray(self._active))
-            tok_np = np.asarray(tok)    # host sync = honest tick time
+            if drafts is not None:
+                (self.cache.pages, wtok, n_acc, self._last_logits,
+                 self._keys) = self.programs["verify"](
+                    self._params, self.cache.pages,
+                    jnp.asarray(self.cache.block_tables),
+                    jnp.asarray(self._pos), self._last_logits,
+                    self._keys, jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks),
+                    jnp.asarray(self._top_ps),
+                    jnp.asarray(self._active), jnp.asarray(drafts),
+                    jnp.asarray(dlens))
+                wtok_np = np.asarray(wtok)   # (B, k+1) host sync
+                n_acc_np = np.asarray(n_acc)
+            else:
+                (self.cache.pages, tok, self._last_logits,
+                 self._keys) = self.programs["decode"](
+                    self._params, self.cache.pages,
+                    jnp.asarray(self.cache.block_tables),
+                    jnp.asarray(self._pos), self._last_logits,
+                    self._keys, jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks),
+                    jnp.asarray(self._top_ps),
+                    jnp.asarray(self._active))
+                # host sync = honest tick time
+                wtok_np = np.asarray(tok).reshape(-1, 1)
+                n_acc_np = np.zeros(self.batch_slots, np.int32)
         now = time.perf_counter()
         emitted = 0
         net_new = 0
@@ -627,28 +881,69 @@ class InferenceServer:
             if not self._active[slot]:
                 continue
             req = self._slot_req[slot]
-            t = int(tok_np[slot])
-            req.output_tokens.append(t)
-            self._pos[slot] += 1
-            emitted += 1
-            # tokens regenerated after a preemption were already
-            # counted before the preemption — only net-new tokens feed
-            # the throughput counters and the tokens/sec window
-            if len(req.output_tokens) > req.tokens_counted:
-                req.tokens_counted = len(req.output_tokens)
-                net_new += 1
-            if self._trace_on:
-                req._note_decode(now)
-            else:
-                req.t_last_token = now
-            if req.t_first_token is None:
-                req.t_first_token = now
-                if telemetry._ENABLED and req.ttft is not None:
-                    telemetry.observe("serving_ttft_seconds", req.ttft)
-            if req.eos_id >= 0 and t == req.eos_id:
-                self._finish(slot, "eos")
-            elif len(req.output_tokens) >= req.max_new_tokens:
-                self._finish(slot, "length")
+            warm = bool(self._warm[slot])
+            run = 1 + int(n_acc_np[slot])
+            proposed = int(dlens[slot]) if dlens is not None else 0
+            finished = None
+            for j in range(run):
+                t = int(wtok_np[slot, j])
+                self._pos[slot] += 1
+                if warm and j == 0:
+                    # warm re-feed of the last prompt token: its k/v
+                    # write is the whole point; the token itself is
+                    # NOT output
+                    continue
+                req.output_tokens.append(t)
+                emitted += 1
+                # tokens regenerated after a preemption were already
+                # counted before the preemption — only net-new tokens
+                # feed the throughput counters and tokens/sec window
+                if len(req.output_tokens) > req.tokens_counted:
+                    req.tokens_counted = len(req.output_tokens)
+                    net_new += 1
+                if self._trace_on:
+                    req._note_decode(now)
+                else:
+                    req.t_last_token = now
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                    if telemetry._ENABLED and req.ttft is not None:
+                        telemetry.observe("serving_ttft_seconds",
+                                          req.ttft)
+                if req.eos_id >= 0 and t == req.eos_id:
+                    finished = "eos"
+                    break
+                if len(req.output_tokens) >= req.max_new_tokens:
+                    finished = "length"
+                    break
+            if proposed:
+                acc = int(n_acc_np[slot])
+                self.spec_tokens_accepted += acc
+                self.spec_tokens_rejected += proposed - acc
+                self._spec_window.append((acc, proposed))
+                if telemetry._ENABLED:
+                    telemetry.inc("serving_spec_tokens_accepted_total",
+                                  acc)
+                    telemetry.inc("serving_spec_tokens_rejected_total",
+                                  proposed - acc)
+            if warm:
+                self._warm[slot] = False
+            if finished is not None:
+                self._finish(slot, finished)
+                continue
+            if proposed:
+                # rejected-suffix rewind: pos simply didn't advance
+                # over the rejected window positions — return the
+                # blocks the unconsumed tail had grabbed (stale rows
+                # are masked by valid lengths and overwritten later)
+                self.cache.rewind(slot, int(self._pos[slot]))
+            if warm:
+                # the warm tick consumed one PRNG split on a discarded
+                # sample; re-seed so the sampled stream matches the
+                # cold (real-prefill) path tick-for-tick
+                self._keys = self._keys.at[slot].set(
+                    jnp.asarray(jax.random.PRNGKey(req.seed),
+                                jnp.uint32))
         self.ticks += 1
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
@@ -671,7 +966,8 @@ class InferenceServer:
         decode path is wedged — raise so a supervisor restarts the
         server instead of the loop spinning forever."""
         progress += len(self.finished) - done_before
-        if progress > 0 or not (self.queue or self._active.any()):
+        if progress > 0 or not (self.queue or self._active.any()
+                                or self._prefilling.any()):
             self._stall_ticks = 0
             self._stalled = False
             return
@@ -702,6 +998,12 @@ class InferenceServer:
                             int(self._active.sum()))
         telemetry.set_gauge("serving_kv_blocks_free",
                             self.cache.num_free_blocks)
+        if self._spec is not None and self._spec_window:
+            prop = sum(p for _, p in self._spec_window)
+            if prop:
+                acc = sum(a for a, _ in self._spec_window)
+                telemetry.set_gauge("serving_draft_accept_rate",
+                                    acc / prop)
         if len(self._tok_window) >= 2:
             t0 = self._tok_window[0][0]
             dt = self._tok_window[-1][0] - t0
@@ -717,7 +1019,8 @@ class InferenceServer:
         done_before = len(self.finished)
         ticks = 0
         try:
-            while self.queue or self._active.any():
+            while self.queue or self._active.any() \
+                or self._prefilling.any():
                 self.step()
                 ticks += 1
                 if max_ticks is not None and ticks >= max_ticks:
@@ -781,7 +1084,8 @@ class InferenceServer:
         done_before = len(self.finished)
         t0 = time.perf_counter()
         ticks = 0
-        while self.queue or self._active.any():
+        while self.queue or self._active.any() \
+                or self._prefilling.any():
             if max_ticks is not None and ticks >= max_ticks:
                 break
             if deadline_s is not None \
@@ -802,7 +1106,7 @@ class InferenceServer:
         if drain:
             self.drain(max_ticks=max_ticks, deadline_s=deadline_s)
         for slot in range(self.batch_slots):
-            if self._active[slot]:
+            if self._active[slot] or self._prefilling[slot]:
                 self._finish(slot, "shutdown", status=_REJECTED)
         while self.queue:
             self._terminate(self.queue.popleft(), "shutdown", _REJECTED)
@@ -833,7 +1137,19 @@ class InferenceServer:
         ok, reason = self.health()
         now = time.perf_counter()
         ages = [now - r.t_submit for r in self.queue]
+        # prefill work not yet pushed through an executable: queued
+        # prompts + the unprefilled remainder of in-prefill slots — a
+        # budget-aware router steers long-prompt traffic away from
+        # replicas already paying chunked-prefill ticks
+        backlog = sum(len(r.prompt) for r in self.queue)
+        for i in range(self.batch_slots):
+            if self._prefilling[i]:
+                backlog += len(self._slot_req[i].prompt) \
+                    - int(self._prefill_pos[i])
         return {"ok": ok, "reason": reason,
+                "prefill_backlog_tokens": int(backlog),
+                "prefill_chunk_tokens": self.prefill_chunk_tokens or 0,
+                "speculative": self._spec is not None,
                 "draining": self._draining,
                 "shutdown": self._shutdown,
                 "stalled": self._stalled,
@@ -914,11 +1230,22 @@ class InferenceServer:
         return out
 
     def compile_stats(self) -> dict:
-        p, d = self.programs["prefill"], self.programs["decode"]
+        # in chunked mode the windowed program IS the prefill path, so
+        # the headline prefill counters point at it (the one-shot
+        # program exists but is never dispatched)
+        p = self.programs["prefill_chunk"] \
+            if self.prefill_chunk_tokens is not None \
+            else self.programs["prefill"]
+        d = self.programs["decode"]
         c = self.programs["copy_block"]
-        return {"prefill_compiles": p.compiles, "prefill_calls": p.calls,
-                "decode_compiles": d.compiles, "decode_calls": d.calls,
-                "copy_compiles": c.compiles, "copy_calls": c.calls}
+        out = {"prefill_compiles": p.compiles, "prefill_calls": p.calls,
+               "decode_compiles": d.compiles, "decode_calls": d.calls,
+               "copy_compiles": c.compiles, "copy_calls": c.calls}
+        v = self.programs.get("verify")
+        if v is not None:
+            out["verify_compiles"] = v.compiles
+            out["verify_calls"] = v.calls
+        return out
 
     def stats(self) -> dict:
         by_status = {s: 0 for s in (_OK, _TIMED_OUT, _PREEMPTED,
@@ -932,12 +1259,20 @@ class InferenceServer:
         ages = [now - r.t_submit for r in self.queue]
         age_p50 = float(np.percentile(ages, 50)) if ages else 0.0
         age_p95 = float(np.percentile(ages, 95)) if ages else 0.0
+        spec_prop = self.spec_tokens_accepted + self.spec_tokens_rejected
         return {"ticks": self.ticks,
                 "queue_age_p50_s": age_p50,
                 "queue_age_p95_s": age_p95,
                 "tokens_generated": self.tokens_generated,
                 "queued": len(self.queue),
                 "active": int(self._active.sum()),
+                "prefilling": int(self._prefilling.sum()),
+                "prefills_skipped": self.prefills_skipped,
+                "spec_tokens_accepted": self.spec_tokens_accepted,
+                "spec_tokens_rejected": self.spec_tokens_rejected,
+                "draft_accept_rate":
+                    self.spec_tokens_accepted / spec_prop
+                    if spec_prop else 0.0,
                 "finished": len(self.finished),
                 "status_counts": by_status,
                 "draining": self._draining,

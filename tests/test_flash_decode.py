@@ -298,3 +298,103 @@ def test_paged_gather_bytes_accounting():
     assert paged_gather_bytes((33, 4, 16, 32), (4, 8), 1,
                               quantized=True) \
         == 2 * 4 * 4 * 8 * 16 * 32 * 1 + 2 * 4 * 4 * 8 * 16 * 4
+
+
+# -- windowed paged attention (chunked prefill / speculative verify) --------
+
+def _window_data(B=2, W=4, S=128, H=8, K=2, d=16, bs=8, seed=13,
+                 vls=None):
+    """Paged pool filled to each sequence's max window position, plus
+    a (B, W) per-row valid-length matrix: row j of the window attends
+    its own prefix, exactly the contract chunked prefill and verify
+    hand the kernel."""
+    rs = np.random.RandomState(seed)
+    if vls is None:
+        base = rs.randint(1, S - W, B)
+        vls = base[:, None] + np.arange(W)[None, :]  # consecutive rows
+    vls = np.asarray(vls, np.int32).reshape(B, W)
+    q, kc, vc, kp, vp, bt, _ = _paged_data(
+        B=B, S=S, H=H, K=K, d=d, bs=bs, seed=seed,
+        vl=vls.max(axis=1))
+    qw = jnp.asarray(rs.randn(B, W, H, d).astype(np.float32))
+    return qw, kc, vc, kp, vp, bt, jnp.asarray(vls)
+
+
+def test_window_reference_matches_single_position_stack():
+    # the window reference must be W independent single-position
+    # references stacked — this is the identity speculative greedy
+    # parity rests on
+    from mxnet_tpu.kernels.flash_decode import \
+        reference_paged_window_attention
+    qw, kc, vc, _, _, _, vls = _window_data(seed=21)
+    out = reference_paged_window_attention(qw, kc, vc, vls, 0.25)
+    for j in range(qw.shape[1]):
+        ref = reference_decode_attention(qw[:, j], kc, vc, vls[:, j],
+                                         0.25)
+        np.testing.assert_allclose(np.asarray(out[:, j]),
+                                   np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_window_inkernel_matches_reference():
+    from mxnet_tpu.kernels.flash_decode import \
+        _flash_decode_paged_window_pallas
+    qw, kc, vc, kp, vp, bt, vls = _window_data(seed=14)
+    out = _flash_decode_paged_window_pallas(qw, kp, vp, bt, vls, 0.25,
+                                            interpret=True)
+    from mxnet_tpu.kernels.flash_decode import \
+        reference_paged_window_attention
+    ref = reference_paged_window_attention(qw, kc, vc, vls, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("vls", [[[1, 2, 3, 4]], [[8, 9, 10, 11]],
+                                 [[125, 126, 127, 128]],
+                                 [[1, 1, 1, 1]]])
+def test_paged_window_valid_len_edges(vls):
+    # window crossing a block boundary, hugging the end of the pool,
+    # and degenerate all-rows-see-one-token (verify with every draft
+    # at position 0 masked)
+    from mxnet_tpu.kernels.flash_decode import (
+        _flash_decode_paged_window_pallas,
+        reference_paged_window_attention)
+    qw, kc, vc, kp, vp, bt, v = _window_data(B=1, seed=15, vls=vls)
+    out = _flash_decode_paged_window_pallas(qw, kp, vp, bt, v, 0.25,
+                                            interpret=True)
+    ref = reference_paged_window_attention(qw, kc, vc, v, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_window_dispatch_and_gate(monkeypatch):
+    from mxnet_tpu.kernels import flash_decode as fd
+    qw, kc, vc, kp, vp, bt, vls = _window_data(seed=16)
+    monkeypatch.setenv("MXNET_TPU_FLASH_INTERPRET", "1")
+    assert fd.paged_window_mode(kp, 4) == "interpret"
+    # int8 pools always take the gathered dequant reference
+    assert fd.paged_window_mode(kp, 4, quantized=True) is None
+    # Mosaic sublane constraint carries over from the decode gate
+    odd = jnp.zeros((5, 2, 4, 16), jnp.float32)
+    assert fd.paged_window_mode(odd, 4) is None
+    before = fd._paged_fallback.count
+    a = fd.flash_decode_paged_window(qw, kp, vp, bt, vls)
+    b = fd.flash_decode_paged_window(qw, kp, vp, bt, vls,
+                                     use_flash=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+    assert fd._paged_fallback.count == before
+
+
+def test_paged_window_quantized_matches_fp32_loosely():
+    from mxnet_tpu.kernels.flash_decode import (
+        flash_decode_paged_window_quantized, quantize_kv,
+        reference_paged_window_attention)
+    qw, kc, vc, kp, vp, bt, vls = _window_data(seed=17)
+    k8, ks, v8, vs = quantize_kv(kp, vp)
+    out = flash_decode_paged_window_quantized(qw, k8, ks, v8, vs, bt,
+                                              vls, scale=0.25)
+    ref = reference_paged_window_attention(qw, kc, vc, vls, 0.25)
+    assert out.dtype == qw.dtype
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.08, atol=0.08)

@@ -1436,3 +1436,354 @@ def test_fleet_subprocess_kill_failover_zero_lost(net, tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+
+
+# -- chunked prefill + speculative decoding ---------------------------------
+
+from mxnet_tpu.serving.speculative import NgramProposer, as_proposer
+
+
+class _StubProposer:
+    """Deterministic test proposer: returns a fixed guess list
+    regardless of context (the server drops guess 0 on non-warm
+    ticks, so wrong[0] is free and wrong[1:] become the drafts)."""
+
+    def __init__(self, k, fn):
+        self.k = k
+        self._fn = fn
+
+    def propose(self, tokens):
+        return np.asarray(self._fn(np.asarray(tokens)), np.int32)
+
+
+def test_ngram_proposer_lookup():
+    p = NgramProposer(k=3, ngram=2)
+    # trailing bigram (1, 2) last occurred at the start
+    out = p.propose([1, 2, 9, 8, 1, 2])
+    assert out.tolist() == [9, 8, 1, 2]        # k + 1 guesses
+    # most recent occurrence wins over the earlier one
+    out = p.propose([1, 2, 5, 1, 2, 7, 1, 2])
+    assert out.tolist()[0] == 7
+    # unigram fallback when no bigram repeats
+    out = p.propose([4, 9, 4])
+    assert out.tolist() == [9, 4]
+    # nothing repeats -> empty
+    assert p.propose([1, 2, 3]).size == 0
+    assert p.propose([5]).size == 0
+
+
+def test_as_proposer_normalization():
+    assert as_proposer(None) is None
+    assert as_proposer(False) is None
+    assert isinstance(as_proposer(True), NgramProposer)
+    assert as_proposer(6).k == 6
+    stub = _StubProposer(2, lambda t: [])
+    assert as_proposer(stub) is stub
+    with pytest.raises(TypeError):
+        as_proposer("ngram")
+    with pytest.raises(ValueError):
+        NgramProposer(k=0)
+
+
+def test_chunked_prefill_16_requests_token_parity_one_compile(net):
+    """The acceptance bar with chunked prefill ON: 16 mixed-length
+    greedy requests, prefill spread over 4-token ticks, token-identical
+    to one-shot generate() with exactly ONE windowed-prefill compile
+    and ONE decode compile. The chunk window (start, len) is traced —
+    ragged tails never retrace."""
+    rs = np.random.RandomState(41)
+    server = InferenceServer(net, batch_slots=4, max_len=64,
+                             block_size=8, max_prompt_len=12,
+                             prefill_chunk_tokens=4)
+    reqs = _mixed_requests(server, rs, 16)
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] == 1, cs
+    assert cs["decode_compiles"] == 1, cs
+    assert cs["prefill_calls"] > 16      # chunks, not prompts
+    per = tracing.cache_stats()["per_block"]
+    assert per["serving_prefill_chunk"]["compiles"] == 1
+    for p, new, r in reqs:
+        assert r.state == "finished" and r.finish_reason == "length"
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=64)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), one[0, len(p):],
+            err_msg=f"request {r.id} diverged under chunked prefill")
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+@pytest.mark.parametrize("chunk,spec,prefix,blocks", [
+    (3, None, False, None),      # chunking alone
+    (4, None, True, None),       # chunking x prefix sharing
+    (4, None, False, 6),         # chunking x preemption (tight pool)
+    (5, 3, True, None),          # chunking x speculation x prefix
+    (None, 3, False, 6),         # speculation x preemption
+    (4, 2, True, 6),             # everything at once
+])
+def test_tail_latency_fuzz_grid(net, chunk, spec, prefix, blocks):
+    """Chunked prefill x speculative decoding x prefix sharing x
+    preemption x deadlines must be invisible in the tokens: every
+    combination is token-identical to one-shot generate() at exactly
+    1 prefill + 1 decode (+ <=1 verify) compile."""
+    rs = np.random.RandomState(43 + (chunk or 0) + (spec or 0))
+    kw = dict(batch_slots=3, max_len=32, block_size=4,
+              max_prompt_len=12, prefix_cache=prefix,
+              prefill_chunk_tokens=chunk, speculative=spec)
+    if blocks:
+        # tight pool: thrash hard, but let every victim retry through
+        kw.update(num_blocks=blocks, max_preemptions=20)
+    server = InferenceServer(net, **kw)
+    # programs are cached ACROSS servers keyed on executable shapes
+    # (num_blocks is not part of the key — the pool is a traced
+    # operand), so earlier grid cases may already have compiled this
+    # entry for a different pool shape: assert the DELTA this
+    # workload adds, which is what the compile discipline promises
+    cs0 = server.compile_stats()
+    base = rs.randint(0, 256, 12).astype(np.int32)
+    reqs = []
+    for i in range(8):
+        T = int(rs.randint(3, 13))
+        p = base[:T].copy() if (prefix and i % 2 == 0) \
+            else rs.randint(0, 256, T).astype(np.int32)
+        new = int(rs.randint(2, 9))
+        reqs.append((p, new, server.submit(p, max_new_tokens=new)))
+    # a dead-on-arrival request must time out without disturbing parity
+    doa = server.submit(rs.randint(0, 256, 5).astype(np.int32),
+                        max_new_tokens=4, deadline_s=0.0)
+    import time as _t
+    _t.sleep(0.002)
+    server.run()
+    assert doa.status == "timed_out"
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] - cs0["prefill_compiles"] <= 1, cs
+    assert cs["decode_compiles"] - cs0["decode_compiles"] <= 1, cs
+    assert cs.get("verify_compiles", 0) \
+        - cs0.get("verify_compiles", 0) <= 1, cs
+    if blocks:
+        assert sum(r.preemptions for _, _, r in reqs) >= 1
+    for p, new, r in reqs:
+        assert r.state == "finished" and r.status == "ok"
+        one = generate(net, p[None, :], max_new_tokens=new, max_len=32)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), one[0, len(p):],
+            err_msg=f"request {r.id} diverged (chunk={chunk} "
+                    f"spec={spec} prefix={prefix} blocks={blocks})")
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_chunk_budget_utilization_gauge(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(44)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=12,
+                                 prefill_chunk_tokens=4)
+        server.submit(rs.randint(0, 256, 11).astype(np.int32),
+                      max_new_tokens=3)
+        server.run()
+        g = telemetry.snapshot()["gauges"]
+        assert "serving_chunk_budget_utilization" in g
+        assert 0.0 < g["serving_chunk_budget_utilization"] <= 1.0
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_prefill_skip_on_full_prefix_cover(net):
+    """A prompt the prefix cache covers END-TO-END never dispatches a
+    prefill at all: the slot warms from the cached blocks and the
+    first decode tick re-derives the last prompt position's logits."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        rs = np.random.RandomState(45)
+        p = rs.randint(0, 256, 9).astype(np.int32)
+        server = InferenceServer(net, batch_slots=2, max_len=64,
+                                 block_size=8, max_prompt_len=12,
+                                 prefix_cache=True)
+        r1 = server.submit(p, max_new_tokens=6)
+        server.run()
+        calls_after_cold = server.compile_stats()["prefill_calls"]
+        r2 = server.submit(p.copy(), max_new_tokens=6)
+        server.run()
+        assert server.prefills_skipped == 1
+        # no second prefill dispatch happened
+        assert server.compile_stats()["prefill_calls"] == calls_after_cold
+        assert list(r2.output_tokens) == list(r1.output_tokens)
+        one = generate(net, p[None, :], max_new_tokens=6, max_len=64)
+        np.testing.assert_array_equal(np.asarray(r2.output_tokens),
+                                      one[0, 9:])
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serving_prefill_skipped_total"] == 1.0
+        assert server.stats()["prefills_skipped"] == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_prefill_skip_sampled_stream_parity(net):
+    """The warm first tick consumes no PRNG randomness the cold path
+    would not: a sampled request served from a full prefix hit emits
+    the same stream as the cold run at the same seed."""
+    rs = np.random.RandomState(46)
+    p = rs.randint(0, 256, 8).astype(np.int32)
+    server = InferenceServer(net, batch_slots=2, max_len=64,
+                             block_size=8, max_prompt_len=12,
+                             prefix_cache=True)
+    r1 = server.submit(p, max_new_tokens=8, temperature=0.8, seed=5)
+    server.run()
+    r2 = server.submit(p.copy(), max_new_tokens=8, temperature=0.8,
+                       seed=5)
+    server.run()
+    assert server.prefills_skipped == 1
+    assert list(r2.output_tokens) == list(r1.output_tokens)
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_speculative_all_rejected_keeps_parity(net):
+    """Adversarial proposer that always drafts wrong tokens: every
+    draft is rejected, throughput falls back to one token per tick,
+    and output stays token-identical — a bad proposer can never
+    corrupt the stream."""
+    rs = np.random.RandomState(47)
+    wrong = _StubProposer(3, lambda t: (t[-4:] + 1) % 256)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=8, max_prompt_len=8,
+                             speculative=wrong)
+    p = rs.randint(0, 256, 6).astype(np.int32)
+    r = server.submit(p, max_new_tokens=8)
+    server.run()
+    assert server.spec_tokens_accepted == 0
+    assert server.spec_tokens_rejected > 0
+    one = generate(net, p[None, :], max_new_tokens=8, max_len=32)
+    np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                  one[0, 6:])
+    assert server.compile_stats()["verify_compiles"] == 1
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_speculative_oracle_all_accepted(net):
+    """Oracle proposer drafting the true continuation: every draft is
+    accepted, so N tokens land in ~N/(k+1) verify dispatches — and the
+    output is still bit-identical to the non-speculative tick."""
+    rs = np.random.RandomState(48)
+    p = rs.randint(0, 256, 6).astype(np.int32)
+    one = np.asarray(generate(net, p[None, :], max_new_tokens=12,
+                              max_len=64))[0]
+
+    def oracle(tokens):
+        L = len(tokens)
+        return one[L:L + 4]  # k + 1 = 4 true next tokens
+
+    server = InferenceServer(net, batch_slots=2, max_len=64,
+                             block_size=8, max_prompt_len=8,
+                             speculative=_StubProposer(3, oracle))
+    r = server.submit(p, max_new_tokens=12)
+    server.run()
+    np.testing.assert_array_equal(np.asarray(r.output_tokens),
+                                  one[6:18])
+    assert server.spec_tokens_rejected == 0
+    assert server.spec_tokens_accepted >= 8
+    cs = server.compile_stats()
+    # 12 tokens in ~3 verify ticks, not 12 decode ticks
+    assert cs["verify_calls"] + cs["decode_calls"] <= 5, cs
+    assert server.stats()["draft_accept_rate"] == 1.0
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_speculative_rewind_under_cow(net):
+    """Rejected drafts must rewind blocks that were CoW-forked off
+    SHARED prefix content without corrupting the other owner: B and C
+    both warm-start on A's full-prefix blocks concurrently (refcount 2
+    on every shared block), each speculates into its own CoW fork of
+    the shared tail, rejects everything, rewinds — and all three
+    streams stay verbatim-identical to one-shot generate()."""
+    rs = np.random.RandomState(49)
+    p = rs.randint(0, 256, 9).astype(np.int32)   # ragged tail: 9 % 4
+    wrong = _StubProposer(3, lambda t: (t[-4:] + 7) % 256)
+    server = InferenceServer(net, batch_slots=3, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             prefix_cache=True, speculative=wrong)
+    ra = server.submit(p, max_new_tokens=5)
+    server.run()
+    rb = server.submit(p.copy(), max_new_tokens=5)
+    rc = server.submit(p.copy(), max_new_tokens=5)
+    server.run()                 # B and C share A's blocks live
+    assert server.prefills_skipped == 2
+    assert server.spec_tokens_rejected > 0
+    assert server.cache.stats()["cow_copies"] >= 1
+    one = np.asarray(generate(net, p[None, :], max_new_tokens=5,
+                              max_len=32))[0, 9:]
+    for r in (ra, rb, rc):
+        np.testing.assert_array_equal(np.asarray(r.output_tokens), one)
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+def test_speculative_sampled_requests_fall_back(net):
+    """temperature > 0 requests are never drafted (verify acceptance
+    is argmax-based); their streams match the non-speculative server
+    at the same seed even when greedy neighbors speculate."""
+    rs = np.random.RandomState(50)
+    p1 = rs.randint(0, 256, 6).astype(np.int32)
+    p2 = rs.randint(0, 256, 6).astype(np.int32)
+    outs = {}
+    for spec in (None, 3):
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8,
+                                 speculative=spec)
+        r1 = server.submit(p1, max_new_tokens=6, temperature=0.7,
+                           seed=9)
+        r2 = server.submit(p2, max_new_tokens=6)
+        server.run()
+        outs[spec] = (list(r1.output_tokens), list(r2.output_tokens))
+    assert outs[None] == outs[3]
+
+
+def test_spec_telemetry_counters_and_tpot_labels(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        # repetitive prompt so the n-gram proposer actually drafts
+        p = np.array([7, 3, 7, 3, 7, 3], np.int32)
+        server = InferenceServer(net, batch_slots=2, max_len=32,
+                                 block_size=8, max_prompt_len=8,
+                                 speculative=3)
+        server.submit(p, max_new_tokens=8)
+        server.run()
+        snap = telemetry.snapshot()
+        cnt = snap["counters"]
+        total = cnt.get("serving_spec_tokens_accepted_total", 0) \
+            + cnt.get("serving_spec_tokens_rejected_total", 0)
+        assert total > 0
+        assert "serving_draft_accept_rate" in snap["gauges"]
+        assert snap["histograms"][
+            "serving_tpot_seconds{spec=on}"]["count"] == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_chunked_prefill_health_backlog_signal(net):
+    rs = np.random.RandomState(52)
+    server = InferenceServer(net, batch_slots=1, max_len=32,
+                             block_size=8, max_prompt_len=12,
+                             prefill_chunk_tokens=4)
+    server.submit(rs.randint(0, 256, 12).astype(np.int32),
+                  max_new_tokens=2)
+    server.submit(rs.randint(0, 256, 10).astype(np.int32),
+                  max_new_tokens=2)
+    server.step()   # admit + first 4-token chunk
+    d = server.health_detail()
+    # 8 unprefilled tokens on the running slot + 10 queued
+    assert d["prefill_backlog_tokens"] == 18
+    assert d["prefill_chunk_tokens"] == 4
+    assert d["speculative"] is False
+    server.run()
+    assert server.health_detail()["prefill_backlog_tokens"] == 0

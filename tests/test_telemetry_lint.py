@@ -210,3 +210,13 @@ def test_router_module_is_scanned_and_clean():
     path = os.path.join(PKG, "serving", "router.py")
     assert path in _module_files(), "router.py missing from lint walk"
     assert _violations(path) == []
+
+
+def test_speculative_module_is_scanned_and_clean():
+    """Draft proposers run on the host inside the decode tick; the
+    module must stay telemetry-free (accept-rate accounting lives in
+    the server behind the gate) and inside the lint's walk."""
+    path = os.path.join(PKG, "serving", "speculative.py")
+    assert path in _module_files(), \
+        "speculative.py missing from lint walk"
+    assert _violations(path) == []
