@@ -19,7 +19,14 @@ site                   fires at
 ``checkpoint.truncate`` after a ``Checkpointer.save`` commit: truncates a
                         just-written array file (and per ``mode`` drops the
                         step's manifest) — a crash/bitrot mid-write
-``collective.timeout``  entry of ``KVStore.pushpull`` — raises
+``collective.timeout``  entry of ``KVStore.pushpull``, of the fused
+                        step's gather/permute dispatch
+                        (``FusedTrainStep.__call__`` / ``run_steps``
+                        when a weight all-gather or pipeline ppermute
+                        is part of the step), and of the eager ZeRO
+                        gathers (``MultiTensorUpdater`` stage<=2
+                        post-update gather and stage-3
+                        ``_materialize_bucket``) — raises
                         :class:`FaultTimeout` like a hung collective
 ``grad.nonfinite``      ``Trainer.step`` before the update — poisons one
                         parameter's gradient with NaN/Inf

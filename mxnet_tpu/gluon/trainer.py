@@ -156,6 +156,15 @@ class Trainer:
         self._kv_type = kvstore
         self._compression_params = dict(compression_params) \
             if compression_params else None
+        # widened per-direction wire config {"grads":..., "weights":...,
+        # "activations":...}: grads ride to the kvstore as before, the
+        # weights entry rides into the multi-tensor updater (quantized
+        # ZeRO weight gathers), activations only exist on the pipeline
+        # transport (FusedTrainStep) and are warned about there
+        self._weight_comp = None
+        cp = self._compression_params
+        if cp and {"grads", "weights", "activations"} & set(cp):
+            self._weight_comp = cp.get("weights")
         self._update_on_kvstore = update_on_kvstore
         self._init_done = False
         self._scale = 1.0
@@ -262,7 +271,8 @@ class Trainer:
         if self._mt_updater is None:
             self._mt_updater = _mt.MultiTensorUpdater(
                 self._optimizer, zero1=self._zero1_active,
-                num_shards=self._zero1_shards, stage=self._zero_stage)
+                num_shards=self._zero1_shards, stage=self._zero_stage,
+                weight_compression=self._weight_comp)
         return self._mt_updater
 
     def _resolve_zero(self) -> int:

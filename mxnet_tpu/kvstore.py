@@ -33,6 +33,10 @@ class KVStore:
         self._optimizer = None
         self._opt_states: Dict = {}
         self._compression = None
+        # weights-direction wire compression (block-scaled int8/fp8):
+        # set via the widened set_gradient_compression({"weights": ...})
+        # config; affects the gathered-byte accounting of pull()
+        self._weight_compression = None
         # degrade-path warnings fire once per STORE, not once per bucket
         # (a 100-bucket model must not emit 100 identical warnings)
         self._warned_once: set = set()
@@ -70,19 +74,38 @@ class KVStore:
         bits = 2 if self._compression.get("type", "2bit") == "2bit" else 8
         return (n * bits + 7) // 8
 
+    def _weight_wire_nbytes(self, value) -> int:
+        """Wire bytes of a weights-direction (gathered) payload under
+        block-scaled int8/fp8 compression: 1 byte per element plus one
+        fp32 scale per block (parallel/compression.py wire format).
+        Sparse values are never quantized."""
+        if isinstance(value, list):
+            return sum(self._weight_wire_nbytes(v) for v in value)
+        if isinstance(value, RowSparseNDArray):
+            return self._nbytes(value)
+        from .parallel.compression import wire_nbytes
+        data = value._data if isinstance(value, NDArray) else value
+        wc = self._weight_compression
+        return wire_nbytes(int(getattr(data, "size", 0)),
+                           wc["type"], wc["block"])
+
     def _count_bytes(self, op: str, value):
         """Feed the `comm_bytes_{pushed,reduced,gathered}` telemetry
         counter families (labels: store type, kind=logical|wire). Only
         the base data-plane primitives call this — bucket helpers
         delegate to pushpull and are counted there, so nothing is
-        double-counted. Compression applies to the gradient direction
-        (pushed/reduced); weight pulls travel uncompressed."""
+        double-counted. Gradient compression applies to the gradient
+        direction (pushed/reduced); weight wire compression, when
+        configured, applies to the gathered direction (pulls)."""
         if not _tm._ENABLED:
             return
         logical = self._nbytes(value)
-        compressed = (self._compression is not None
-                      and op in ("pushed", "reduced"))
-        wire = self._wire_nbytes(value, compressed)
+        if op == "gathered" and self._weight_compression is not None:
+            wire = self._weight_wire_nbytes(value)
+        else:
+            compressed = (self._compression is not None
+                          and op in ("pushed", "reduced"))
+            wire = self._wire_nbytes(value, compressed)
         fam = _tm.counter(
             f"comm_bytes_{op}",
             "bytes moved by kvstore collectives (logical vs wire)")
@@ -356,8 +379,38 @@ class KVStore:
         (reference: src/kvstore/gradient_compression.cc). Eager pushes
         quantize each replica's gradient before aggregation; the fused
         mesh path quantizes the allreduce itself
-        (parallel/compression.py, FusedTrainStep(compression=...))."""
+        (parallel/compression.py, FusedTrainStep(compression=...)).
+
+        Also accepts the widened per-direction config
+        ``{"grads": ..., "weights": ..., "activations": ...}``: the
+        grads entry behaves like the legacy flat dict, the weights
+        entry (block-scaled ``int8``/``fp8``) switches the gathered
+        direction of pull() to wire-byte accounting, and activations —
+        a pipeline-transport concern with no eager-store wire — warns
+        once and is ignored."""
         params = dict(compression_params)
+        if {"grads", "weights", "activations"} & set(params):
+            unknown = set(params) - {"grads", "weights", "activations"}
+            if unknown:
+                raise ValueError(
+                    f"unknown compression directions {sorted(unknown)} "
+                    "(expected 'grads', 'weights', 'activations')")
+            if params.get("activations") is not None:
+                self._warn_once(
+                    "compression.activations",
+                    "activation wire compression only applies to the "
+                    "pipeline transport (FusedTrainStep(pipeline=...)); "
+                    "the eager kvstore moves no activations — ignored")
+            from .parallel.data_parallel import _normalize_wire_cfg
+            self._weight_compression = _normalize_wire_cfg(
+                params.get("weights"), "weights")
+            grads = params.get("grads")
+            if grads is None:
+                self._compression = None
+                self._residuals = {}
+                return
+            params = {"type": grads} if isinstance(grads, str) \
+                else dict(grads)
         ctype = params.get("type", "2bit")
         if ctype not in ("2bit", "int8"):
             raise ValueError(

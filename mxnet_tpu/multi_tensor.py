@@ -40,6 +40,8 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from . import faults as _ft
+from . import flight as _fl
 from . import telemetry as _tm
 
 __all__ = ["MultiTensorUpdater", "plan_buckets", "flatten_buckets",
@@ -235,7 +237,7 @@ class _ZeroGroup:
                  "wshards", "wrote", "home", "params", "reqs", "gdtype",
                  "flat1_fns", "pad1_fns", "flatpad1_fns", "unflat1_fns",
                  "pending", "gshards", "gfresh", "baccum", "k2bucket",
-                 "inflight")
+                 "inflight", "wq1_fns", "wdq1_fns", "wire_bytes")
 
     def __init__(self, idxs, mp, plans, padded, segs, shard, flatten_fn,
                  flatpad_fn, pad_fn, wpad_fn, update_fn, unflatten_fn,
@@ -294,9 +296,33 @@ class MultiTensorUpdater:
 
     def __init__(self, optimizer, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                  zero1: bool = False, num_shards: int = None,
-                 stage: int = None):
+                 stage: int = None, weight_compression=None):
         self.optimizer = optimizer
         self.bucket_bytes = bucket_bytes
+        #: weights-direction wire compression for the ZeRO gathers
+        #: (block-scaled int8/fp8, parallel/compression.py): the shard
+        #: quantizes before the shard->home transfer, dequantizes on
+        #: arrival. The eager chain is drift-free without residuals —
+        #: zg.wshards (the authoritative copy) is never quantized, only
+        #: the transient materialized replicas are.
+        from .parallel.data_parallel import _normalize_wire_cfg
+        wc = _normalize_wire_cfg(weight_compression, "weights")
+        if wc is not None:
+            import warnings
+            if wc.pop("residual", False):
+                warnings.warn(
+                    "weight_compression residual mode is a fused-step "
+                    "(FusedTrainStep zero=3) concern; the eager "
+                    "updater's authoritative sharded weights are never "
+                    "quantized, so gathers are drift-free without it — "
+                    "ignored")
+            if (int(stage) if stage is not None
+                    else (1 if zero1 else 0)) < 1:
+                warnings.warn(
+                    "weight_compression requires a ZeRO stage (the "
+                    "unsharded fused path gathers no weights); ignored")
+                wc = None
+        self._wcomp = wc
         self._cache: Dict = {}
         #: trace count — cache misses; steady state adds zero
         self.compiles = 0
@@ -609,11 +635,29 @@ class MultiTensorUpdater:
         # land committed there, which matches where eager NDArray data
         # already lives; explicit device_put remains the path back onto
         # any mesh.
+        if _ft._ACTIVE:
+            _ft.timeout_point("collective.timeout")
+        fl_on = _fl._ENABLED
+        if fl_on:
+            t0 = time.monotonic()
+            _fl.record("collective", "zero.weight_gather",
+                       store=f"zero{stage}",
+                       bytes=sum(w for (_, w) in zg.wire_bytes))
         with _tm.phase("weight_gather"):
-            new_ws = zg.unflatten_fn(jax.device_put(
-                w_bks, [zg.home] * len(w_bks)))
+            if self._wcomp is not None:
+                futs = [self._gather_dispatch(zg, j, b)
+                        for j, b in enumerate(w_bks)]
+                homed = [self._gather_finish(zg, j, f)
+                         for j, f in enumerate(futs)]
+            else:
+                homed = jax.device_put(w_bks, [zg.home] * len(w_bks))
+            new_ws = zg.unflatten_fn(homed)
             for k, (i, p, _) in enumerate(members):
                 p.data()._data = new_ws[k]
+        self._count_gather_bytes(zg, range(len(w_bks)))
+        if fl_on:
+            _fl.record("collective_done", "zero.weight_gather",
+                       dur_s=time.monotonic() - t0)
         zg.wrote = list(new_ws)
 
     def _weights_clean(self, zg) -> bool:
@@ -732,7 +776,11 @@ class MultiTensorUpdater:
             return
         if force and not buf and zg.gfresh[j]:
             return  # nothing new since the last flush
-        leaves = []
+        # keyed by the member's GROUP index k: the per-bucket jitted
+        # fns index leaves[k] through the plan, and for any bucket past
+        # the first k is not bucket-local (a dict is a pytree, so the
+        # jit signature stays stable per bucket)
+        leaves = {}
         for (k, off, size, shape) in plan:
             g = buf.get(k)
             if g is None:
@@ -742,7 +790,7 @@ class MultiTensorUpdater:
                     g = d  # manually written full grad
                 else:
                     g = jnp.zeros(shape, zg.gdtype)
-            leaves.append(g)
+            leaves[k] = g
         buf.clear()
         t0 = time.perf_counter() if _tm._ENABLED else 0.0
         kv = self._hook_kvstore
@@ -819,6 +867,35 @@ class MultiTensorUpdater:
         zg.gfresh = [False] * nbk
         return out
 
+    # -- weights-direction wire (gathers): quantize/count/finish -----------
+    def _gather_dispatch(self, zg, j, bucket):
+        """Dispatch bucket j's shard->home transfer. With weight wire
+        compression the sharded bucket quantizes first, so the 1-byte
+        codes + per-block fp32 scales are what travels; otherwise the
+        flat bucket moves at its logical size."""
+        if self._wcomp is None:
+            return jax.device_put(bucket, zg.home)
+        return jax.device_put(zg.wq1_fns[j](bucket), zg.home)
+
+    def _gather_finish(self, zg, j, fut):
+        """Resolve a dispatched transfer to the full-precision flat
+        bucket at home (dequantizing when compressed)."""
+        if self._wcomp is None:
+            return fut
+        return zg.wdq1_fns[j](*fut)
+
+    def _count_gather_bytes(self, zg, js):
+        if not _tm._ENABLED:
+            return
+        fam = _tm.counter(
+            "comm_bytes_gathered",
+            "bytes moved by kvstore collectives (logical vs wire)")
+        store = f"zero{self.stage}"
+        fam.labels(store=store, kind="logical").inc(
+            sum(zg.wire_bytes[j][0] for j in js))
+        fam.labels(store=store, kind="wire").inc(
+            sum(zg.wire_bytes[j][1] for j in js))
+
     # -- ZeRO-3: sharded weights with just-in-time gathers -----------------
     def _release_group(self, zg):
         """Drop every member's full-size weight array, leaving a
@@ -843,15 +920,28 @@ class MultiTensorUpdater:
         its members' arrays; dispatch the NEXT bucket's gather async
         (one-bucket lookahead) so sequential layer access — fwd or bwd —
         hides the gather latency."""
+        if _ft._ACTIVE:
+            _ft.timeout_point("collective.timeout")
+        fl_on = _fl._ENABLED
+        if fl_on:
+            t0 = time.monotonic()
+            _fl.record("collective", "zero3.gather", bucket=j,
+                       store=f"zero{self.stage}",
+                       bytes=zg.wire_bytes[j][1])
         fut = zg.inflight.pop(j, None)
         if fut is None:
-            fut = jax.device_put(zg.wshards[j], zg.home)
+            fut = self._gather_dispatch(zg, j, zg.wshards[j])
         jn = j + 1
         if jn < len(zg.plans) and jn not in zg.inflight and any(
                 not isinstance(zg.params[k]._data._data, jax.Array)
                 for (k, _, _, _) in zg.plans[jn]):
-            zg.inflight[jn] = jax.device_put(zg.wshards[jn], zg.home)
-        leaves = zg.unflat1_fns[j](fut)
+            zg.inflight[jn] = self._gather_dispatch(zg, jn,
+                                                    zg.wshards[jn])
+        leaves = zg.unflat1_fns[j](self._gather_finish(zg, j, fut))
+        self._count_gather_bytes(zg, (j,))
+        if fl_on:
+            _fl.record("collective_done", "zero3.gather", bucket=j,
+                       dur_s=time.monotonic() - t0)
         for arr, (k, _, _, _) in zip(leaves, zg.plans[j]):
             p = zg.params[k]
             if not isinstance(p._data._data, jax.Array):
@@ -895,7 +985,9 @@ class MultiTensorUpdater:
                 for ga in buf.values():
                     t += ga.nbytes
             for fut in (zg.inflight or {}).values():
-                t += fut.nbytes
+                # compressed prefetches are (codes, scales) pairs
+                t += sum(x.nbytes
+                         for x in jax.tree_util.tree_leaves(fut))
         return {"weights": w, "grads": g, "opt_state": o, "transient": t}
 
     def _reduce_scatter(self, kvstore, gid, buckets):
@@ -1017,6 +1109,32 @@ class MultiTensorUpdater:
                 lambda b, plan=plan:
                 [jax.lax.slice(b, (off,), (off + size,)).reshape(shape)
                  for (_, off, size, shape) in plan]))
+        # weights-direction wire compression: per-bucket quantize (runs
+        # on the sharded bucket BEFORE the shard->home transfer, so the
+        # 1-byte codes + per-block fp32 scales are what travels) and
+        # dequantize (at home, on arrival) executables; plus the
+        # per-bucket (logical, wire) gathered-byte stats either way so
+        # the A/B accounting always has both sides
+        bdt = wdtype if mp else wmeta[0].dtype
+        isz = jnp.dtype(bdt).itemsize
+        wc = self._wcomp
+        if wc is not None:
+            from .parallel.compression import (block_dequantize,
+                                               block_quantize,
+                                               wire_nbytes)
+            zg.wq1_fns, zg.wdq1_fns = [], []
+            for tot in padded:
+                zg.wq1_fns.append(jax.jit(
+                    lambda b, sch=wc["type"], blk=wc["block"]:
+                    block_quantize(b, sch, blk)))
+                zg.wdq1_fns.append(jax.jit(
+                    lambda c, s, tot=tot, dt=bdt:
+                    block_dequantize(c, s, n=tot, dtype=dt)))
+            zg.wire_bytes = [
+                (tot * isz, wire_nbytes(tot, wc["type"], wc["block"]))
+                for tot in padded]
+        else:
+            zg.wire_bytes = [(tot * isz, tot * isz) for tot in padded]
         zg.pending = [dict() for _ in range(nbk)]
         zg.gshards = [None] * nbk
         zg.gfresh = [False] * nbk

@@ -17,8 +17,28 @@ Schemes:
 
 Both return the *mean* over the `dp` axis (matching what XLA's implicit
 backward allreduce produces for a mean loss).
+
+Beyond gradients (EQuARX, arXiv:2506.17615): the dominant wire bytes at
+pod scale are the *weight* all-gathers (ZeRO-1/2 post-update rebuild,
+ZeRO-3 in-step rematerialization) and the pipeline's per-tick activation
+``ppermute`` hops. :func:`quantized_all_gather` and
+:func:`quantized_ppermute` cover those directions with block-scaled
+int8 / fp8-e4m3 transport: the local shard is quantized with one fp32
+scale per ``block`` contiguous elements, the 1-byte payload plus the
+scales ride the collective, and dequantization happens on arrival.
+All-gather is lossy-but-stateless per step (no feedback state needed —
+each step re-gathers from the exact master shard), and the gathering
+rank's OWN slice is patched back bit-exact, so the owner's
+weight round-trip never picks up quantization error. The optional
+error-feedback mode (:func:`quantized_all_gather_ef`) additionally keeps
+a per-shard residual so the *transmitted* view of a slowly-moving weight
+is drift-free across steps. ``quantized_ppermute`` is differentiable
+(custom_vjp: the cotangent rides the inverted permutation, quantized the
+same way) so it composes with ``jax.grad`` through the GPipe schedule.
 """
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +46,184 @@ from jax import lax
 
 __all__ = ["compressed_psum", "compressed_psum_scatter",
            "compressed_psum_tree", "quantize_2bit",
-           "dequantize_2bit", "quantize_int8"]
+           "dequantize_2bit", "quantize_int8",
+           "WIRE_SCHEMES", "DEFAULT_BLOCK", "block_quantize",
+           "block_dequantize", "quantized_all_gather",
+           "quantized_all_gather_ef", "quantized_ppermute",
+           "wire_nbytes"]
+
+# -- block-scaled wire schemes (weights / activations) -----------------------
+
+#: wire schemes usable for the gather/permute directions (2-bit stays
+#: gradient-only: it needs error feedback to converge, which stateless
+#: per-step gathers cannot carry for the non-owned portions)
+WIRE_SCHEMES = ("int8", "fp8")
+
+#: elements sharing one fp32 scale. 128 divides every ZeRO lane-aligned
+#: shard size (multi_tensor.ZERO1_LANE == 128) so weight shards never pad.
+DEFAULT_BLOCK = 128
+
+
+def _wire_dtype_qmax(scheme):
+    if scheme == "int8":
+        return jnp.int8, 127.0
+    if scheme == "fp8":
+        # e4m3 saturates at 448; clip BEFORE the cast — on some backends
+        # an out-of-range fp32->fp8 cast produces nan, not +-max
+        return jnp.float8_e4m3fn, float(jnp.finfo(jnp.float8_e4m3fn).max)
+    raise ValueError(f"unknown wire scheme {scheme!r} "
+                     f"(supported: {WIRE_SCHEMES})")
+
+
+def wire_nbytes(n_elem: int, scheme, block: int = DEFAULT_BLOCK) -> int:
+    """Bytes one shard of `n_elem` elements occupies ON the wire under a
+    block-scaled scheme: 1-byte codes (padded to a whole block) plus one
+    fp32 scale per block. `scheme=None` means uncompressed fp32."""
+    if scheme is None:
+        return int(n_elem) * 4
+    nb = -(-int(n_elem) // int(block))
+    return nb * int(block) + nb * 4
+
+
+def block_quantize(x, scheme="int8", block=DEFAULT_BLOCK):
+    """Quantize a tensor with per-block fp32 scales.
+
+    Returns ``(codes, scales)``: codes ``(nb, block)`` in the wire dtype
+    (int8 or fp8-e4m3), scales ``(nb, 1)`` fp32, where
+    ``nb = ceil(x.size / block)`` (the tail block is zero-padded).
+    Scales are abs-max / qmax per block — traced values, never Python
+    floats, so one executable serves every step."""
+    dt, qmax = _wire_dtype_qmax(scheme)
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(nb, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scales = jnp.maximum(amax / qmax, 1e-30)
+    q = blocks / scales
+    if scheme == "int8":
+        codes = jnp.clip(jnp.round(q), -127, 127).astype(dt)
+    else:
+        codes = jnp.clip(q, -qmax, qmax).astype(dt)
+    return codes, scales
+
+
+def block_dequantize(codes, scales, n=None, shape=None, dtype=jnp.float32):
+    """Invert :func:`block_quantize`: codes*(per-block scale), flattened,
+    sliced back to `n` elements (or ``prod(shape)``), reshaped."""
+    out = (codes.astype(jnp.float32) * scales).reshape(-1)
+    if shape is not None:
+        n = 1
+        for d in shape:
+            n *= int(d)
+    if n is not None and n != out.shape[0]:
+        out = out[:n]
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def quantized_all_gather(shard, axis_name, scheme="int8",
+                         block=DEFAULT_BLOCK, exact_self=True):
+    """Block-scaled quantized ``all_gather(axis=0, tiled=True)``.
+
+    Each rank quantizes its local shard, the int8/fp8 codes + fp32
+    scales ride the gather, and every rank dequantizes the N shards on
+    arrival. With ``exact_self`` (default) the gathering rank patches
+    its OWN slice back in bit-exact — the owner's weight round-trip
+    (master shard -> wire -> gathered full -> slice own) stays lossless,
+    so per-step quantization error never accumulates into the masters.
+
+    Stateless by design: no residual is carried because each step
+    re-quantizes from the exact master shard (lossy-but-stateless).
+    Shapes: shard ``(s, ...)`` -> returns ``(N*s, ...)`` in shard.dtype.
+    """
+    shape = shard.shape
+    flat = shard.reshape(-1)
+    ssz = flat.shape[0]
+    codes, scales = block_quantize(flat, scheme, block)
+    gc = lax.all_gather(codes, axis_name, axis=0)    # (N, nb, block)
+    gs = lax.all_gather(scales, axis_name, axis=0)   # (N, nb, 1)
+    n_ranks = gc.shape[0]
+    deq = (gc.astype(jnp.float32) * gs).reshape(n_ranks, -1)[:, :ssz]
+    if exact_self:
+        idx = lax.axis_index(axis_name)
+        deq = lax.dynamic_update_slice(
+            deq, flat.astype(jnp.float32)[None, :], (idx, 0))
+    out = deq.reshape((n_ranks * shape[0],) + tuple(shape[1:]))
+    return out.astype(shard.dtype)
+
+
+def quantized_all_gather_ef(shard, residual, axis_name, scheme="int8",
+                            block=DEFAULT_BLOCK):
+    """Error-feedback variant for ZeRO-3 weight rematerialization: the
+    carried residual folds into the shard before quantization and the
+    un-sent remainder becomes the next step's residual, so the
+    *transmitted* view of each weight shard is drift-free across steps
+    (the time-average of what other ranks see converges to the master
+    even while it moves). The own-rank slice is still patched exact.
+
+    Returns ``(full, new_residual)`` — residual is fp32, shard-shaped.
+    """
+    shape = shard.shape
+    flat = shard.reshape(-1).astype(jnp.float32)
+    ssz = flat.shape[0]
+    g = flat + residual.reshape(-1)
+    codes, scales = block_quantize(g, scheme, block)
+    sent = block_dequantize(codes, scales, n=ssz)
+    new_residual = (g - sent).reshape(shape)
+    gc = lax.all_gather(codes, axis_name, axis=0)
+    gs = lax.all_gather(scales, axis_name, axis=0)
+    n_ranks = gc.shape[0]
+    deq = (gc.astype(jnp.float32) * gs).reshape(n_ranks, -1)[:, :ssz]
+    idx = lax.axis_index(axis_name)
+    deq = lax.dynamic_update_slice(deq, flat[None, :], (idx, 0))
+    out = deq.reshape((n_ranks * shape[0],) + tuple(shape[1:]))
+    return out.astype(shard.dtype), new_residual
+
+
+def _qpermute(x, axis_name, perm, scheme, block):
+    flat = x.reshape(-1)
+    codes, scales = block_quantize(flat, scheme, block)
+    pc = lax.ppermute(codes, axis_name, perm)
+    ps = lax.ppermute(scales, axis_name, perm)
+    # non-target ranks receive zero codes AND zero scales -> zeros out,
+    # matching lax.ppermute's fill semantics
+    return block_dequantize(pc, ps, n=flat.shape[0],
+                            shape=x.shape, dtype=x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _quantized_ppermute(x, axis_name, perm, scheme, block):
+    return _qpermute(x, axis_name, perm, scheme, block)
+
+
+def _qpermute_fwd(x, axis_name, perm, scheme, block):
+    return _qpermute(x, axis_name, perm, scheme, block), None
+
+
+def _qpermute_bwd(axis_name, perm, scheme, block, _res, ct):
+    inv = tuple((d, s) for (s, d) in perm)
+    return (_qpermute(ct, axis_name, inv, scheme, block),)
+
+
+_quantized_ppermute.defvjp(_qpermute_fwd, _qpermute_bwd)
+
+
+def quantized_ppermute(x, axis_name, perm, scheme="int8",
+                       block=DEFAULT_BLOCK):
+    """Block-scaled quantized ``lax.ppermute``: quantize locally, route
+    the 1-byte codes + fp32 scales, dequantize on the receiving rank.
+    Differentiable — the cotangent rides the *inverted* permutation,
+    quantized with the same scheme, so GPipe's autodiff backward pass
+    and 1F1B's explicit cotangent shifts both compress symmetrically.
+    Ranks that are not a destination in `perm` receive zeros (same fill
+    rule as ``lax.ppermute``). Output keeps ``x.dtype``."""
+    perm = tuple((int(a), int(b)) for (a, b) in perm)
+    return _quantized_ppermute(x, axis_name, perm, scheme, int(block))
 
 
 def quantize_2bit(x, threshold):

@@ -30,6 +30,32 @@ from .mesh import current_mesh, use_mesh
 __all__ = ["FusedTrainStep", "ShardedForward", "split_batch_spec"]
 
 
+def _normalize_wire_cfg(cfg, direction):
+    """Validate/normalize one weights/activations wire-compression entry
+    of the widened ``compression={"weights":..., "activations":...,
+    "grads":...}`` config. Accepts a scheme string or a dict; returns
+    ``{"type", "block", "residual"}``. 2-bit is rejected outright: it
+    needs error-feedback state to converge, which the stateless
+    per-step gather/permute transport cannot carry for non-owned
+    slices."""
+    if cfg is None:
+        return None
+    from .compression import DEFAULT_BLOCK, WIRE_SCHEMES
+    if isinstance(cfg, str):
+        cfg = {"type": cfg}
+    cfg = dict(cfg)
+    ctype = cfg.get("type", "int8")
+    if ctype not in WIRE_SCHEMES:
+        raise ValueError(
+            f"{direction} wire compression supports {WIRE_SCHEMES}; "
+            f"got {ctype!r} (the 2-bit scheme is gradient-only: it "
+            "relies on error feedback, which per-step weight/"
+            "activation transport cannot carry)")
+    return {"type": ctype,
+            "block": int(cfg.get("block", DEFAULT_BLOCK)),
+            "residual": bool(cfg.get("residual", False))}
+
+
 def split_batch_spec(ndim: int, axis: int = 0, dp_axis: str = "dp"):
     spec = [None] * ndim
     spec[axis] = dp_axis
@@ -199,10 +225,31 @@ class FusedTrainStep:
         self.donate = donate
         self.n_model_inputs = n_model_inputs
         self.grad_accum = grad_accum
-        # {"type": "2bit"|"int8", "threshold": float} — quantized
-        # allreduce with error feedback (reference:
-        # src/kvstore/gradient_compression.cc; see parallel/compression)
-        self.compression = dict(compression) if compression else None
+        # compression config, two accepted shapes:
+        #   legacy flat {"type": "2bit"|"int8", "threshold": float} —
+        #     gradient compression only (quantized allreduce with error
+        #     feedback; reference: src/kvstore/gradient_compression.cc)
+        #   widened {"grads": {...}, "weights": {...},
+        #            "activations": {...}} — per-direction wire
+        #     compression: grads keep the legacy semantics; weights
+        #     quantize the ZeRO weight all-gathers (block-scaled
+        #     int8/fp8, parallel/compression.quantized_all_gather);
+        #     activations quantize the pipeline's per-tick ppermute
+        #     hops + last-stage broadcast (quantized_ppermute)
+        comp = dict(compression) if compression else None
+        self._wire_weights = None
+        self._wire_acts = None
+        if comp is not None and ({"weights", "activations", "grads"}
+                                 & comp.keys()):
+            g = comp.get("grads")
+            self.compression = ({"type": g} if isinstance(g, str)
+                                else dict(g)) if g else None
+            self._wire_weights = _normalize_wire_cfg(
+                comp.get("weights"), "weights")
+            self._wire_acts = _normalize_wire_cfg(
+                comp.get("activations"), "activations")
+        else:
+            self.compression = comp
         # ZeRO weight-update sharding (arXiv:2004.13336), all inside the
         # one compiled step so XLA schedules the collectives into the
         # backward. zero=1: grads reduce-scatter per flat bucket, each
@@ -234,6 +281,39 @@ class FusedTrainStep:
                              f"count; got {pipeline!r}")
         self.pipeline = int(pipeline) if pipeline is not None else None
         self.pp_axis = pp_axis
+        # degrade matrix for the widened wire-compression config: each
+        # unfusable combination warns ONCE (at construction) and runs
+        # without the requested compression rather than failing the run
+        import warnings as _warnings
+        if self._wire_weights is not None and self.zero_stage == 0:
+            _warnings.warn(
+                "compression={'weights': ...} requested without ZeRO "
+                "(zero=0): there is no weight all-gather on the wire "
+                "to compress — training with uncompressed weights",
+                RuntimeWarning, stacklevel=2)
+            self._wire_weights = None
+        if self._wire_weights is not None and \
+                self._wire_weights["residual"] and self.zero_stage != 3:
+            _warnings.warn(
+                "weight-compression residual mode applies to zero=3 "
+                "(resident shards re-gathered every step); under "
+                f"zero={self.zero_stage} the gather source is already "
+                "the exact post-update shard — ignoring residual=True",
+                RuntimeWarning, stacklevel=2)
+            self._wire_weights = dict(self._wire_weights,
+                                      residual=False)
+        if self._wire_acts is not None and self.pipeline is None:
+            _warnings.warn(
+                "compression={'activations': ...} requested without "
+                "pipeline=M: there are no activation ppermute hops to "
+                "compress — ignoring the activations entry",
+                RuntimeWarning, stacklevel=2)
+            self._wire_acts = None
+        # static per-step (logical, wire) byte totals for the quantized
+        # gather/permute directions — filled by the builders, flushed
+        # to the comm_bytes_{gathered,permuted} counters per step
+        self._wire_gathered = None
+        self._wire_permuted = None
         self._pp_staged = None
         self._pp_mask = None
         self._compiled = None
@@ -415,6 +495,13 @@ class FusedTrainStep:
                 "plain fused step (sequential semantics); build a "
                 "hybrid_mesh(dp=..., pp=...) to pipeline",
                 RuntimeWarning, stacklevel=3)
+            if self._wire_acts is not None:
+                warnings.warn(
+                    "activation wire compression requested but the "
+                    "pipeline fell back to the plain step — no "
+                    "inter-stage hops exist; ignoring the "
+                    "'activations' entry", RuntimeWarning, stacklevel=3)
+                self._wire_acts = None
         with use_mesh(self.mesh):
             entry = self.net.trace_entry(
                 list(args[:self.n_model_inputs]), training=True)
@@ -506,6 +593,13 @@ class FusedTrainStep:
                 f"{self.dp_axis!r} axis of size > 1 — nothing to shard "
                 "the update over; running unsharded",
                 RuntimeWarning, stacklevel=3)
+            if self._wire_weights is not None:
+                warnings.warn(
+                    "weight wire compression requested but the ZeRO "
+                    "build fell back to unsharded — no weight "
+                    "all-gather exists; ignoring the 'weights' entry",
+                    RuntimeWarning, stacklevel=3)
+                self._wire_weights = None
         if self.compression is not None:
             if self.mesh is not None and \
                     self.dp_axis in self.mesh.axis_names:
@@ -712,6 +806,28 @@ class FusedTrainStep:
         if self.compression is not None:
             scheme = self.compression.get("type", "2bit")
             threshold = float(self.compression.get("threshold", 0.5))
+        # weight wire compression: the post-update (zero=1/2) or
+        # in-step (zero=3) weight all-gather moves block-scaled
+        # int8/fp8 codes + fp32 scales instead of fp32 shards
+        wcfg = self._wire_weights
+        wscheme = wcfg["type"] if wcfg is not None else None
+        wblock = wcfg["block"] if wcfg is not None else None
+        wres = bool(wcfg is not None and wcfg["residual"]
+                    and self.zero_stage >= 3)
+        # one flag drives the resid-carrying step signature: grad
+        # error-feedback residuals and weight-gather residuals ride the
+        # same shard-sharded dict (grad keys `__zero1__…`, weight keys
+        # `__wres__…`), independently present
+        has_resid = (scheme is not None) or wres
+        if wscheme is not None:
+            from .compression import (quantized_all_gather,
+                                      quantized_all_gather_ef,
+                                      wire_nbytes)
+
+        def _wgather(v):
+            if wscheme is not None:
+                return quantized_all_gather(v, dp, wscheme, wblock)
+            return lax.all_gather(v, dp, axis=0, tiled=True)
 
         # group trainables by (weight dtype, optimizer-state structure)
         # so every bucket flattens homogeneous leaves; the state probe
@@ -848,20 +964,36 @@ class FusedTrainStep:
             return (lsum / accum, new_aux,
                     {k: v / accum for k, v in rsum.items()})
 
+        def _wkey(gi, j):
+            return f"__wres__{gi}_{j}"
+
         def step(tr, aux, states, hyper, key, resid, *batch):
             # distinct dropout keys per dp shard
             key = jax.random.fold_in(key, lax.axis_index(dp))
             rank = lax.axis_index(dp)
+            new_wres = {}
             if z3:
                 # transient gather: full-size weights exist only inside
                 # the executable (XLA frees each bucket's gather after
-                # its last use); the resident weights are the shards
+                # its last use); the resident weights are the shards.
+                # Under weight wire compression the gather moves int8/
+                # fp8 codes + per-block fp32 scales; residual mode
+                # additionally carries per-shard error feedback so the
+                # transmitted view is drift-free across steps
                 wsh = tr
                 tr = {}
                 for gi, g in enumerate(grp_list):
-                    fulls = [lax.all_gather(wsh[_sk3(gi, j)], dp,
-                                            axis=0, tiled=True)
-                             for j in range(len(g.plans))]
+                    fulls = []
+                    for j in range(len(g.plans)):
+                        if wres:
+                            fb, nr = quantized_all_gather_ef(
+                                wsh[_sk3(gi, j)],
+                                resid[_wkey(gi, j)][0],
+                                dp, wscheme, wblock)
+                            new_wres[_wkey(gi, j)] = nr[None]
+                        else:
+                            fb = _wgather(wsh[_sk3(gi, j)])
+                        fulls.append(fb)
                     for n, w in zip(g.names, _mt.unflatten_buckets(
                             fulls, g.plans, len(g.names))):
                         tr[n] = w
@@ -908,14 +1040,15 @@ class FusedTrainStep:
                         # shard — updated weights never all-gather
                         new_tr[_sk3(gi, j)] = nw
                     else:
-                        full.append(lax.all_gather(nw, dp, axis=0,
-                                                   tiled=True))
+                        full.append(_wgather(nw))
                 if not z3:
                     for n, w in zip(g.names, _mt.unflatten_buckets(
                             full, g.plans, len(g.names))):
                         new_tr[n] = w
             out = (loss, gnorm, new_tr, new_aux, new_states)
-            return out + ((new_resid,) if scheme is not None else ())
+            if has_resid:
+                return out + ({**new_resid, **new_wres},)
+            return out
 
         batch_specs = tuple(split_batch_spec(
             _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp)
@@ -927,10 +1060,18 @@ class FusedTrainStep:
         in_specs = (tr_spec, P(), st_spec, P(), P())
         out_specs = (P(), tr_spec, P(), st_spec)
         loop_out_specs = (P(), P()) + out_specs[1:]
+        resid_spec = {}
         if scheme is not None:
-            in_specs = in_specs + (st_spec,)
-            out_specs = out_specs + (st_spec,)
-            loop_out_specs = loop_out_specs + (st_spec,)
+            resid_spec.update({k: P(dp) for k in state_keys})
+        if wres:
+            resid_spec.update(
+                {_wkey(gi, j): P(dp)
+                 for gi, g in enumerate(grp_list)
+                 for j in range(len(g.plans))})
+        if has_resid:
+            in_specs = in_specs + (resid_spec,)
+            out_specs = out_specs + (resid_spec,)
+            loop_out_specs = loop_out_specs + (resid_spec,)
 
             def fn_step(tr, aux, states, hyper, key, resid, *batch):
                 out = step(tr, aux, states, hyper, key, resid, *batch)
@@ -951,7 +1092,7 @@ class FusedTrainStep:
         fn = shard_map(
             fn_step, mesh=mesh, in_specs=in_specs + batch_specs,
             out_specs=out_specs, check_rep=False)
-        if scheme is not None:
+        if has_resid:
             donate = (0, 2, 5)
         else:
             donate = (0, 2)
@@ -960,7 +1101,7 @@ class FusedTrainStep:
         fn_loop = shard_map(
             fn_stats, mesh=mesh, in_specs=in_specs + batch_specs,
             out_specs=loop_out_specs, check_rep=False)
-        if scheme is not None:
+        if has_resid:
             def loop_body(tr, aux, states, resid, hyper, key, batch):
                 return fn_loop(tr, aux, states, hyper, key, resid,
                                *batch)
@@ -988,12 +1129,40 @@ class FusedTrainStep:
                         for n, v in self._tr.items()}
         self._aux = {n: _global_put(v, repl)
                      for n, v in self._aux.items()}
-        if scheme is not None:
-            self._resid = {
-                _skey(gi, j): jax.device_put(
-                    jnp.zeros((ndp, g.padded[j]), jnp.float32), shard)
-                for gi, g in enumerate(grp_list)
-                for j in range(len(g.plans))}
+        if has_resid:
+            self._resid = {}
+            if scheme is not None:
+                self._resid.update({
+                    _skey(gi, j): jax.device_put(
+                        jnp.zeros((ndp, g.padded[j]), jnp.float32),
+                        shard)
+                    for gi, g in enumerate(grp_list)
+                    for j in range(len(g.plans))})
+            if wres:
+                # weight-gather error feedback: one fp32 residual per
+                # rank per bucket SHARD (not per full bucket — feedback
+                # covers only what this rank transmits)
+                self._resid.update({
+                    _wkey(gi, j): jax.device_put(
+                        jnp.zeros((ndp, g.padded[j] // ndp),
+                                  jnp.float32), shard)
+                    for gi, g in enumerate(grp_list)
+                    for j in range(len(g.plans))})
+        # static per-step byte totals for /metrics: every bucket is
+        # gathered exactly once per step (z3 at entry, z1/2 post-
+        # update). Logical = the fp32 value every rank receives; wire =
+        # the payloads that actually travel (quantized shard codes +
+        # scales, or the fp32 shards when uncompressed) — counted for
+        # BOTH modes so the byte cut is A/B-provable from /metrics
+        lg = wr = 0
+        for g in grp_list:
+            for pj in g.padded:
+                lg += pj * 4
+                if wscheme is not None:
+                    wr += ndp * wire_nbytes(pj // ndp, wscheme, wblock)
+                else:
+                    wr += pj * 4
+        self._wire_gathered = (lg, wr)
         self._batch_sh = tuple(
             NamedSharding(mesh, spec) for spec in batch_specs)
         # checkpoint restore reads these to re-place restored state;
@@ -1120,6 +1289,38 @@ class FusedTrainStep:
             scheme = self.compression.get("type", "2bit")
             threshold = float(self.compression.get("threshold", 0.5))
 
+        # weight/activation wire compression: resolve the widened
+        # config against what THIS build actually has on the wire
+        wcfg = self._wire_weights
+        if wcfg is not None and wcfg["residual"]:
+            warnings.warn(
+                "weight wire compression residual mode needs zero=3 "
+                "and the pipeline clamps to zero<=2 — running the "
+                "stateless gather (the exact-self patch keeps each "
+                "owner's slice exact)", RuntimeWarning, stacklevel=3)
+        if wcfg is not None and (stage < 1 or ndp <= 1):
+            warnings.warn(
+                "weight wire compression requested but this pipeline "
+                "build runs zero=0 (or has no dp group) — no weight "
+                "all-gather exists to compress; ignoring the "
+                "'weights' entry", RuntimeWarning, stacklevel=3)
+            wcfg = None
+        wscheme = wcfg["type"] if wcfg is not None else None
+        wblock = wcfg["block"] if wcfg is not None else None
+        acfg = self._wire_acts
+        if acfg is not None and npp <= 1:
+            warnings.warn(
+                f"activation wire compression requested but the "
+                f"{ppx!r} axis has size 1 — no inter-stage hops to "
+                "compress; ignoring the 'activations' entry",
+                RuntimeWarning, stacklevel=3)
+            acfg = None
+        ascheme = acfg["type"] if acfg is not None else None
+        ablock = acfg["block"] if acfg is not None else None
+        awire = (ascheme, ablock) if ascheme is not None else None
+        if wscheme is not None or ascheme is not None:
+            from .compression import quantized_all_gather, wire_nbytes
+
         # loss dtype probe (the 1F1B accumulator matches it — bf16
         # pipelines don't silently upcast)
         def _mb_loss(key_):
@@ -1240,7 +1441,7 @@ class FusedTrainStep:
                 ybs = yc.reshape(M, mbsz, *yc.shape[1:])
                 loss_sum, grads = _pl._1f1b_local(
                     params, mbs, ybs, stage_fn, mb_loss, ppx,
-                    loss_dtype=ld)
+                    loss_dtype=ld, wire=awire)
                 loss_sum = lax.psum(loss_sum, ppx)  # lives on last stage
                 grads = {n: grads[n] / M for n in names}
                 return loss_sum / M, grads
@@ -1314,7 +1515,12 @@ class FusedTrainStep:
                     w_sh = lax.dynamic_slice(wf, (rank * ssz,), (ssz,))
                     nw, nst = opt._step(w_sh, red[n], states_[n],
                                         hyper)
-                    full = lax.all_gather(nw, dp, axis=0, tiled=True)
+                    if wscheme is not None:
+                        full = quantized_all_gather(nw, dp, wscheme,
+                                                    wblock)
+                    else:
+                        full = lax.all_gather(nw, dp, axis=0,
+                                              tiled=True)
                     new_tr[n] = full[:numel].reshape(
                         stacked[n].shape[1:])[None]
                     new_states[n] = jax.tree_util.tree_map(
@@ -1430,6 +1636,28 @@ class FusedTrainStep:
         self.zero_stage = stage
         self._pp_nstages = npp
 
+        # static wire-vs-logical byte accounting per step, one rank's
+        # perspective (mirrors the kvstore counters): the dp weight
+        # gather of each stage's flat shards, and the 1F1B activation/
+        # cotangent ppermute hops across all M + 2(n-1) ticks
+        if stage >= 1 and ndp > 1:
+            lg = wr = 0
+            for n in names:
+                isz = jnp.dtype(stacked[n].dtype).itemsize
+                padded, ssz = flat_meta[n][1], flat_meta[n][2]
+                lg += padded * isz
+                wr += ndp * wire_nbytes(ssz, wscheme, wblock) \
+                    if wscheme is not None else padded * isz
+            self._wire_gathered = (lg, wr)
+        if npp > 1:
+            act_elems = mbsz * int(_np.prod(xr.shape[1:]))
+            isz = jnp.dtype(xr.dtype).itemsize
+            hops = (M + 2 * (npp - 1)) * 2 * (npp - 1) * accum
+            lg = hops * act_elems * isz
+            wr = hops * wire_nbytes(act_elems, ascheme, ablock) \
+                if ascheme is not None else lg
+            self._wire_permuted = (lg, wr)
+
     def zero1_state_nbytes(self):
         """(total, per_replica) optimizer-state bytes after _build —
         per_replica is total/N, the ZeRO-1 memory claim."""
@@ -1482,6 +1710,13 @@ class FusedTrainStep:
             # not — exactly what the checkpoint resume harness needs
             _ft.kill_point("step.kill")
             _ft.delay_point("host.slow")
+            if self._wire_gathered is not None or \
+                    self._wire_permuted is not None:
+                # the weight-gather / activation-permute collectives
+                # run inside the executable; this host choke point is
+                # where an armed collective.timeout simulates their
+                # hang (kvstore.pushpull covers the eager direction)
+                _ft.timeout_point("collective.timeout")
         self._step_count += 1
         self.optimizer.num_update = self._step_count
         hyper = {"lr": jnp.asarray(self.optimizer.learning_rate,
@@ -1504,6 +1739,22 @@ class FusedTrainStep:
         if timed:
             import time as _time
             t0 = _time.perf_counter()
+        fl_on = _fl._ENABLED and (self._wire_gathered is not None
+                                  or self._wire_permuted is not None)
+        if fl_on:
+            # same event shape as KVStore.pushpull so post-mortems see
+            # weight-gather / activation-hop stalls alongside the eager
+            # collectives; bytes = wire payload per step (static)
+            import time as _ftm
+            t0f = _ftm.monotonic()
+            if self._wire_gathered is not None:
+                _fl.record("collective", "fused.all_gather",
+                           key="__weights__", store="fused",
+                           bytes=int(self._wire_gathered[1]))
+            if self._wire_permuted is not None:
+                _fl.record("collective", "fused.ppermute",
+                           key="__activations__", store="fused",
+                           bytes=int(self._wire_permuted[1]))
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):
             if self._pp_mask is not None:
@@ -1524,6 +1775,14 @@ class FusedTrainStep:
             else:
                 loss, self._tr, self._aux, self._states = self._compiled(
                     self._tr, self._aux, self._states, hyper, key, *raw)
+        if fl_on:
+            dtf = _ftm.monotonic() - t0f
+            if self._wire_gathered is not None:
+                _fl.record("collective_done", "fused.all_gather",
+                           key="__weights__", dur_s=dtf)
+            if self._wire_permuted is not None:
+                _fl.record("collective_done", "fused.ppermute",
+                           key="__activations__", dur_s=dtf)
         if timed:
             jax.block_until_ready(loss)
             dt = _time.perf_counter() - t0
@@ -1540,7 +1799,28 @@ class FusedTrainStep:
             nb = raw[0].shape[0] if raw and getattr(
                 raw[0], "ndim", 0) else None
             _tm.step_done(nb)
+            self._count_wire_bytes(1)
         return NDArray(loss)
+
+    def _count_wire_bytes(self, k):
+        """Feed the `comm_bytes_{gathered,permuted}` counter families
+        for the in-executable weight all-gathers / activation ppermute
+        hops (labels mirror ``KVStore._count_bytes``; store="fused").
+        The byte totals are static per build — computed once at trace
+        time and multiplied by the step count here, so the /metrics
+        wire-vs-logical ratio proves the quantized-collective cut
+        without touching the hot path."""
+        if not _tm._ENABLED:
+            return
+        for op, stats in (("gathered", self._wire_gathered),
+                          ("permuted", self._wire_permuted)):
+            if stats is None:
+                continue
+            fam = _tm.counter(
+                f"comm_bytes_{op}",
+                "bytes moved by kvstore collectives (logical vs wire)")
+            fam.labels(store="fused", kind="logical").inc(stats[0] * k)
+            fam.labels(store="fused", kind="wire").inc(stats[1] * k)
 
     # -- whole-loop compilation (K steps per dispatch) -----------------------
     def _loop_fallback_reason(self):
@@ -1751,6 +2031,9 @@ class FusedTrainStep:
             # with the previous window fully committed
             _ft.kill_point("step.kill")
             _ft.delay_point("host.slow")
+            if self._wire_gathered is not None or \
+                    self._wire_permuted is not None:
+                _ft.timeout_point("collective.timeout")
 
         # K host key draws — the exact key sequence K single dispatches
         # would consume, so dropout/RNG parity is bitwise
@@ -1786,12 +2069,32 @@ class FusedTrainStep:
         fresh = entry.pop("fresh", False)
         if timed or fresh:
             t_start = _time.perf_counter()
+        fl_on = _fl._ENABLED and (self._wire_gathered is not None
+                                  or self._wire_permuted is not None)
+        if fl_on:
+            t0f = _time.monotonic()
+            if self._wire_gathered is not None:
+                _fl.record("collective", "fused.all_gather",
+                           key="__weights__", store="fused",
+                           bytes=int(self._wire_gathered[1]) * k)
+            if self._wire_permuted is not None:
+                _fl.record("collective", "fused.ppermute",
+                           key="__activations__", store="fused",
+                           bytes=int(self._wire_permuted[1]) * k)
         with use_mesh(self.mesh if self.mesh is not None
                       else current_mesh()):
             (losses, gnorms, skips, self._tr, aux_out, self._states,
              resid_out, carry_out) = entry["fn"](
                 self._tr, aux_in, self._states, resid_in, hyper0,
                 carry0, keys, *stacked)
+        if fl_on:
+            dtf = _time.monotonic() - t0f
+            if self._wire_gathered is not None:
+                _fl.record("collective_done", "fused.all_gather",
+                           key="__weights__", dur_s=dtf)
+            if self._wire_permuted is not None:
+                _fl.record("collective_done", "fused.ppermute",
+                           key="__activations__", dur_s=dtf)
         if fresh:
             jax.block_until_ready(losses)
             _tracing.record_compile(name, None)
@@ -1856,4 +2159,5 @@ class FusedTrainStep:
             _tm.step_done(nb * k if nb else None, steps=k)
             _tm.set_gauge("train_loop_k", k)
             _tm.inc("train_loop_dispatches_total")
+            self._count_wire_bytes(k)
         return NDArray(losses)

@@ -109,15 +109,75 @@ def _vary(x, axis_name):
         return x  # already varying over axis_name
 
 
-def _bcast_from_last(x, axis_name, n):
+def _shift_fn(axis_name, wire):
+    """The activation/cotangent hop: plain `lax.ppermute`, or the
+    block-scaled quantized hop (1-byte codes + per-block fp32 scales on
+    the wire) when `wire=(scheme, block)` is set."""
+    if wire is None:
+        return lambda v, perm: jax.lax.ppermute(v, axis_name, perm)
+    from .compression import quantized_ppermute
+    scheme, block = wire
+    return lambda v, perm: quantized_ppermute(v, axis_name, perm,
+                                              scheme, block)
+
+
+def _qbcast_impl(x, axis_name, n, scheme, block):
+    from .compression import block_dequantize, block_quantize
+    idx = jax.lax.axis_index(axis_name)
+    codes, scales = block_quantize(x, scheme, block)
+    span = 1
+    while span < n:
+        pairs = [(s, s - span) for s in range(n - span, n)
+                 if s - span >= 0]
+        rc = jax.lax.ppermute(codes, axis_name, pairs)
+        rs = jax.lax.ppermute(scales, axis_name, pairs)
+        newly = jnp.logical_and(idx >= n - 2 * span, idx < n - span)
+        codes = jnp.where(newly, rc, codes)
+        scales = jnp.where(newly, rs, scales)
+        span *= 2
+    deq = block_dequantize(codes, scales, shape=x.shape, dtype=x.dtype)
+    # quantize ONCE at the source and forward the codes through every
+    # doubling round (no requantize-per-hop error compounding); the
+    # source stage keeps its exact value — only wire hops are lossy,
+    # mirroring quantized_all_gather's exact-self patch
+    return jnp.where(idx == n - 1, x, deq)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _quantized_bcast_from_last(x, axis_name, n, scheme, block):
+    return _qbcast_impl(x, axis_name, n, scheme, block)
+
+
+def _qbcast_fwd(x, axis_name, n, scheme, block):
+    return _qbcast_impl(x, axis_name, n, scheme, block), None
+
+
+def _qbcast_bwd(axis_name, n, scheme, block, _, ct):
+    # transpose of broadcast-from-last: the source stage absorbs every
+    # stage's cotangent (straight-through the quantizer — the standard
+    # STE treatment), all other stages contribute nothing
+    idx = jax.lax.axis_index(axis_name)
+    s = jax.lax.psum(ct, axis_name)
+    return (jnp.where(idx == n - 1, s, jnp.zeros_like(ct)),)
+
+
+_quantized_bcast_from_last.defvjp(_qbcast_fwd, _qbcast_bwd)
+
+
+def _bcast_from_last(x, axis_name, n, wire=None):
     """Broadcast the LAST stage's value to every pp shard with a
     recursive-doubling ppermute chain (ceil(log2 n) hops), replacing the
     old full-size psum: no fake zero-contributions ride the wire and no
     reduction work is spent adding them. jax requires unique ppermute
     sources, so the multicast is staged — after round r the suffix of
-    min(2^r, n) stages holds the value."""
+    min(2^r, n) stages holds the value. With `wire=(scheme, block)` the
+    value travels quantized (codes + scales take the same doubling
+    route; one quantize at the source, one dequantize at the end)."""
     if n <= 1:
         return x
+    if wire is not None:
+        return _quantized_bcast_from_last(x, axis_name, int(n),
+                                          wire[0], int(wire[1]))
     idx = jax.lax.axis_index(axis_name)
     span = 1
     while span < n:
@@ -130,7 +190,7 @@ def _bcast_from_last(x, axis_name, n):
     return x
 
 
-def _gpipe_local(params, mbatches, stage_fn, axis_name):
+def _gpipe_local(params, mbatches, stage_fn, axis_name, wire=None):
     """Per-device schedule body (runs inside shard_map).
 
     params: this stage's parameters (leading pp dim already split away).
@@ -147,6 +207,7 @@ def _gpipe_local(params, mbatches, stage_fn, axis_name):
     idx = jax.lax.axis_index(axis_name)
     M = mbatches.shape[0]
     perm = [(i, i + 1) for i in range(n - 1)]  # no wraparound
+    shift = _shift_fn(axis_name, wire)
 
     state0 = _vary(jnp.zeros(mbatches.shape[1:], mbatches.dtype),
                    axis_name)
@@ -165,18 +226,18 @@ def _gpipe_local(params, mbatches, stage_fn, axis_name):
         upd = jax.lax.dynamic_update_index_in_dim(outputs, out, j, 0)
         take = jnp.logical_and(idx == n - 1, t >= n - 1)
         outputs = jnp.where(take, upd, outputs)
-        state = jax.lax.ppermute(out, axis_name, perm)
+        state = shift(out, perm)
         return (state, outputs), ()
 
     (_, outputs), _ = jax.lax.scan(
         tick, (state0, out0), jnp.arange(M + n - 1))
     # ship the last stage's results to every pp shard (ppermute chain,
     # not a psum of mostly-zeros)
-    return _bcast_from_last(outputs, axis_name, n)
+    return _bcast_from_last(outputs, axis_name, n, wire)
 
 
 def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
-                axis_name, loss_dtype=None):
+                axis_name, loss_dtype=None, wire=None):
     """Per-device 1F1B schedule body (runs inside shard_map).
 
     One scan tick = one forward micro-step AND one backward micro-step
@@ -208,6 +269,7 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
     S = 2 * n - 1  # stash slots: max in-flight microbatches per stage
     perm_up = [(i, i + 1) for i in range(n - 1)]
     perm_down = [(i + 1, i) for i in range(n - 1)]
+    shift = _shift_fn(axis_name, wire)
 
     mb_shape = mbatches.shape[1:]
     act_dtype = mbatches.dtype
@@ -281,9 +343,10 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
         grads = jax.tree_util.tree_map(
             lambda g, d: g + d, grads, dparams)
 
-        # shift: activations up, cotangents down
-        state = jax.lax.ppermute(out, axis_name, perm_up)
-        cot_out = jax.lax.ppermute(dinp, axis_name, perm_down)
+        # shift: activations up, cotangents down (both quantized under
+        # wire compression — EQuARX covers forward AND backward hops)
+        state = shift(out, perm_up)
+        cot_out = shift(dinp, perm_down)
         return (state, cot_out, stash, grads, loss_acc), ()
 
     total_ticks = M + 2 * (n - 1)
@@ -295,7 +358,7 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
 
 
 def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
-                num_microbatches, mesh=None, pp_axis="pp"):
+                num_microbatches, mesh=None, pp_axis="pp", wire=None):
     """1F1B pipeline schedule: fused forward+backward with interleaved
     microbatch backprop and an O(num_stages) activation stash.
 
@@ -318,6 +381,11 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
 
     Without a mesh (or without a `pp` axis) it computes the same
     quantities sequentially (exact reference semantics for tests).
+
+    `wire=(scheme, block)` (scheme "int8" | "fp8") sends the per-tick
+    activation/cotangent hops block-scale-quantized over the wire —
+    ~3.9x fewer inter-stage bytes at block=128. Ignored by the
+    sequential fallback (nothing crosses a wire there).
     """
     mesh = mesh if mesh is not None else current_mesh()
     B = x.shape[0]
@@ -353,7 +421,7 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         loss_sum, grads = _1f1b_local(params, mbs, ybs, stage_fn,
                                       loss_fn, pp_axis,
-                                      loss_dtype=loss_dtype)
+                                      loss_dtype=loss_dtype, wire=wire)
         # loss lives on the last stage only; share it with every shard
         loss_sum = jax.lax.psum(loss_sum, pp_axis)
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
@@ -370,16 +438,19 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
 
 
 def gpipe(stage_fn, stacked_params, x, num_microbatches, mesh=None,
-          pp_axis="pp"):
+          pp_axis="pp", wire=None):
     """Run `x` through the staged pipeline.
 
     stage_fn: (stage_params, h) -> h, shape-preserving.
     stacked_params: pytree with leading dim = num_stages (sharded over
         `pp_axis` when a mesh is active).
     x: (B, ...) batch; B % num_microbatches == 0.
+    wire: optional (scheme, block) — quantize the inter-stage hops and
+        the final last-stage broadcast (block-scaled int8/fp8 on the
+        wire; differentiable via a straight-through custom_vjp).
 
     Without a mesh (or without a `pp` axis) this degrades to the exact
-    sequential computation.
+    sequential computation (`wire` ignored — nothing crosses a wire).
     """
     mesh = mesh if mesh is not None else current_mesh()
     if mesh is None or pp_axis not in mesh.axis_names:
@@ -398,7 +469,7 @@ def gpipe(stage_fn, stacked_params, x, num_microbatches, mesh=None,
     # strip the (now size-1) stage dim inside the body
     def body(params, mbs):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
-        return _gpipe_local(params, mbs, stage_fn, pp_axis)
+        return _gpipe_local(params, mbs, stage_fn, pp_axis, wire)
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(param_specs, P()), out_specs=P(),
