@@ -23,7 +23,11 @@ comparison).
 through 1 replica, then N subprocess replicas behind
 mx.serving.FleetRouter (fleet TTFT p50/p95, tokens/sec per replica vs
 single), then N replicas with one SIGKILLed mid-run — zero lost and
-zero duplicated requests is the reported robustness claim.
+zero duplicated requests is the reported robustness claim. Adding
+--slo appends two burn-rate legs: clean (the SLO alert must stay
+silent) and with `replica.stall` armed in every worker (the alert
+must fire, name the objective in health, and collect a cross-process
+flight bundle the merge CLI stitches into one ordered timeline).
 
 One JSON line, rc 0, BudgetGuard — same contract as every bench here.
 """
@@ -451,17 +455,21 @@ def mixed_phase(on_tpu, guard, num_requests=24, seed=0):
     telemetry.reset()
 
 
-def _fleet_spawn(d, name, cfg_json, fault=None, max_wall_s=300):
+def _fleet_spawn(d, name, cfg_json, fault=None, max_wall_s=300,
+                 extra_env=None):
     """One subprocess fleet replica over the FileKV channel. Workers
     always run on CPU: this phase measures the ROUTER (failover,
     shedding, fleet latency), not chip throughput — and N processes
-    cannot share one TPU anyway."""
+    cannot share one TPU anyway. `extra_env` rides into the worker
+    (the --slo legs use it to enable telemetry + flight recorder)."""
     import subprocess
 
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("MXNET_TPU_FAULTS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    if extra_env:
+        env.update(extra_env)
     if fault:
         env["MXNET_TPU_FAULTS"] = fault
     log = open(os.path.join(d, f"{name}.log"), "w")
@@ -475,18 +483,30 @@ def _fleet_spawn(d, name, cfg_json, fault=None, max_wall_s=300):
 
 
 def _fleet_leg(d, n_workers, cfg_json, workload, arrival_rate, rs,
-               kill=False):
+               kill=False, faults=None, slo=False, router_kw=None):
     """Poisson-drive `workload` through an N-replica subprocess fleet;
-    returns (requests, wall_s, router_stats, worker_rcs, final_stats)."""
+    returns (requests, wall_s, router_stats, worker_rcs, final_stats,
+    slo_info). `faults` maps worker name -> MXNET_TPU_FAULTS spec;
+    `slo=True` enables telemetry + flight in the workers, attaches a
+    burn-rate SLOEngine over the fleet-merged registry, and collects a
+    flight bundle into `d` on the alert's rising edge."""
     import signal as _signal
 
     from mxnet_tpu.serving.router import FileKV, FleetRouter, ProcReplica
 
+    faults = dict(faults or {})
+    if kill:
+        faults.setdefault("w0", "replica.kill:at=8")
+    extra_env = {"MXNET_TPU_TELEMETRY": "1",
+                 "MXNET_TPU_FLIGHT": "1",
+                 "MXNET_TPU_FLIGHT_DIR": d} if slo else None
     kv = FileKV(d)
-    procs = [_fleet_spawn(
-        d, f"w{i}", cfg_json,
-        fault="replica.kill:at=8" if (kill and i == 0) else None)
-        for i in range(n_workers)]
+    procs = [_fleet_spawn(d, f"w{i}", cfg_json,
+                          fault=faults.get(f"w{i}"),
+                          extra_env=extra_env)
+             for i in range(n_workers)]
+    engine = None
+    slo_info = {}
     try:
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < 240:
@@ -502,10 +522,30 @@ def _fleet_leg(d, n_workers, cfg_json, workload, arrival_rate, rs,
         else:
             raise RuntimeError("fleet workers never became healthy")
 
+        fleet_kw = dict(affinity_blocks=0, backoff_base_s=0.01,
+                        heartbeat_timeout_s=2.0)
+        fleet_kw.update(router_kw or {})
         fleet = FleetRouter(
             [ProcReplica(kv, f"w{i}") for i in range(n_workers)],
-            affinity_blocks=0, backoff_base_s=0.01,
-            heartbeat_timeout_s=2.0)
+            **fleet_kw)
+        if slo:
+            from mxnet_tpu import flight as _flight
+            from mxnet_tpu import telemetry as _telemetry
+            from mxnet_tpu.slo import Objective
+
+            _telemetry.enable()
+            _flight.enable()
+            fired_health = []
+            engine = fleet.attach_slo(
+                objectives=[Objective("ttft_under_500ms",
+                                      metric="serving_ttft_seconds",
+                                      target=0.7, threshold_s=0.5)],
+                fast_window_s=1.0, slow_window_s=4.0,
+                burn_threshold=1.0, tick_interval_s=0.05,
+                bundle_dir=d,
+                on_alert=lambda name, info:
+                    fired_health.append(fleet._slo.health()[1]))
+            slo_info["fired_health"] = fired_health
         gaps = rs.exponential(1.0 / arrival_rate, len(workload))
         t_start = time.perf_counter()
         arrivals = t_start + np.cumsum(gaps)
@@ -520,6 +560,9 @@ def _fleet_leg(d, n_workers, cfg_json, workload, arrival_rate, rs,
                 time.sleep(0.002)
         wall = time.perf_counter() - t_start
         stats = fleet.stats()
+        if engine is not None:
+            slo_info["alerts"] = engine.alerts_total
+            slo_info["bundle"] = fleet.last_bundle_path
         final = fleet.stop_fleet(timeout_ms=30_000)
         rcs = []
         for p in procs:
@@ -528,22 +571,40 @@ def _fleet_leg(d, n_workers, cfg_json, workload, arrival_rate, rs,
             except Exception:
                 p.kill()
                 rcs.append(p.wait(timeout=30))
-        return frs, wall, stats, rcs, final
+        return frs, wall, stats, rcs, final, slo_info
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+        if slo:
+            from mxnet_tpu import flight as _flight
+            from mxnet_tpu import telemetry as _telemetry
+            if engine is not None:
+                _telemetry.unregister_health_source(engine)
+            _telemetry.set_fleet_metrics_provider(None)
+            _flight.disable()
+            _flight.clear()
+            _telemetry.disable()
+            _telemetry.reset()
 
 
 def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
-                arrival_rate=None, seed=0):
+                arrival_rate=None, seed=0, slo=False):
     """--fleet N: the resilient-serving bench. Three legs over the same
     Poisson workload of subprocess replicas on the FileKV channel:
     one replica (the scaling baseline), N replicas (fleet TTFT p50/p95
     + tokens/sec per replica vs 1), and N replicas with one SIGKILLed
     mid-run by `replica.kill` — the robustness claim is ZERO lost and
-    ZERO duplicated requests across the failover."""
+    ZERO duplicated requests across the failover.
+
+    --slo adds two SLO legs over a small trickle workload with a
+    burn-rate SLOEngine attached to the router's fleet-merged registry:
+    a clean leg where the alert must stay SILENT, and a leg with
+    `replica.stall` armed in every worker where the multi-window burn
+    alert must FIRE, flip health to the violated objective's name, and
+    collect a cross-process flight bundle that the merge CLI stitches
+    into one ordered timeline."""
     import tempfile
 
     from mxnet_tpu import telemetry
@@ -572,11 +633,11 @@ def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
                           kill=kill)
 
     # leg 1: single replica (the baseline the fleet is judged against)
-    frs1, wall1, _, _, _ = leg(1, kill=False)
+    frs1, wall1, _, _, _, _ = leg(1, kill=False)
     single_tps = total_new / wall1
 
     # leg 2: N replicas, clean — the headline fleet number
-    frsN, wallN, statsN, _, _ = leg(fleet_n, kill=False)
+    frsN, wallN, statsN, _, _, _ = leg(fleet_n, kill=False)
     fleet_tps = total_new / wallN
     ttfts = [fr.ttft_s for fr in frsN if fr.ttft_s is not None]
     ttft_p50 = float(np.percentile(ttfts, 50)) if ttfts else 0.0
@@ -586,7 +647,7 @@ def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
     kill_ok = lost = dup = failovers = 0
     kill_rc0 = None
     if guard.remaining() > 30.0:
-        frsK, _, statsK, rcsK, _ = leg(fleet_n, kill=True)
+        frsK, _, statsK, rcsK, _, _ = leg(fleet_n, kill=True)
         kill_ok = sum(1 for fr in frsK if fr.status == "ok")
         lost = len(workload) - len(frsK) \
             + sum(1 for fr in frsK if fr.status != "ok")
@@ -594,6 +655,63 @@ def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
         failovers = statsK["failovers"]
         kill_rc0 = rcsK[0]
 
+    # --slo legs: burn-rate alerting end to end on a trickle workload
+    slo_res = {}
+    if slo and guard.remaining() > 60.0:
+        from mxnet_tpu import flight as _flight
+
+        rsS = np.random.RandomState(seed + 1)
+        slo_workload = [(rsS.randint(0, cfg_kw["vocab_size"],
+                                     8).astype(np.int32), 4)
+                        for _ in range(10)]
+        # hedging off + a heartbeat timeout above the stall so the
+        # stalled workers stay "healthy but slow" — the burn-rate case,
+        # not the failover case
+        slo_router_kw = dict(hedge_after_s=30.0,
+                             heartbeat_timeout_s=5.0)
+
+        def slo_leg(faults):
+            d = tempfile.mkdtemp(prefix="fleet_slo_")
+            *_, info = _fleet_leg(
+                d, fleet_n, cfg_json, slo_workload, 8.0,
+                np.random.RandomState(seed + 1), faults=faults,
+                slo=True, router_kw=slo_router_kw)
+            return info
+
+        clean = slo_leg(None)
+        # every worker sleeps ~1s after each productive tick: almost
+        # every TTFT lands over the 0.5s objective, so BOTH burn
+        # windows blow past the threshold
+        stall = slo_leg({f"w{i}": "replica.stall:ms=1000"
+                         for i in range(fleet_n)})
+        health = (stall.get("fired_health") or [""])[0]
+        merged_events, ordered, n_sources = 0, False, 0
+        bundle = stall.get("bundle")
+        if bundle:
+            merged = _flight.merge([bundle])
+            with open(merged) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.strip()]
+            n_sources = len(json.loads(lines[0])["sources"])
+            ts = [json.loads(ln)["t_unix"] for ln in lines[1:]]
+            merged_events = len(ts)
+            ordered = ts == sorted(ts)
+        slo_res = {
+            "slo_clean_alerts": clean.get("alerts", 0),
+            "slo_stall_alerts": stall.get("alerts", 0),
+            "slo_alert_fired": bool(stall.get("alerts", 0)),
+            "slo_health_reason": health[:160],
+            "slo_bundle_sources": n_sources,
+            "slo_merged_events": merged_events,
+            "slo_merged_ordered": ordered,
+            "slo_pass": bool(stall.get("alerts", 0)
+                             and clean.get("alerts", 0) == 0
+                             and "ttft_under_500ms" in health
+                             and n_sources >= 1 + fleet_n
+                             and merged_events > 0 and ordered),
+        }
+
+    guard.best.update(slo_res)
     guard.best.update({
         "value": round(fleet_tps, 2),
         "phase": "fleet",
@@ -629,6 +747,17 @@ def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
                  ("bench_fleet_lost_requests", float(lost)),
                  ("bench_fleet_failovers", float(failovers))):
         telemetry.set_gauge(k, float(v), bench="decode_fleet")
+    if slo_res:
+        for k, v in (("bench_slo_alert_fired",
+                      slo_res["slo_alert_fired"]),
+                     ("bench_slo_clean_alerts",
+                      slo_res["slo_clean_alerts"]),
+                     ("bench_slo_bundle_sources",
+                      slo_res["slo_bundle_sources"]),
+                     ("bench_slo_merged_events",
+                      slo_res["slo_merged_events"]),
+                     ("bench_slo_pass", slo_res["slo_pass"])):
+            telemetry.set_gauge(k, float(v), bench="decode_fleet")
     guard.emit()
     telemetry.disable()
     telemetry.reset()
@@ -772,6 +901,11 @@ def main():
                     help="resilient-fleet bench: N subprocess replicas "
                          "behind FleetRouter, incl. a kill-one-replica "
                          "leg asserting zero lost requests")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --fleet: add SLO legs — a clean leg "
+                         "where the burn-rate alert must stay silent "
+                         "and a replica.stall leg where it must fire, "
+                         "flip health, and collect a flight bundle")
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--arrival-rate", type=float, default=None,
                     help="Poisson arrival rate, requests/sec")
@@ -805,7 +939,8 @@ def main():
     elif args.fleet:
         fleet_phase(on_tpu, guard, fleet_n=args.fleet,
                     num_requests=args.requests,
-                    arrival_rate=args.arrival_rate, seed=args.seed)
+                    arrival_rate=args.arrival_rate, seed=args.seed,
+                    slo=args.slo)
     elif args.serve:
         serve_phase(on_tpu, guard, num_requests=args.requests,
                     arrival_rate=args.arrival_rate, seed=args.seed)

@@ -360,9 +360,9 @@ def main_zero(stage):
 
 
 #: telemetry's public hot helpers — the ones instrumented call sites
-#: invoke on the fused-step path
+#: invoke on the fused-step path (read_gauge feeds TrainLoop's auto-K)
 _TM_HOT = ("phase", "mark_phase", "step_done", "inc", "set_gauge",
-           "observe")
+           "observe", "read_gauge")
 
 #: the flight recorder's hot helpers — B-side no-ops these too, so the
 #: measured A/B gap covers flight recording compiled in but disabled
@@ -442,9 +442,24 @@ def main_telemetry_overhead():
         "inc": lambda *a, **k: None,
         "set_gauge": lambda *a, **k: None,
         "observe": lambda *a, **k: None,
+        "read_gauge": lambda *a, **k: None,
     }
     fl_noops = {"record": lambda *a, **k: None,
                 "dump": lambda *a, **k: None}
+
+    # the fleet-observability hooks ride the same cost contract: B-side
+    # no-ops the SLO engine tick and the router's trace-propagation
+    # hook too, so the measured gap covers them compiled in but idle
+    from mxnet_tpu import slo as _slo
+    from mxnet_tpu.serving import router as _router
+
+    saved_hooks = {(_slo.SLOEngine, "tick"): _slo.SLOEngine.tick,
+                   (_router.FleetRouter, "_note_result"):
+                       _router.FleetRouter._note_result}
+    hook_noops = {(_slo.SLOEngine, "tick"):
+                      lambda self, now=None: None,
+                  (_router.FleetRouter, "_note_result"):
+                      lambda self, *a, **k: None}
 
     a_ms, b_ms = [], []
     for _ in range(rounds):
@@ -455,6 +470,8 @@ def main_telemetry_overhead():
             setattr(telemetry, name, fn)
         for name, fn in fl_noops.items():
             setattr(flight, name, fn)
+        for (cls, name), fn in hook_noops.items():
+            setattr(cls, name, fn)
         try:
             b_ms.append(timed())  # B: helpers are true no-ops
         finally:
@@ -462,6 +479,8 @@ def main_telemetry_overhead():
                 setattr(telemetry, name, fn)
             for name, fn in saved_fl.items():
                 setattr(flight, name, fn)
+            for (cls, name), fn in saved_hooks.items():
+                setattr(cls, name, fn)
 
     ratio = min(a_ms) / min(b_ms)
     guard.best.update({

@@ -154,6 +154,18 @@ def main():
     if not fmatch or any(fr.status != "ok" for _, fr in frs):
         raise SystemExit("fleet serving diverged or lost a request")
 
+    # -- fleet observability: one merged timeline per request ---------
+    # (router queue/attempt spans + the winning worker's prefill/decode
+    # spans on one clock; chrome export puts the router and each
+    # replica on their own pid — see docs/observability.md)
+    tr = fleet.trace(fr0)
+    spans = ", ".join(f"{e['name']}@{e['src']}" for e in tr["events"])
+    print(f"fleet trace {fr0.token}: decision="
+          f"{tr['attempts'][0]['decision']} [{spans}]")
+    telemetry.export_chrome_trace("llama_serve_fleet_trace.json")
+    print("chrome trace (router + replica pids): "
+          "llama_serve_fleet_trace.json")
+
 
 if __name__ == "__main__":
     main()

@@ -45,6 +45,7 @@ from . import faults
 from . import flight
 from . import monitor
 from . import profiler
+from . import slo
 from . import telemetry
 from . import tracing
 from . import parallel

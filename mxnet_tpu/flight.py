@@ -48,7 +48,8 @@ from collections import deque
 from typing import List, Optional, Tuple
 
 __all__ = ["enable", "disable", "enabled", "record", "events", "clear",
-           "dump", "set_capacity", "capacity", "last_dump_path",
+           "dump", "dump_text", "merge", "main",
+           "set_capacity", "capacity", "last_dump_path",
            "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 4096
@@ -127,6 +128,40 @@ def clear():
         _EVENTS.clear()
 
 
+def _render(reason: str, evs: list, seq: int) -> str:
+    """Serialize a ring snapshot as JSONL text: one header line
+    (reason, pid, PAIRED clock anchors `t_monotonic`/`time_unix` —
+    sampled together so a reader can convert event times to wall
+    clock), then one line per event, oldest first."""
+    header = {"flight": 1, "reason": reason, "pid": os.getpid(),
+              "seq": seq, "events": len(evs),
+              "capacity": _EVENTS.maxlen,
+              "t_monotonic": time.monotonic(),
+              "time_unix": time.time()}
+    lines = [json.dumps(header)]
+    for t, kind, site, payload in evs:
+        line = {"t": t, "kind": kind, "site": site}
+        if payload:
+            line["payload"] = payload
+        lines.append(json.dumps(line, default=str))
+    return "\n".join(lines) + "\n"
+
+
+def dump_text(reason: str = "manual") -> Optional[str]:
+    """The ring serialized as JSONL text (same format as :func:`dump`)
+    without touching the filesystem — the fleet router ships this over
+    the kv channel when it collects a cross-process flight bundle.
+    Returns None while disabled."""
+    global _DUMP_SEQ
+    if not _ENABLED:
+        return None
+    with _lock:
+        evs = list(_EVENTS)
+        _DUMP_SEQ += 1
+        seq = _DUMP_SEQ
+    return _render(reason, evs, seq)
+
+
 def dump(reason: str = "manual", path: Optional[str] = None) -> Optional[str]:
     """Write the ring as JSONL: one header line (reason, pid, clock
     anchors, capacity) then one line per event, oldest first — the
@@ -136,13 +171,10 @@ def dump(reason: str = "manual", path: Optional[str] = None) -> Optional[str]:
     Default location: ``MXNET_TPU_FLIGHT_DIR`` (or cwd) with a
     per-reason filename, so repeated fires of the same trigger
     overwrite one file instead of flooding the directory."""
-    global last_dump_path, _DUMP_SEQ
-    if not _ENABLED:
+    global last_dump_path
+    text = dump_text(reason)
+    if text is None:
         return None
-    with _lock:
-        evs = list(_EVENTS)
-        _DUMP_SEQ += 1
-        seq = _DUMP_SEQ
     if path is None:
         d = os.environ.get("MXNET_TPU_FLIGHT_DIR") or os.getcwd()
         try:
@@ -152,20 +184,103 @@ def dump(reason: str = "manual", path: Optional[str] = None) -> Optional[str]:
         safe = "".join(c if c.isalnum() or c in "-_" else "-"
                        for c in reason) or "manual"
         path = os.path.join(d, f"flight-{safe}-p{os.getpid()}.jsonl")
-    header = {"flight": 1, "reason": reason, "pid": os.getpid(),
-              "seq": seq, "events": len(evs),
-              "capacity": _EVENTS.maxlen,
-              "t_monotonic": time.monotonic(),
-              "time_unix": time.time()}
     try:
         with open(path, "w") as f:
-            f.write(json.dumps(header) + "\n")
-            for t, kind, site, payload in evs:
-                line = {"t": t, "kind": kind, "site": site}
-                if payload:
-                    line["payload"] = payload
-                f.write(json.dumps(line, default=str) + "\n")
+            f.write(text)
     except OSError:
         return None
     last_dump_path = path
     return path
+
+
+# -- cross-process merge (the `python -m mxnet_tpu.flight merge` CLI) -------
+
+def _collect_paths(sources: List[str]) -> List[str]:
+    paths: List[str] = []
+    for src in sources:
+        if os.path.isdir(src):
+            # skip a previous merge output so re-merging a bundle
+            # directory stays idempotent
+            paths.extend(sorted(
+                os.path.join(src, n) for n in os.listdir(src)
+                if n.endswith(".jsonl") and n != "merged.jsonl"))
+        else:
+            paths.append(src)
+    return paths
+
+
+def merge(sources: List[str], out: Optional[str] = None) -> str:
+    """Stitch per-process flight dumps (files or directories of
+    ``*.jsonl`` — e.g. a router-written ``flight-bundle-<reason>/``)
+    into ONE clock-aligned timeline. Each dump's header carries paired
+    ``t_monotonic``/``time_unix`` anchors, so every event's monotonic
+    timestamp converts to wall clock via the per-process offset
+    ``time_unix - t_monotonic``; events from all sources are then
+    sorted on that shared axis. Output: a header line (sources with
+    their offsets) followed by
+    ``{"t_unix", "src", "kind", "site", "payload"?}`` lines. Returns
+    the output path (default: ``merged.jsonl`` next to the first
+    source)."""
+    paths = _collect_paths(sources)
+    if not paths:
+        raise ValueError("no flight dumps to merge")
+    srcs = []
+    merged = []
+    for p in paths:
+        name = os.path.splitext(os.path.basename(p))[0]
+        with open(p) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if not lines:
+            continue
+        header = json.loads(lines[0])
+        offset = float(header.get("time_unix", 0.0)) - \
+            float(header.get("t_monotonic", 0.0))
+        n = 0
+        for ln in lines[1:]:
+            ev = json.loads(ln)
+            rec = {"t_unix": float(ev.get("t", 0.0)) + offset,
+                   "src": name, "kind": ev.get("kind"),
+                   "site": ev.get("site")}
+            if ev.get("payload") is not None:
+                rec["payload"] = ev["payload"]
+            merged.append(rec)
+            n += 1
+        srcs.append({"file": os.path.basename(p),
+                     "pid": header.get("pid"),
+                     "reason": header.get("reason"),
+                     "offset_s": offset, "events": n})
+    merged.sort(key=lambda r: (r["t_unix"], r["src"]))
+    if out is None:
+        base = paths[0]
+        d = base if os.path.isdir(base) else os.path.dirname(base) or "."
+        out = os.path.join(d, "merged.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps({"flight_merge": 1, "sources": srcs,
+                            "events": len(merged)}) + "\n")
+        for rec in merged:
+            f.write(json.dumps(rec, default=str) + "\n")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m mxnet_tpu.flight merge <dir-or-files...> [-o OUT]``:
+    stitch a flight bundle into one ordered timeline (see
+    :func:`merge`). Stdlib-only, like the rest of this module."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.flight")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge per-process flight dumps "
+                                      "into one clock-aligned timeline")
+    mp.add_argument("sources", nargs="+",
+                    help="dump files and/or bundle directories")
+    mp.add_argument("-o", "--out", default=None,
+                    help="output path (default: merged.jsonl next to "
+                         "the first source)")
+    args = ap.parse_args(argv)
+    out = merge(args.sources, out=args.out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1775,6 +1775,12 @@ class FusedTrainStep:
             else:
                 loss, self._tr, self._aux, self._states = self._compiled(
                     self._tr, self._aux, self._states, hyper, key, *raw)
+        if timed:
+            # everything before this point is host work: argument prep
+            # plus the async dispatch (the compiled call returns before
+            # the device finishes) — this is the overhead TrainLoop's
+            # k="auto" amortizes across the fused window
+            t_disp = _time.perf_counter()
         if fl_on:
             dtf = _ftm.monotonic() - t0f
             if self._wire_gathered is not None:
@@ -1784,6 +1790,8 @@ class FusedTrainStep:
                 _fl.record("collective_done", "fused.ppermute",
                            key="__activations__", dur_s=dtf)
         if timed:
+            _tm.set_gauge("train_dispatch_overhead_ms_per_step",
+                          (t_disp - t0) * 1e3)
             jax.block_until_ready(loss)
             dt = _time.perf_counter() - t0
             _tm.mark_phase("fused_step", dt, t0=t0, device=True)
@@ -2087,6 +2095,10 @@ class FusedTrainStep:
              resid_out, carry_out) = entry["fn"](
                 self._tr, aux_in, self._states, resid_in, hyper0,
                 carry0, keys, *stacked)
+        if timed:
+            # host prep + async dispatch for the whole K-window; the
+            # per-step share (divided by k below) feeds k="auto"
+            t_disp = _time.perf_counter()
         if fl_on:
             dtf = _time.monotonic() - t0f
             if self._wire_gathered is not None:
@@ -2158,6 +2170,8 @@ class FusedTrainStep:
                 raw[0][0], "ndim", 0) else None
             _tm.step_done(nb * k if nb else None, steps=k)
             _tm.set_gauge("train_loop_k", k)
+            _tm.set_gauge("train_dispatch_overhead_ms_per_step",
+                          (t_disp - t_start) / k * 1e3)
             _tm.inc("train_loop_dispatches_total")
             self._count_wire_bytes(k)
         return NDArray(losses)

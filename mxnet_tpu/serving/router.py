@@ -59,6 +59,28 @@ against the channel protocol; ``python -m mxnet_tpu.serving.router
 --dir D --name r0`` is the subprocess entry the tests and the fleet
 bench spawn.
 
+Fleet observability (telemetry-gated end to end):
+
+- **Distributed tracing**: every attempt is stamped with the
+  idempotency token as trace context; workers ship the finished
+  request's span timeline back inside the ``res/<token>`` payload, and
+  heartbeats carry a paired perf/wall clock anchor recorded at worker
+  warm-up, so `FleetRouter.trace(id)` merges router queue wait, the
+  routing decision, every retry/hedge/failover attempt (replica id +
+  outcome) and the winner's prefill/decode spans onto ONE wall-clock
+  axis. `telemetry.export_chrome_trace` renders the merged timelines
+  with a router pid plus one pid per replica.
+- **Fleet metrics**: heartbeats piggyback bounded, delta-encoded
+  registry snapshots (`telemetry.registry_delta`); the router merges
+  them bucket-exactly (`fleet_registry`) and
+  `FleetRouter.start_metrics_server` serves the fleet view on
+  /metrics with ``replica=<name>`` gauge labels.
+- **SLO engine**: `attach_slo` wires an `mxnet_tpu.slo.SLOEngine` to
+  the fleet-merged registry, ticks it from `step()`, flips /healthz to
+  degraded while an alert fires, and collects a cross-process flight
+  bundle (`collect_flight_bundle` -> ``flight-bundle-<reason>/``,
+  stitched by ``python -m mxnet_tpu.flight merge``).
+
 Cost contract: all router telemetry/flight calls are gated on the
 module flags (`telemetry._ENABLED` / `_fl._ENABLED` / `_ft._ACTIVE`),
 AST-enforced by tests/test_telemetry_lint.py.
@@ -280,6 +302,10 @@ class FleetRequest:
         self.retries = 0                # re-dispatches after a failure
         self.hedged = False
         self.attempts: List["_Attempt"] = []
+        #: distributed-trace record, one entry per attempt (replica,
+        #: routing decision, outcome, shipped worker timeline + clock
+        #: offset); only populated while telemetry is enabled
+        self.attempt_log: List[dict] = []
         self.next_eligible_t = 0.0
         self.t_submit = time.time()
         self.t_deadline = None if deadline_s is None \
@@ -302,13 +328,14 @@ class FleetRequest:
 
 class _Attempt:
     """One dispatch of a request to one replica."""
-    __slots__ = ("rep", "sub", "t0", "hedge")
+    __slots__ = ("rep", "sub", "t0", "hedge", "log")
 
     def __init__(self, rep, sub, t0, hedge):
         self.rep = rep
         self.sub = sub
         self.t0 = t0
         self.hedge = hedge
+        self.log: Optional[dict] = None     # its fr.attempt_log entry
 
 
 # -- replica handles ---------------------------------------------------------
@@ -340,6 +367,10 @@ class LocalReplica:
             return None                 # no heartbeat from the dead
         d = self.server.health_detail()
         d["t"] = now
+        # paired clock anchor (same-process, so sampled fresh): lets
+        # the router convert the server's perf_counter span timestamps
+        # to wall clock, mirroring the ProcReplica handshake
+        d["clock"] = {"perf": time.perf_counter(), "unix": time.time()}
         return d
 
     def submit(self, fr: FleetRequest, attempt_key: str,
@@ -351,7 +382,7 @@ class LocalReplica:
             temperature=fr.params["temperature"],
             top_k=fr.params["top_k"], top_p=fr.params["top_p"],
             eos_id=fr.params["eos_id"], seed=fr.params["seed"],
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, trace_ctx=attempt_key)
         return req
 
     def drive(self) -> int:
@@ -368,10 +399,15 @@ class LocalReplica:
     def poll(self, sub) -> Optional[dict]:
         if sub.state != "finished" or id(sub) in self._dropped:
             return None
-        return {"status": sub.status,
-                "tokens": [int(t) for t in sub.output_tokens],
-                "finish_reason": sub.finish_reason,
-                "ttft": getattr(sub, "ttft", None)}
+        res = {"status": sub.status,
+               "tokens": [int(t) for t in sub.output_tokens],
+               "finish_reason": sub.finish_reason,
+               "ttft": getattr(sub, "ttft", None)}
+        if telemetry._ENABLED:
+            tr = self.server.trace(sub.id)
+            if tr is not None:
+                res["trace"] = tr
+        return res
 
     def discard(self, sub):
         """Forget a result (the `router.drop` fault's sink)."""
@@ -490,7 +526,8 @@ class _Rep:
     """Router-side per-replica state: the handle plus everything the
     router derives about it."""
     __slots__ = ("handle", "name", "breaker", "state", "detail",
-                 "last_seen", "attempts")
+                 "last_seen", "attempts", "clock_offset", "tm_state",
+                 "hb_seq")
 
     def __init__(self, handle, breaker, now):
         self.handle = handle
@@ -500,6 +537,12 @@ class _Rep:
         self.detail: Optional[dict] = None
         self.last_seen = now            # heartbeat staleness baseline
         self.attempts: Dict[int, tuple] = {}    # id(att) -> (fr, att)
+        #: unix - perf_counter offset from the replica's clock anchor
+        #: (the cross-process trace alignment handshake)
+        self.clock_offset: Optional[float] = None
+        #: latest heartbeat-shipped registry state, family -> blob
+        self.tm_state: Dict[str, dict] = {}
+        self.hb_seq = None              # last heartbeat seq applied
 
 
 # -- the router --------------------------------------------------------------
@@ -577,6 +620,11 @@ class FleetRouter:
         self.n_failovers = 0
         self.n_hedges = 0
         self.n_duplicates = 0
+        self._pick_how = "least_loaded"     # last routing decision
+        self._slo = None                    # attach_slo() sets this
+        self._bundle_seq = 0
+        self.last_bundle_path: Optional[str] = None
+        telemetry.register_fleet_trace_source(self)
 
     # -- intake --------------------------------------------------------------
 
@@ -633,6 +681,8 @@ class FleetRouter:
         progress += self._hedge(now)
         self.ticks += 1
         self._note_progress(progress, now)
+        if self._slo is not None and telemetry._ENABLED:
+            self._slo.tick()
         return progress
 
     def run(self, max_ticks: Optional[int] = None,
@@ -667,6 +717,20 @@ class FleetRouter:
             if d is not None:
                 rep.detail = d
                 rep.last_seen = float(d.get("t", now))
+                ck = d.get("clock")
+                if ck is not None:
+                    rep.clock_offset = (float(ck.get("unix", 0.0))
+                                        - float(ck.get("perf", 0.0)))
+                seq = d.get("hb_seq")
+                if seq is None or seq != rep.hb_seq:
+                    rep.hb_seq = seq
+                    tm = d.get("tm")
+                    if tm:
+                        for fam_name, st in tm.items():
+                            if st is None:
+                                rep.tm_state.pop(fam_name, None)
+                            else:
+                                rep.tm_state[fam_name] = st
             if isinstance(h, ProcReplica) and rep.detail is not None:
                 # heartbeat staleness is the liveness signal for a
                 # remote worker — and a fresh beat REVIVES one that was
@@ -691,10 +755,24 @@ class FleetRouter:
                                state=_STATE_NAMES[state],
                                was=_STATE_NAMES[rep.state])
                 rep.state = state
+                if state == DEAD:
+                    # terminal state: drop the replica's labeled series
+                    # (and its heartbeat-shipped registry contribution)
+                    # instead of leaving stale rows in /metrics forever
+                    rep.tm_state.clear()
+                    if telemetry._ENABLED:
+                        telemetry.remove_series("router_replica_health",
+                                                replica=rep.name)
+                        telemetry.remove_series("router_replica_inflight",
+                                                replica=rep.name)
         if telemetry._ENABLED:
             for rep in self._reps:
+                if rep.state == DEAD:
+                    continue
                 telemetry.set_gauge("router_replica_health", rep.state,
                                     replica=rep.name)
+                telemetry.set_gauge("router_replica_inflight",
+                                    len(rep.attempts), replica=rep.name)
             telemetry.set_gauge("router_fleet_queue_depth",
                                 len(self._queue))
 
@@ -713,7 +791,7 @@ class FleetRouter:
             if rep.state != DEAD or not rep.attempts:
                 continue
             for fr, att in list(rep.attempts.values()):
-                self._drop_attempt(fr, att)
+                self._drop_attempt(fr, att, outcome="failover")
                 self.n_failovers += 1
                 n += 1
                 if telemetry._ENABLED:
@@ -780,8 +858,10 @@ class FleetRouter:
             tgt = self._affinity.get(key)
             if tgt is not None and tgt in elig:
                 self._affinity.move_to_end(key)
+                self._pick_how = "prefix_affinity"
                 return tgt
         best = min(elig, key=self._load)
+        self._pick_how = "least_loaded"
         if key is not None:
             self._affinity[key] = best
             self._affinity.move_to_end(key)
@@ -829,6 +909,13 @@ class FleetRouter:
                 self._retry(fr, now, f"submit to {rep.name}: {e}")
             return False
         att = _Attempt(rep, sub, now, hedge)
+        if telemetry._ENABLED:
+            att.log = {"attempt": fr.tries - 1, "replica": rep.name,
+                       "key": attempt_key, "t0": now, "hedge": hedge,
+                       "decision": self._pick_how, "outcome": None,
+                       "t_end": None, "clock": rep.clock_offset,
+                       "trace": None}
+            fr.attempt_log.append(att.log)
         fr.attempts.append(att)
         rep.attempts[id(att)] = (fr, att)
         fr.state = "inflight"
@@ -857,7 +944,12 @@ class FleetRouter:
         return toks
 
     def _drop_attempt(self, fr: FleetRequest, att: _Attempt,
-                      cancel: bool = False):
+                      cancel: bool = False,
+                      outcome: Optional[str] = None):
+        if att.log is not None and outcome is not None \
+                and att.log.get("outcome") is None:
+            att.log["outcome"] = outcome
+            att.log["t_end"] = time.time()
         if att in fr.attempts:
             fr.attempts.remove(att)
         att.rep.attempts.pop(id(att), None)
@@ -866,6 +958,21 @@ class FleetRouter:
                 att.rep.handle.cancel(att.sub)
             except Exception:
                 pass
+
+    def _note_result(self, att: _Attempt, res: dict, outcome: str,
+                     now: float):
+        """Record an attempt's terminal outcome and stitch the worker's
+        shipped span timeline (plus the clock offset that aligns it)
+        into the distributed trace."""
+        if att.log is None:
+            return
+        if att.log.get("outcome") is None:
+            att.log["outcome"] = outcome
+            att.log["t_end"] = now
+        tr = res.get("trace") if isinstance(res, dict) else None
+        if tr is not None:
+            att.log["trace"] = tr
+            att.log["clock"] = att.rep.clock_offset
 
     def _retry(self, fr: FleetRequest, now: float, why: str):
         """Requeue after a failed/lost attempt under capped-exponential
@@ -905,7 +1012,8 @@ class FleetRouter:
                     if self.attempt_timeout_s is not None and \
                             now - att.t0 > self.attempt_timeout_s:
                         att.rep.breaker.record_failure(now)
-                        self._drop_attempt(fr, att, cancel=True)
+                        self._drop_attempt(fr, att, cancel=True,
+                                           outcome="timeout")
                         if _fl._ENABLED:
                             _fl.record("route", "router.attempt_timeout",
                                        token=fr.token,
@@ -919,7 +1027,7 @@ class FleetRouter:
                     # the attempt, and let the retry + idempotency
                     # machinery prove the request still finishes once
                     att.rep.handle.discard(att.sub)
-                    self._drop_attempt(fr, att)
+                    self._drop_attempt(fr, att, outcome="dropped")
                     self._retry(fr, now, "router.drop")
                     continue
                 if res.get("status") == "ok":
@@ -930,6 +1038,8 @@ class FleetRouter:
                     # the replica: the attempt failed
                     if res.get("status") != _CANCELLED:
                         att.rep.breaker.record_failure(now)
+                    self._note_result(att, res,
+                                      res.get("status") or "failed", now)
                     self._drop_attempt(fr, att)
                     self._retry(fr, now,
                                 f"{res.get('status')} on {att.rep.name}")
@@ -938,6 +1048,8 @@ class FleetRouter:
     def _deliver(self, fr: FleetRequest, att: _Attempt, res: dict,
                  now: float):
         att.rep.breaker.record_success()
+        self._note_result(att, res, "duplicate" if fr.terminal
+                          else "won", now)
         self._drop_attempt(fr, att)
         if fr.terminal:
             # idempotency: a late duplicate (the request already won
@@ -953,7 +1065,8 @@ class FleetRouter:
             fr.ttft_s = (att.t0 - fr.t_submit) + float(res["ttft"])
         # hedge resolution: cancel the loser(s) before finalizing
         for other in list(fr.attempts):
-            self._drop_attempt(fr, other, cancel=True)
+            self._drop_attempt(fr, other, cancel=True,
+                               outcome="lost_hedge")
         self._finalize(fr, _OK, res.get("finish_reason"), now,
                        won=("hedge" if att.hedge else "primary"))
 
@@ -961,7 +1074,7 @@ class FleetRouter:
                   reason: Optional[str], now: float,
                   won: str = "none"):
         for att in list(fr.attempts):
-            self._drop_attempt(fr, att, cancel=True)
+            self._drop_attempt(fr, att, cancel=True, outcome="cancelled")
         self._inflight.pop(fr.token, None)
         try:
             self._queue.remove(fr)
@@ -972,8 +1085,10 @@ class FleetRouter:
         fr.finish_reason = reason
         fr.t_finish = now
         self.finished.append(fr)
-        if fr.hedged and telemetry._ENABLED:
-            telemetry.inc("serve_hedges_total", won=won)
+        if telemetry._ENABLED:
+            telemetry.inc("serve_requests_total", status=status)
+            if fr.hedged:
+                telemetry.inc("serve_hedges_total", won=won)
         if _fl._ENABLED:
             _fl.record("route", "router.finish", token=fr.token,
                        status=status, replica=fr.replica,
@@ -1124,6 +1239,233 @@ class FleetRouter:
                     "restarts": getattr(rep.handle, "restarts", 0),
                 } for rep in self._reps}}
 
+    # -- distributed tracing -------------------------------------------------
+
+    def _find_request(self, request) -> Optional[FleetRequest]:
+        if isinstance(request, FleetRequest):
+            return request
+        if isinstance(request, str):
+            fr = self._inflight.get(request)
+            if fr is not None:
+                return fr
+            for fr in self.finished + list(self._queue):
+                if fr.token == request:
+                    return fr
+            return None
+        rid = int(request)
+        for fr in (list(self._inflight.values()) + self.finished
+                   + list(self._queue)):
+            if fr.id == rid:
+                return fr
+        return None
+
+    def trace(self, request) -> Optional[dict]:
+        """ONE merged distributed timeline for a request (by id, token,
+        or the FleetRequest itself): the router's queue wait, every
+        attempt as a span carrying its replica id / routing decision /
+        outcome (won, failover, timeout, dropped, lost_hedge, ...), and
+        each attempt's shipped worker span timeline (prefill, decode
+        windows, CoW, preemptions) converted from the worker's
+        perf_counter clock to wall time via the heartbeat clock
+        handshake. Every event carries ``src`` ("router" or the replica
+        name) and a unix ``t``; timed spans carry ``dur_s``. None when
+        the request is unknown or was never traced (telemetry was
+        off)."""
+        fr = self._find_request(request)
+        if fr is None or not fr.attempt_log:
+            return None
+        now = time.time()
+        t_first = fr.attempt_log[0]["t0"]
+        events: List[dict] = [
+            {"name": "queued", "t": fr.t_submit, "src": "router",
+             "dur_s": max(0.0, t_first - fr.t_submit)}]
+        attempts = []
+        for entry in fr.attempt_log:
+            t_end = entry.get("t_end") or fr.t_finish or now
+            events.append(
+                {"name": f"attempt {entry['attempt']}",
+                 "t": entry["t0"],
+                 "dur_s": max(0.0, t_end - entry["t0"]),
+                 "src": "router", "replica": entry["replica"],
+                 "outcome": entry.get("outcome"),
+                 "hedge": entry["hedge"],
+                 "decision": entry.get("decision"),
+                 "token": entry["key"]})
+            attempts.append({k: entry.get(k) for k in
+                             ("attempt", "replica", "key", "t0", "t_end",
+                              "hedge", "decision", "outcome")})
+            wt, off = entry.get("trace"), entry.get("clock")
+            if wt and off is not None:
+                for wev in wt.get("events", []):
+                    cev = dict(wev)
+                    cev["t"] = float(wev.get("t", 0.0)) + off
+                    cev["src"] = entry["replica"]
+                    events.append(cev)
+        if fr.t_finish is not None:
+            events.append({"name": "finish", "t": fr.t_finish,
+                           "src": "router", "status": fr.status})
+        events.sort(key=lambda e: e["t"])
+        latency = None if fr.t_finish is None \
+            else fr.t_finish - fr.t_submit
+        return {"request_id": fr.id, "token": fr.token,
+                "state": fr.state, "status": fr.status,
+                "finish_reason": fr.finish_reason,
+                "replica": fr.replica, "tries": fr.tries,
+                "retries": fr.retries, "hedged": fr.hedged,
+                "queue_wait_s": max(0.0, t_first - fr.t_submit),
+                "ttft_s": fr.ttft_s, "latency_s": latency,
+                "attempts": attempts, "events": events}
+
+    def fleet_traces(self, limit: int = 256) -> List[dict]:
+        """Merged timelines of the most recent finished requests plus
+        everything in flight — the source `telemetry.export_chrome_trace`
+        renders under the router/replica pids."""
+        frs = self.finished[-int(limit):] + list(self._inflight.values())
+        out = []
+        for fr in frs:
+            if not fr.attempt_log:
+                continue
+            tr = self.trace(fr)
+            if tr is not None:
+                out.append(tr)
+        return out
+
+    # -- fleet metrics plane -------------------------------------------------
+
+    def fleet_registry(self) -> "OrderedDict":
+        """The bucket-exact merge of the router's own registry with
+        every replica's latest heartbeat-shipped snapshot: counters
+        sum, histograms merge bucket-wise, gauges get one child per
+        source under a ``replica=<name>`` label (the router's own
+        gauges appear as ``replica=router``)."""
+        blobs = {"router": telemetry._registry_state()}
+        for rep in self._reps:
+            if rep.tm_state:
+                blobs[rep.name] = rep.tm_state
+        return telemetry._merge_registry(blobs, label="replica")
+
+    def fleet_prometheus(self) -> str:
+        """Prometheus exposition of `fleet_registry()` — the body the
+        router's /metrics serves."""
+        return telemetry._prometheus_text(self.fleet_registry())
+
+    def start_metrics_server(self, port: int = 0,
+                             host: Optional[str] = None):
+        """Serve the FLEET view at GET /metrics (and /healthz, which a
+        firing SLO alert flips to 503): registers this router as the
+        process's fleet metrics provider, then starts (or reuses) the
+        telemetry metrics server."""
+        telemetry.set_fleet_metrics_provider(self)
+        return telemetry.start_metrics_server(port=port, host=host)
+
+    # -- SLO engine ----------------------------------------------------------
+
+    def attach_slo(self, engine=None, *, bundle_on_alert: bool = True,
+                   bundle_dir: Optional[str] = None,
+                   bundle_timeout_s: float = 5.0, **engine_kw):
+        """Wire an SLO engine to this fleet: sample the fleet-merged
+        registry, tick from `step()` (behind the telemetry gate),
+        register as a /healthz source (a firing alert answers 503
+        naming the violated objective), and — on each alert's rising
+        edge — collect a cross-process flight bundle. Pass an
+        `SLOEngine` to reuse one, or kwargs for a default engine over
+        `slo.default_objectives` (availability measured on the fleet's
+        `serve_requests_total`, i.e. after retry/hedge/failover
+        rescue). Returns the engine."""
+        from .. import slo as _slo
+        if engine is None:
+            objectives = engine_kw.pop("objectives", None) \
+                or _slo.default_objectives(
+                    availability_metric="serve_requests_total")
+            engine = _slo.SLOEngine(objectives,
+                                    source=self.fleet_registry,
+                                    **engine_kw)
+        user_alert = engine.on_alert
+
+        def _on_alert(name, info):
+            if _fl._ENABLED:
+                _fl.record("slo", "slo.alert", objective=name,
+                           burn_fast=round(info.get("burn_rate_fast",
+                                                    0.0), 3),
+                           burn_slow=round(info.get("burn_rate_slow",
+                                                    0.0), 3))
+            if bundle_on_alert:
+                path = None if bundle_dir is None else os.path.join(
+                    bundle_dir, f"flight-bundle-slo-{name}")
+                try:
+                    self.collect_flight_bundle(
+                        f"slo-{name}", path=path,
+                        timeout_s=bundle_timeout_s)
+                except Exception:
+                    pass
+            if user_alert is not None:
+                user_alert(name, info)
+
+        engine.on_alert = _on_alert
+        telemetry.register_health_source(engine)
+        self._slo = engine
+        return engine
+
+    # -- cross-process flight correlation ------------------------------------
+
+    def collect_flight_bundle(self, reason: str = "manual",
+                              path: Optional[str] = None,
+                              timeout_s: float = 5.0) -> str:
+        """Dump the router's own flight ring and command every live
+        ProcReplica to publish its ring over the channel, collecting
+        everything into a ``flight-bundle-<reason>/`` directory (one
+        ``<who>.jsonl`` per process plus ``manifest.json``). Each dump
+        header carries paired monotonic/unix clock anchors, so
+        ``python -m mxnet_tpu.flight merge <dir>`` stitches the files
+        into one clock-aligned timeline. Returns the bundle path;
+        workers that fail to answer within `timeout_s` are listed under
+        ``missing`` in the manifest."""
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason) or "manual"
+        if path is None:
+            d = os.environ.get("MXNET_TPU_FLIGHT_DIR") or os.getcwd()
+            path = os.path.join(d, f"flight-bundle-{safe}")
+        os.makedirs(path, exist_ok=True)
+        sources = []
+        text = _fl.dump_text(reason)
+        if text is not None:
+            fname = f"router-p{os.getpid()}.jsonl"
+            with open(os.path.join(path, fname), "w") as f:
+                f.write(text)
+            sources.append(fname)
+        self._bundle_seq += 1
+        seq = self._bundle_seq
+        pending = []
+        for rep in self._reps:
+            h = rep.handle
+            if isinstance(h, ProcReplica) and rep.state != DEAD:
+                h._send({"op": "flight_dump", "reason": reason,
+                         "seq": seq})
+                pending.append(rep)
+        deadline = time.time() + timeout_s
+        while pending and time.time() < deadline:
+            for rep in list(pending):
+                h = rep.handle
+                raw = h.channel.get(f"{h.ns}/flight/{seq}",
+                                    timeout_ms=0)
+                if raw is None:
+                    continue
+                fname = f"{rep.name}.jsonl"
+                with open(os.path.join(path, fname), "w") as f:
+                    f.write(raw)
+                sources.append(fname)
+                pending.remove(rep)
+            if pending:
+                time.sleep(0.01)
+        manifest = {"bundle": 1, "reason": reason,
+                    "time_unix": time.time(),
+                    "router_pid": os.getpid(), "sources": sources,
+                    "missing": [rep.name for rep in pending]}
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+        self.last_bundle_path = path
+        return path
+
 
 # -- the worker side ---------------------------------------------------------
 
@@ -1170,11 +1512,30 @@ def run_fleet_worker(channel, name: str,
         while wreq.state != "finished":
             server.step()
 
+    # clock handshake, recorded at warm-up: perf_counter and wall clock
+    # sampled together, shipped on every heartbeat so the router can
+    # convert this worker's span timestamps to the fleet's shared
+    # wall-clock axis
+    clock_anchor = {"perf": time.perf_counter(), "unix": time.time()}
+    hb_state = {"seq": 0, "tm_prev": None}
+
     def _beat(now, reason=None):
         d = server.health_detail()
         d["t"] = now
         d["name"] = name
         d["compile"] = server.compile_stats()
+        d["clock"] = clock_anchor
+        hb_state["seq"] += 1
+        d["hb_seq"] = hb_state["seq"]
+        if telemetry._ENABLED:
+            # bounded delta-encoded registry snapshot rides the beat;
+            # every 20th beat resends the full state so a router that
+            # missed intermediate beats heals
+            prev = None if hb_state["seq"] % 20 == 1 \
+                else hb_state["tm_prev"]
+            delta, hb_state["tm_prev"] = telemetry.registry_delta(prev)
+            if delta:
+                d["tm"] = delta
         if reason is not None:
             d["ok"] = False
             d["reason"] = reason
@@ -1202,7 +1563,8 @@ def run_fleet_worker(channel, name: str,
                             top_p=cmd.get("top_p", 0.0),
                             eos_id=cmd.get("eos_id"),
                             seed=cmd.get("seed", 0),
-                            deadline_s=cmd.get("deadline_s"))
+                            deadline_s=cmd.get("deadline_s"),
+                            trace_ctx=tok)
                     except Exception as e:
                         res = json.dumps(
                             {"status": "rejected", "tokens": [],
@@ -1224,6 +1586,19 @@ def run_fleet_worker(channel, name: str,
                     live.clear()
                 else:
                     server.end_drain()  # best effort: reopen admission
+            elif op == "flight_dump":
+                # router-commanded ring dump for a flight bundle:
+                # publish the serialized ring (clock anchors in the
+                # header) on the channel instead of the local disk
+                text = _fl.dump_text(cmd.get("reason", "bundle"))
+                if text is None:        # recorder disabled here
+                    text = json.dumps(
+                        {"flight": 1, "disabled": True,
+                         "reason": cmd.get("reason"),
+                         "pid": os.getpid(), "events": 0,
+                         "t_monotonic": time.monotonic(),
+                         "time_unix": time.time()}) + "\n"
+                channel.set(f"{ns}/flight/{cmd.get('seq', 0)}", text)
             elif op == "stop":
                 stopping = True
         emitted = 0
@@ -1239,11 +1614,17 @@ def run_fleet_worker(channel, name: str,
                 time.sleep(float(sp.get("ms", 500)) / 1e3)
         for tok, req in list(live.items()):
             if req.state == "finished":
-                res = json.dumps(
-                    {"status": req.status,
-                     "tokens": [int(t) for t in req.output_tokens],
-                     "finish_reason": req.finish_reason,
-                     "ttft": getattr(req, "ttft", None)})
+                payload = {"status": req.status,
+                           "tokens": [int(t) for t in req.output_tokens],
+                           "finish_reason": req.finish_reason,
+                           "ttft": getattr(req, "ttft", None)}
+                if telemetry._ENABLED:
+                    # ship the span timeline with the result so the
+                    # router can stitch the distributed trace
+                    tr = server.trace(req.id)
+                    if tr is not None:
+                        payload["trace"] = tr
+                res = json.dumps(payload)
                 done[tok] = res
                 channel.set(f"{ns}/res/{tok}", res)
                 live.pop(tok)

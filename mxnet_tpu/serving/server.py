@@ -124,9 +124,13 @@ class Request:
     _next_id = 0
 
     def __init__(self, prompt, max_new_tokens, temperature, top_k,
-                 top_p, eos_id, seed, deadline_s=None):
+                 top_p, eos_id, seed, deadline_s=None, trace_ctx=None):
         self.id = Request._next_id
         Request._next_id += 1
+        #: distributed trace context: the fleet router's idempotency
+        #: token for the attempt that carried this request (None for
+        #: direct submits); stitched back into the fleet timeline
+        self.trace_ctx = trace_ctx
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -365,11 +369,15 @@ class InferenceServer:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, eos_id: Optional[int] = None,
                seed: int = 0,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               trace_ctx: Optional[str] = None) -> Request:
         """Enqueue one request. prompt_ids: 1-D (or (1, T)) ints.
         ``deadline_s`` bounds the request's total wall-clock lifetime
         (queue wait included); past it the request finishes with
-        status ``timed_out``."""
+        status ``timed_out``. ``trace_ctx`` stamps a distributed trace
+        context (the fleet router's per-attempt idempotency token) onto
+        the request so its span timeline can be correlated across
+        processes."""
         if self._shutdown or self._draining:
             if telemetry._ENABLED:
                 telemetry.inc("serving_requests_total", status=_REJECTED)
@@ -402,7 +410,8 @@ class InferenceServer:
                 f"block_size={self.block_size}) but the pool only has "
                 f"{capacity} — raise num_blocks or shrink the request")
         req = Request(prompt, max_new_tokens, temperature, top_k,
-                      top_p, eos_id, seed, deadline_s=deadline_s)
+                      top_p, eos_id, seed, deadline_s=deadline_s,
+                      trace_ctx=trace_ctx)
         req._trace_seq = self._submit_seq
         self._submit_seq += 1
         if self._trace_on:
@@ -1194,6 +1203,7 @@ class InferenceServer:
             else req.t_finish - req.t_submit
         return {"request_id": req.id, "state": req.state,
                 "status": req.status, "finish_reason": req.finish_reason,
+                "trace_ctx": req.trace_ctx,
                 "events": events,
                 "queue_wait_s": queue_wait, "ttft_s": req.ttft,
                 "tpot_s": tpot, "latency_s": latency,

@@ -1,0 +1,314 @@
+"""SLO engine: declarative serving objectives with Google-SRE-style
+multi-window burn-rate alerting.
+
+An :class:`Objective` declares what "good" means against one existing
+metric family — a latency bound over a log2-bucket histogram
+(``serving_ttft_seconds <= 0.5s`` for 95% of requests) or an
+availability ratio over a status-labeled counter
+(``serving_requests_total{status=ok}`` / all terminal statuses). The
+:class:`SLOEngine` samples the cumulative (good, total) pair on every
+tick, keeps a short history, and evaluates the burn rate
+
+    burn = bad_fraction / (1 - target)
+
+over TWO sliding windows (fast + slow, default 1m + 10m). An alert
+fires only when BOTH windows burn above the threshold — the fast
+window gives low detection latency, the slow window keeps a brief
+blip from paging (the multi-window policy from the Google SRE
+workbook, ch. 5). While firing, the engine:
+
+- publishes ``slo_burn_rate{objective=,window=}``,
+  ``slo_error_budget_remaining{objective=}`` and
+  ``slo_alert_firing{objective=}`` gauges,
+- reports not-ok from :meth:`SLOEngine.health`, so a registered
+  /healthz probe flips to 503 with the violated objective named in
+  the JSON body,
+- invokes ``on_alert(objective_name, info)`` once per rising edge —
+  the fleet router hooks its flight-bundle collection here.
+
+The engine is passive: someone must call :meth:`tick` (the fleet
+router does, from its step loop, behind the telemetry gate). Cost
+contract: every path that records anything early-returns on
+``telemetry._ENABLED`` (one attribute check while disabled; the AST
+lint in ``tests/test_telemetry_lint.py`` scans this module).
+
+Latency objectives snap the threshold UP to the enclosing log2 bucket
+boundary (the same bucketing ``Histogram.observe`` uses), so "good"
+counts are exact bucket sums, never interpolated.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import telemetry as _tm
+
+__all__ = ["Objective", "SLOEngine", "default_objectives"]
+
+
+def _bucket_exp(threshold: float) -> int:
+    """The log2 bucket exponent whose upper bound 2^e encloses
+    `threshold` (exact powers of two map to their own bucket), mirroring
+    Histogram.observe's frexp bucketing."""
+    m, e = math.frexp(float(threshold))
+    if m == 0.5:
+        e -= 1
+    return e
+
+
+class Objective:
+    """One declarative objective: `target` fraction of events must be
+    good over the alerting windows.
+
+    Latency form (pass ``threshold_s``): good = observations <=
+    2^ceil(log2(threshold_s)) in the named histogram (exact bucket
+    arithmetic; the threshold snaps up to the enclosing log2 bucket
+    boundary, exposed as `.effective_threshold`).
+
+    Availability form (no ``threshold_s``): good = counter children
+    whose ``status`` label is in `good_statuses`; total = all children
+    carrying a ``status`` label except `ignore_statuses` (cancellations
+    are the client's choice, not a server failure). Children without a
+    ``status`` label (e.g. the submit-time unlabeled inc) are ignored.
+    """
+
+    def __init__(self, name: str, *, metric: str, target: float,
+                 threshold_s: Optional[float] = None,
+                 good_statuses: Tuple[str, ...] = ("ok",),
+                 ignore_statuses: Tuple[str, ...] = ("cancelled",)):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        self.name = name
+        self.metric = metric
+        self.target = float(target)
+        self.threshold_s = threshold_s
+        self.good_statuses = tuple(good_statuses)
+        self.ignore_statuses = tuple(ignore_statuses)
+        if threshold_s is not None:
+            if threshold_s <= 0:
+                raise ValueError("threshold_s must be positive")
+            self._exp = _bucket_exp(threshold_s)
+            self.effective_threshold = 2.0 ** self._exp
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the tolerated bad fraction (1 - target)."""
+        return 1.0 - self.target
+
+    def sample(self, registry) -> Tuple[float, float]:
+        """Cumulative (good, total) event counts from a registry (the
+        live one or a fleet-merged OrderedDict of families)."""
+        fam = registry.get(self.metric)
+        if fam is None:
+            return 0.0, 0.0
+        good = total = 0.0
+        for key, ch in list(fam.children.items()):
+            if self.threshold_s is not None:
+                total += ch.count
+                good += ch.zeros
+                for e, n in list(ch.buckets.items()):
+                    if e <= self._exp:
+                        good += n
+            else:
+                labels = dict(key)
+                status = labels.get("status")
+                if status is None or status in self.ignore_statuses:
+                    continue
+                total += ch.value
+                if status in self.good_statuses:
+                    good += ch.value
+        return good, total
+
+
+def default_objectives(*, ttft_p95_s: float = 0.5,
+                       tpot_p95_s: float = 0.1,
+                       availability: float = 0.999,
+                       availability_metric: str = "serving_requests_total",
+                       ) -> List[Objective]:
+    """The serving objectives the Gemma-on-TPU regime cares about:
+    TTFT p95, TPOT p95 (both as 95%-under-threshold objectives over the
+    existing serving histograms) and request availability. The router
+    attaches these with ``availability_metric="serve_requests_total"``
+    so availability reflects fleet outcomes after retry/hedge/failover
+    rescue, not per-replica ones."""
+    return [
+        Objective("ttft_p95_s", metric="serving_ttft_seconds",
+                  target=0.95, threshold_s=ttft_p95_s),
+        Objective("tpot_p95_s", metric="serving_tpot_seconds",
+                  target=0.95, threshold_s=tpot_p95_s),
+        Objective("availability", metric=availability_metric,
+                  target=availability),
+    ]
+
+
+class _State:
+    """Per-objective alerting state: cumulative sample history plus the
+    firing edge."""
+    __slots__ = ("samples", "firing", "since_t", "burn_fast", "burn_slow",
+                 "bad_frac_slow")
+
+    def __init__(self):
+        self.samples: List[Tuple[float, float, float]] = []  # (t, good, tot)
+        self.firing = False
+        self.since_t: Optional[float] = None
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+        self.bad_frac_slow = 0.0
+
+
+class SLOEngine:
+    """Evaluate objectives on sliding windows; fire on multi-window
+    burn. `source` supplies the registry to sample (default: this
+    process's live registry; the router passes its fleet-merged view).
+    `now` everywhere is a monotonic clock — tests drive it manually."""
+
+    def __init__(self, objectives: List[Objective], *,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 burn_threshold: float = 10.0,
+                 tick_interval_s: float = 0.25,
+                 source: Optional[Callable[[], dict]] = None,
+                 on_alert: Optional[Callable[[str, dict], None]] = None,
+                 on_clear: Optional[Callable[[str], None]] = None):
+        if fast_window_s >= slow_window_s:
+            raise ValueError("fast window must be shorter than slow")
+        self.objectives = list(objectives)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.tick_interval_s = float(tick_interval_s)
+        self._source = source or (lambda: _tm._REGISTRY)
+        self.on_alert = on_alert
+        self.on_clear = on_clear
+        self._state: Dict[str, _State] = {o.name: _State()
+                                          for o in self.objectives}
+        self._last_tick: Optional[float] = None
+        self.alerts_total = 0
+
+    # -- evaluation ---------------------------------------------------------
+
+    @staticmethod
+    def _window(samples, now: float, window_s: float) -> Tuple[float, float]:
+        """(good, total) deltas over the trailing window: newest sample
+        minus the newest sample at or before the window start (the
+        oldest sample when history is still short)."""
+        t1, g1, n1 = samples[-1]
+        base = samples[0]
+        for s in samples:
+            if s[0] <= now - window_s:
+                base = s
+            else:
+                break
+        return g1 - base[1], n1 - base[2]
+
+    @staticmethod
+    def _burn(good: float, total: float, budget: float) -> Tuple[float, float]:
+        """(bad_fraction, burn_rate); no traffic in the window means no
+        evidence, so zero burn."""
+        if total <= 0:
+            return 0.0, 0.0
+        bad = max(0.0, (total - good) / total)
+        return bad, bad / budget
+
+    def tick(self, now: Optional[float] = None) -> Optional[List[str]]:
+        """Sample every objective and re-evaluate alerts. Returns the
+        names currently firing (None while telemetry is disabled — the
+        engine is inert, one attribute check)."""
+        if not _tm._ENABLED:
+            return None
+        if now is None:
+            now = time.monotonic()
+        if (self._last_tick is not None
+                and now - self._last_tick < self.tick_interval_s):
+            return [o.name for o in self.objectives
+                    if self._state[o.name].firing]
+        self._last_tick = now
+        registry = self._source()
+        firing: List[str] = []
+        for obj in self.objectives:
+            st = self._state[obj.name]
+            good, total = obj.sample(registry)
+            st.samples.append((now, good, total))
+            horizon = now - self.slow_window_s * 1.5
+            while len(st.samples) > 2 and st.samples[1][0] < horizon:
+                st.samples.pop(0)
+            fg, ft = self._window(st.samples, now, self.fast_window_s)
+            sg, stt = self._window(st.samples, now, self.slow_window_s)
+            _, st.burn_fast = self._burn(fg, ft, obj.budget)
+            st.bad_frac_slow, st.burn_slow = self._burn(sg, stt, obj.budget)
+            was = st.firing
+            st.firing = (st.burn_fast > self.burn_threshold
+                         and st.burn_slow > self.burn_threshold)
+            if st.firing:
+                firing.append(obj.name)
+                if not was:
+                    st.since_t = now
+                    self.alerts_total += 1
+                    if self.on_alert is not None:
+                        try:
+                            self.on_alert(obj.name, self.objective_info(obj))
+                        except Exception:
+                            pass
+            elif was:
+                st.since_t = None
+                if self.on_clear is not None:
+                    try:
+                        self.on_clear(obj.name)
+                    except Exception:
+                        pass
+            self._publish(obj, st)
+        return firing
+
+    def _publish(self, obj: Objective, st: _State):
+        if not _tm._ENABLED:
+            return
+        _tm.set_gauge("slo_burn_rate", st.burn_fast,
+                      objective=obj.name, window="fast")
+        _tm.set_gauge("slo_burn_rate", st.burn_slow,
+                      objective=obj.name, window="slow")
+        _tm.set_gauge("slo_error_budget_remaining",
+                      max(0.0, 1.0 - st.bad_frac_slow / obj.budget),
+                      objective=obj.name)
+        _tm.set_gauge("slo_alert_firing", 1.0 if st.firing else 0.0,
+                      objective=obj.name)
+
+    def objective_info(self, obj: Objective) -> dict:
+        st = self._state[obj.name]
+        info = {"objective": obj.name, "metric": obj.metric,
+                "target": obj.target, "firing": st.firing,
+                "burn_rate_fast": st.burn_fast,
+                "burn_rate_slow": st.burn_slow,
+                "burn_threshold": self.burn_threshold,
+                "error_budget_remaining":
+                    max(0.0, 1.0 - st.bad_frac_slow / obj.budget)}
+        if obj.threshold_s is not None:
+            info["threshold_s"] = obj.threshold_s
+            info["effective_threshold_s"] = obj.effective_threshold
+        return info
+
+    # -- health-source protocol (telemetry.register_health_source) ----------
+
+    def firing(self) -> List[str]:
+        return [o.name for o in self.objectives
+                if self._state[o.name].firing]
+
+    def health(self) -> Tuple[bool, str]:
+        """(ok, reason); while any alert fires the reason NAMES the
+        violated objective(s) — this is what /healthz serves as 503."""
+        names = self.firing()
+        if not names:
+            return True, "ok"
+        parts = []
+        for n in names:
+            st = self._state[n]
+            parts.append(f"{n} burn={st.burn_fast:.1f}/{st.burn_slow:.1f}"
+                         f" (fast/slow, threshold"
+                         f" {self.burn_threshold:g})")
+        return False, "slo violated: " + "; ".join(parts)
+
+    def health_detail(self) -> dict:
+        ok, reason = self.health()
+        return {"ok": ok, "reason": reason, "kind": "slo",
+                "objectives": [self.objective_info(o)
+                               for o in self.objectives]}

@@ -56,6 +56,7 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "Counter", "Gauge", "Histogram",
            "counter", "gauge", "histogram",
            "inc", "set_gauge", "observe",
+           "read_gauge", "remove_series",
            "phase", "mark_phase", "step_done",
            "snapshot", "to_prometheus", "dump_json", "breakdown_table",
            "export_chrome_trace", "note_device_trace",
@@ -64,11 +65,14 @@ __all__ = ["enable", "disable", "enabled", "reset",
            "register_health_source", "unregister_health_source", "health",
            "health_report",
            "register_request_trace_source",
+           "register_fleet_trace_source",
+           "set_fleet_metrics_provider",
            "publish_snapshot", "aggregate_snapshot",
-           "to_prometheus_merged",
+           "to_prometheus_merged", "registry_delta",
            "publish_step_time", "step_times", "step_time_skew",
            "stragglers",
-           "STEP_PHASES", "SERVE_PHASES", "REQUEST_PID"]
+           "STEP_PHASES", "SERVE_PHASES", "REQUEST_PID",
+           "ROUTER_PID", "REPLICA_PID_BASE"]
 
 #: THE flag. Instrumented call sites across the stack guard with
 #: `if telemetry._ENABLED:` (one module-attribute load + branch) so the
@@ -110,6 +114,10 @@ _SPEED_WINDOW: deque = deque(maxlen=64)
 HOST_PID = 0
 DEVICE_PID = 1
 REQUEST_PID = 9000
+#: fleet pids: the router's own spans and one pid per replica (assigned
+#: REPLICA_PID_BASE + index over sorted replica names at export time)
+ROUTER_PID = 9500
+REPLICA_PID_BASE = 9501
 
 #: weakrefs to objects exposing `health() -> (ok, reason)`; consulted
 #: by the /healthz endpoint (InferenceServer registers itself so a
@@ -119,6 +127,15 @@ _HEALTH_SOURCES: List[weakref.ref] = []
 #: weakrefs to objects exposing `request_traces() -> [trace dict]`;
 #: export_chrome_trace merges their span timelines under REQUEST_PID
 _REQUEST_TRACE_SOURCES: List[weakref.ref] = []
+
+#: weakrefs to objects exposing `fleet_traces() -> [merged timeline]`
+#: (FleetRouter); export_chrome_trace renders them with ROUTER_PID for
+#: router-side spans and one pid per replica
+_FLEET_TRACE_SOURCES: List[weakref.ref] = []
+
+#: weakref to an object exposing `fleet_prometheus() -> str` (a
+#: FleetRouter); when set, /metrics serves the fleet-merged view
+_FLEET_METRICS_PROVIDER: Optional[weakref.ref] = None
 
 
 def enable():
@@ -335,6 +352,30 @@ def observe(name: str, value, **labels):
     if not _ENABLED:
         return
     histogram(name).labels(**labels).observe(value)
+
+
+def read_gauge(name: str, default=None, **labels):
+    """Read a gauge child's current value WITHOUT creating the family
+    or the child (returns `default` when either is absent, or when the
+    family is not a gauge). Works regardless of the enabled flag — it
+    reads whatever earlier enabled-time writes left behind."""
+    fam = _REGISTRY.get(name)
+    if fam is None or fam.kind != "gauge":
+        return default
+    ch = fam.children.get(_label_key(labels))
+    return default if ch is None else ch.value
+
+
+def remove_series(name: str, **labels) -> bool:
+    """Drop ONE labeled child from a family (e.g. the
+    `router_replica_health{replica=w0}` gauge after w0 goes DEAD) so
+    terminal label sets don't linger in /metrics forever. Returns True
+    when a child was removed. The family itself stays registered."""
+    fam = _REGISTRY.get(name)
+    if fam is None:
+        return False
+    with _lock:
+        return fam.children.pop(_label_key(labels), None) is not None
 
 
 # -- per-step timeline ------------------------------------------------------
@@ -566,6 +607,39 @@ def _registry_state() -> dict:
     return out
 
 
+def registry_delta(prev: Optional[dict],
+                   max_bytes: int = 65536) -> Tuple[dict, dict]:
+    """Bounded, delta-encoded registry serialization for piggybacking
+    on heartbeats: returns ``(delta, acked)`` where ``delta`` holds
+    only the families whose state changed since ``prev`` (value None
+    marks a family that disappeared, e.g. after reset) and ``acked`` is
+    the state to pass as ``prev`` next time. Families that would push
+    the encoded delta past ``max_bytes`` are deferred — they stay dirty
+    in ``acked`` and ship on a later beat, so the channel stays bounded
+    and the receiver stays eventually consistent. Family states are
+    absolute (not increments), so re-applying a delta is idempotent —
+    safe over an at-least-once heartbeat channel."""
+    cur = _registry_state()
+    prev = prev or {}
+    delta: dict = {}
+    acked = dict(prev)
+    budget = int(max_bytes)
+    for name in prev:
+        if name not in cur:
+            delta[name] = None
+            acked.pop(name, None)
+    for name, st in cur.items():
+        if prev.get(name) == st:
+            continue
+        cost = len(json.dumps({name: st}))
+        if delta and budget - cost < 0:
+            continue  # over budget: defer this family to a later beat
+        budget -= cost
+        delta[name] = st
+        acked[name] = st
+    return delta, acked
+
+
 def publish_snapshot() -> bool:
     """Publish this process's registry to the coordination-service KV
     store so `aggregate_snapshot` on any process (in practice: the
@@ -582,11 +656,13 @@ def publish_snapshot() -> bool:
                       json.dumps(_registry_state()))
 
 
-def _merge_registry(blobs: Dict[int, dict]) -> "OrderedDict[str, _Family]":
+def _merge_registry(blobs: Dict,
+                    label: str = "proc") -> "OrderedDict[str, _Family]":
     """Merge per-process registry states into fresh (registry-detached)
     families: counters sum, histograms merge bucket-wise (exact
     count/sum/min/max/zeros), gauges keep one child per process under a
-    `proc` label."""
+    `proc` label (or `label=` — the fleet router merges per-replica
+    blobs keyed by replica NAME with ``label="replica"``)."""
     merged: "OrderedDict[str, _Family]" = OrderedDict()
     for pid in sorted(blobs):
         for name, st in blobs[pid].items():
@@ -602,7 +678,7 @@ def _merge_registry(blobs: Dict[int, dict]) -> "OrderedDict[str, _Family]":
             for pairs, state in st.get("c", []):
                 labels = {str(k): str(v) for k, v in pairs}
                 if kind == "gauge":
-                    labels["proc"] = str(pid)
+                    labels[label] = str(pid)
                 ch = fam.labels(**labels)
                 if kind == "counter":
                     ch.inc(float(state))
@@ -830,10 +906,36 @@ def register_request_trace_source(obj):
     _prune_register(_REQUEST_TRACE_SOURCES, obj)
 
 
+def register_fleet_trace_source(obj):
+    """Register an object exposing `fleet_traces() -> [merged timeline]`
+    (FleetRouter); export_chrome_trace renders the router-side spans on
+    ROUTER_PID and each replica's spans on its own pid. Held by
+    weakref."""
+    _prune_register(_FLEET_TRACE_SOURCES, obj)
+
+
+def set_fleet_metrics_provider(obj):
+    """Point /metrics at a fleet view: `obj` exposes
+    `fleet_prometheus() -> str` (a FleetRouter serving the bucket-exact
+    merge of its own registry plus every replica's heartbeat-shipped
+    snapshot). Held by weakref; pass None to restore the local body."""
+    global _FLEET_METRICS_PROVIDER
+    with _lock:
+        _FLEET_METRICS_PROVIDER = None if obj is None else weakref.ref(obj)
+
+
 def _metrics_body() -> bytes:
-    """The /metrics payload: the merged cross-process view on the
-    primary of an initialized multi-process job, the local registry
-    everywhere else (and on any aggregation failure)."""
+    """The /metrics payload: the fleet-merged view when a FleetRouter
+    registered itself as provider, else the merged cross-process view
+    on the primary of an initialized multi-process job, the local
+    registry everywhere else (and on any aggregation failure)."""
+    ref = _FLEET_METRICS_PROVIDER
+    provider = ref() if ref is not None else None
+    if provider is not None:
+        try:
+            return provider.fleet_prometheus().encode()
+        except Exception:
+            pass
     try:
         from .parallel import multihost as _mh
         if _mh.is_initialized():
@@ -1061,6 +1163,88 @@ def _request_trace_events() -> List[dict]:
     return events
 
 
+def _fleet_trace_events() -> List[dict]:
+    """Convert every registered fleet source's merged request timelines
+    (see FleetRouter.trace) into chrome events: router-side spans on
+    ROUTER_PID, each replica's spans on REPLICA_PID_BASE + its index
+    over the sorted replica names (stable across exports), one tid per
+    request on every pid. Timestamps are unix seconds — the fleet's one
+    shared clock after the heartbeat offset handshake."""
+    raw: List[Tuple[str, int, dict]] = []   # (src, request_id, event)
+    replicas = set()
+    tids: Dict[str, set] = {}
+    for src in _live_sources(_FLEET_TRACE_SOURCES):
+        try:
+            traces = src.fleet_traces()
+        except Exception:
+            continue
+        for tr in traces:
+            rid = int(tr.get("request_id", 0))
+            for ev in tr.get("events", []):
+                who = str(ev.get("src", "router"))
+                if who != "router":
+                    replicas.add(who)
+                tids.setdefault(who, set()).add(rid)
+                raw.append((who, rid, ev))
+    if not raw:
+        return []
+    pid_of = {"router": ROUTER_PID}
+    for i, name in enumerate(sorted(replicas)):
+        pid_of[name] = REPLICA_PID_BASE + i
+    events: List[dict] = []
+    for who, name in sorted(pid_of.items(), key=lambda kv: kv[1]):
+        label = ("fleet: router" if who == "router"
+                 else f"fleet: replica {who}")
+        events.append({"ph": "M", "pid": pid_of[who],
+                       "name": "process_name", "args": {"name": label}})
+        for rid in sorted(tids.get(who, ())):
+            events.append({"ph": "M", "pid": pid_of[who], "tid": rid,
+                           "name": "thread_name",
+                           "args": {"name": f"request {rid}"}})
+    for who, rid, ev in raw:
+        base = {"name": ev.get("name", "?"), "pid": pid_of[who],
+                "tid": rid, "ts": float(ev.get("t", 0.0)) * 1e6}
+        args = {k: v for k, v in ev.items()
+                if k not in ("name", "t", "dur_s", "src")}
+        if args:
+            base["args"] = args
+        dur = ev.get("dur_s")
+        if dur is not None:
+            base["ph"] = "X"
+            base["dur"] = float(dur) * 1e6
+        else:
+            base["ph"] = "i"
+            base["s"] = "t"
+        events.append(base)
+    return events
+
+
+def _normalize_trace_events(events: List[dict]) -> List[dict]:
+    """Deterministic event ordering for export: metadata first (sorted
+    by pid/name/tid), then spans sorted by (pid, ts, -dur, name, ph);
+    host/device thread idents (which vary run to run) are renumbered to
+    dense per-pid indices in first-encounter order of the sorted
+    stream. Same recorded spans in -> byte-identical JSON out."""
+    meta = [dict(e) for e in events if e.get("ph") == "M"]
+    rest = [dict(e) for e in events if e.get("ph") != "M"]
+    rest.sort(key=lambda e: (e.get("pid", 0), float(e.get("ts", 0.0)),
+                             -float(e.get("dur", 0.0) or 0.0),
+                             str(e.get("name", "")), str(e.get("ph", ""))))
+    remap: Dict[Tuple, int] = {}
+    counts: Dict[int, int] = {}
+    for e in rest:
+        pid = e.get("pid", 0)
+        if pid in (HOST_PID, DEVICE_PID) and "tid" in e:
+            key = (pid, e["tid"])
+            if key not in remap:
+                remap[key] = counts.get(pid, 0)
+                counts[pid] = remap[key] + 1
+            e["tid"] = remap[key]
+    meta.sort(key=lambda e: (e.get("pid", 0), str(e.get("name", "")),
+                             str(e.get("tid", ""))))
+    return meta + rest
+
+
 def export_chrome_trace(path: str) -> str:
     """Write ONE chrome://tracing-loadable JSON merging:
 
@@ -1070,10 +1254,14 @@ def export_chrome_trace(path: str) -> str:
       FusedTrainStep with `device=True`) and any chrome-format trace a
       registered `jax.profiler` session produced (pids >= 2),
     - per-request serving span timelines from registered
-      InferenceServers (pid REQUEST_PID, one tid per request).
+      InferenceServers (pid REQUEST_PID, one tid per request),
+    - fleet-merged request timelines from registered FleetRouters
+      (router spans on pid ROUTER_PID, one pid per replica).
 
     Works with whatever has been recorded so far; events only exist
-    for spans that ran while telemetry was enabled."""
+    for spans that ran while telemetry was enabled. The output is
+    deterministic: same recorded spans produce byte-identical JSON
+    (stable event order, dense per-pid thread ids, sorted keys)."""
     events: List[dict] = [
         {"ph": "M", "pid": HOST_PID, "name": "process_name",
          "args": {"name": "host: telemetry phases + profiler scopes"}},
@@ -1087,6 +1275,7 @@ def export_chrome_trace(path: str) -> str:
     except Exception:
         pass
     events.extend(_request_trace_events())
+    events.extend(_fleet_trace_events())
     dev = _device_trace_events()
     if dev:
         pids = sorted({ev.get("pid") for ev in dev})
@@ -1094,6 +1283,8 @@ def export_chrome_trace(path: str) -> str:
             events.append({"ph": "M", "pid": pid, "name": "process_name",
                            "args": {"name": "device: jax.profiler trace"}})
         events.extend(dev)
+    events = _normalize_trace_events(events)
     with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  sort_keys=True, separators=(",", ":"))
     return path

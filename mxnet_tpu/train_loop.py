@@ -19,7 +19,9 @@ See docs/compiled_loop.md for when K helps and the degrade matrix.
 """
 from __future__ import annotations
 
+import math
 import time
+import warnings
 from typing import Callable, Iterable, Optional
 
 from . import flight as _fl
@@ -27,6 +29,36 @@ from . import telemetry as _tm
 from .gluon.data.dataloader import DevicePrefetcher, window_iter
 
 __all__ = ["TrainLoop"]
+
+#: auto-K: per-step host residual to aim for after amortization (the
+#: fused window divides the measured dispatch overhead by K)
+AUTO_K_TARGET_MS = 0.1
+AUTO_K_MAX = 64
+AUTO_K_DEFAULT = 8
+
+_AUTO_K_WARNED = False
+
+
+def _auto_k() -> int:
+    """Pick K from the live `train_dispatch_overhead_ms_per_step`
+    gauge (set by FusedTrainStep on every timed dispatch): K =
+    ceil(overhead / AUTO_K_TARGET_MS), so the amortized per-step host
+    overhead lands at the target. Clamped to [1, AUTO_K_MAX]; without
+    a signal (telemetry off, or no timed step has run yet) warns ONCE
+    and falls back to AUTO_K_DEFAULT."""
+    global _AUTO_K_WARNED
+    overhead_ms = _tm.read_gauge("train_dispatch_overhead_ms_per_step")
+    if overhead_ms is None or overhead_ms <= 0:
+        if not _AUTO_K_WARNED:
+            _AUTO_K_WARNED = True
+            warnings.warn(
+                "TrainLoop(k='auto'): no train_dispatch_overhead_ms_per_"
+                "step gauge yet (enable telemetry and run one timed "
+                f"step first) — using the default K={AUTO_K_DEFAULT}",
+                RuntimeWarning, stacklevel=3)
+        return AUTO_K_DEFAULT
+    return max(1, min(AUTO_K_MAX,
+                      math.ceil(overhead_ms / AUTO_K_TARGET_MS)))
 
 
 class TrainLoop:
@@ -38,6 +70,8 @@ class TrainLoop:
     is one) so the host stacks window i+1 while window i runs on
     device. Each window of K batches becomes one ``run_steps`` call —
     a ragged final window just uses the second cached executable.
+    ``k="auto"`` sizes the window from the live telemetry
+    dispatch-overhead gauge (see :func:`_auto_k`).
 
     Checkpointing: pass a ``Checkpointer`` plus ``save_every`` (in
     steps; rounded up to the next K boundary, since the loop only sees
@@ -46,11 +80,15 @@ class TrainLoop:
     synchronous checkpoint at the K boundary and stops cleanly.
     """
 
-    def __init__(self, step, k: int = 8, checkpointer=None,
+    def __init__(self, step, k=8, checkpointer=None,
                  save_every: Optional[int] = None, preemption=None,
                  prefetch_depth: int = 2):
-        if k < 1:
-            raise ValueError(f"k must be >= 1; got {k}")
+        if k == "auto":
+            # pick K from the telemetry dispatch-overhead gauge so the
+            # amortized host overhead lands at AUTO_K_TARGET_MS/step
+            k = _auto_k()
+        if not isinstance(k, (int, float)) or k < 1:
+            raise ValueError(f"k must be >= 1 or 'auto'; got {k!r}")
         self.step = step
         self.k = int(k)
         self.checkpointer = checkpointer

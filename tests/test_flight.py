@@ -276,3 +276,82 @@ def test_compile_event_feed():
     evs = [e for e in flight.events() if e[1] == "compile"]
     assert evs and evs[-1][2] == "blk"
     assert evs[-1][3]["seconds"] == 0.125
+
+
+# -- cross-process merge CLI (ISSUE 14) --------------------------------------
+
+def _write_dump(path, src_events, t_monotonic, time_unix, pid=1):
+    header = {"flight": 1, "reason": "test", "pid": pid, "seq": 1,
+              "events": len(src_events), "capacity": 512,
+              "t_monotonic": t_monotonic, "time_unix": time_unix}
+    lines = [json.dumps(header)]
+    for t, kind, site, payload in src_events:
+        line = {"t": t, "kind": kind, "site": site}
+        if payload:
+            line["payload"] = payload
+        lines.append(json.dumps(line))
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _read_merged(path):
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()
+             if ln.strip()]
+    return lines[0], lines[1:]
+
+
+def test_merge_aligns_clocks_across_processes(tmp_path):
+    """Two dumps whose monotonic clocks started at wildly different
+    zeros interleave correctly once each is shifted by its own
+    header's time_unix - t_monotonic offset."""
+    # process A: monotonic 100 == unix 1000 (offset +900)
+    _write_dump(tmp_path / "a.jsonl",
+                [(101.0, "k", "a.first", None),
+                 (103.0, "k", "a.last", {"n": 1})],
+                t_monotonic=100.0, time_unix=1000.0, pid=11)
+    # process B: monotonic 5000 == unix 1000 (offset -4000)
+    _write_dump(tmp_path / "b.jsonl",
+                [(5002.0, "k", "b.mid", None)],
+                t_monotonic=5000.0, time_unix=1000.0, pid=22)
+    out = flight.merge([str(tmp_path / "a.jsonl"),
+                        str(tmp_path / "b.jsonl")])
+    assert out == str(tmp_path / "merged.jsonl")
+    head, evs = _read_merged(tmp_path / "merged.jsonl")
+    assert head["flight_merge"] == 1 and head["events"] == 3
+    assert [s["file"] for s in head["sources"]] == \
+        ["a.jsonl", "b.jsonl"]
+    assert head["sources"][0]["offset_s"] == 900.0
+    assert head["sources"][1]["offset_s"] == -4000.0
+    # wall-clock interleave: a.first (1001) < b.mid (1002) < a.last
+    assert [(e["src"], e["site"]) for e in evs] == \
+        [("a", "a.first"), ("b", "b.mid"), ("a", "a.last")]
+    assert [e["t_unix"] for e in evs] == [1001.0, 1002.0, 1003.0]
+    assert evs[2]["payload"] == {"n": 1}
+
+
+def test_merge_directory_skips_prior_merge_output(tmp_path):
+    _write_dump(tmp_path / "w0.jsonl", [(1.0, "k", "s", None)],
+                t_monotonic=0.0, time_unix=100.0)
+    (tmp_path / "manifest.json").write_text("{}")   # non-jsonl: ignored
+    out1 = flight.merge([str(tmp_path)])
+    head1, evs1 = _read_merged(tmp_path / "merged.jsonl")
+    assert head1["events"] == 1
+    # re-merge of the same dir must not swallow merged.jsonl itself
+    out2 = flight.merge([str(tmp_path)])
+    assert out1 == out2
+    head2, evs2 = _read_merged(tmp_path / "merged.jsonl")
+    assert head2 == head1 and evs2 == evs1
+
+
+def test_merge_cli_main(tmp_path, capsys):
+    _write_dump(tmp_path / "w0.jsonl", [(1.0, "k", "s", None)],
+                t_monotonic=0.0, time_unix=100.0)
+    dst = tmp_path / "out.jsonl"
+    assert flight.main(["merge", str(tmp_path), "-o", str(dst)]) == 0
+    assert capsys.readouterr().out.strip() == str(dst)
+    head, evs = _read_merged(dst)
+    assert head["events"] == 1 and evs[0]["t_unix"] == 101.0
+
+
+def test_merge_requires_sources(tmp_path):
+    with pytest.raises(ValueError, match="no flight dumps"):
+        flight.merge([str(tmp_path)])       # empty directory

@@ -500,13 +500,31 @@ def test_replica_names_must_be_unique():
 def test_health_state_gauges_exported():
     telemetry.enable()
     r0, r1 = FakeReplica("r0"), FakeReplica("r1")
-    r1.dead = True
     fleet = _fleet([r0, r1])
     fleet.step()
     g = telemetry.snapshot()["gauges"]
     assert g["router_replica_health{replica=r0}"] == HEALTHY
-    assert g["router_replica_health{replica=r1}"] == DEAD
+    assert g["router_replica_health{replica=r1}"] == HEALTHY
+    assert g["router_replica_inflight{replica=r0}"] == 0.0
     assert g["router_fleet_queue_depth"] == 0.0
+
+
+def test_dead_replica_series_removed():
+    """Terminal state must DROP the per-replica labeled series instead
+    of freezing them at their last value — a dead replica showing a
+    stale HEALTHY/load gauge forever is a dashboard lie."""
+    telemetry.enable()
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    fleet = _fleet([r0, r1])
+    fleet.step()
+    g = telemetry.snapshot()["gauges"]
+    assert "router_replica_health{replica=r1}" in g
+    r1.dead = True
+    fleet.step()
+    g = telemetry.snapshot()["gauges"]
+    assert "router_replica_health{replica=r1}" not in g
+    assert "router_replica_inflight{replica=r1}" not in g
+    assert g["router_replica_health{replica=r0}"] == HEALTHY
 
 
 # -- real replicas: token parity, in-process fault sites ---------------------
@@ -620,3 +638,330 @@ def test_proc_replica_protocol_over_filekv_thread(net, tmp_path):
     finally:
         t.join(timeout=30)
     assert not t.is_alive(), "worker must exit on stop"
+
+
+# -- fleet observability: tracing, metrics plane, SLO, flight bundles --------
+
+def test_fleet_trace_merged_timeline_local(net):
+    """One merged timeline per request: router queue/attempt spans plus
+    the winning worker's shipped span timeline, clock-converted and
+    time-ordered; reachable by request object, token, and id."""
+    telemetry.enable()
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a"),
+                         LocalReplica(_mk_server(net), name="b")],
+                        affinity_blocks=0)
+    rs = np.random.RandomState(7)
+    reqs = _mixed(fleet, rs, 4)
+    fleet.run(timeout_s=120)
+    fr = reqs[0][2]
+    tr = fleet.trace(fr)
+    assert tr is not None and tr["status"] == "ok"
+    assert fleet.trace(fr.token)["token"] == fr.token
+    assert fleet.trace(fr.id)["request_id"] == fr.id
+    names = [e["name"] for e in tr["events"]]
+    assert names[0] == "queued" and "finish" in names
+    assert any(n.startswith("attempt ") for n in names)
+    att = next(e for e in tr["events"]
+               if e["name"].startswith("attempt "))
+    assert att["replica"] == fr.replica and att["outcome"] == "won"
+    assert att["decision"] in ("least_loaded", "prefix_affinity")
+    # the worker's own spans rode the result back and were converted
+    # to the router's wall clock
+    worker_evs = [e for e in tr["events"] if e.get("src") == fr.replica]
+    worker_names = {e["name"] for e in worker_evs}
+    assert "prefill" in worker_names and "decode" in worker_names
+    ts = [e["t"] for e in tr["events"]]
+    assert ts == sorted(ts)
+    # worker span times land inside the router's attempt window (clock
+    # handshake sane): within a generous skew bound
+    assert all(abs(e["t"] - att["t"]) < 60.0 for e in worker_evs)
+    assert fleet.trace("nope") is None
+    assert len(fleet.fleet_traces()) == 4
+
+
+def test_fleet_trace_disabled_telemetry_records_nothing(net):
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a")],
+                        affinity_blocks=0)
+    fr = fleet.submit(np.arange(1, 5, dtype=np.int32), 4)
+    fleet.run(timeout_s=120)
+    assert fr.status == "ok"
+    assert fr.attempt_log == []
+    assert fleet.trace(fr) is None
+    assert fleet.fleet_traces() == []
+
+
+def test_fleet_chrome_trace_export_pids(net, tmp_path):
+    """export_chrome_trace renders fleet timelines with one pid for
+    the router and one per replica."""
+    import json as _json
+
+    telemetry.enable()
+    fleet = FleetRouter([LocalReplica(_mk_server(net), name="a"),
+                         LocalReplica(_mk_server(net), name="b")],
+                        affinity_blocks=0)
+    reqs = _mixed(fleet, np.random.RandomState(9), 4)
+    fleet.run(timeout_s=120)
+    p = tmp_path / "fleet_trace.json"
+    telemetry.export_chrome_trace(str(p))
+    evs = _json.loads(p.read_text())["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert telemetry.ROUTER_PID in pids
+    assert {telemetry.REPLICA_PID_BASE,
+            telemetry.REPLICA_PID_BASE + 1} & pids
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "fleet: router" in procs
+    assert {"fleet: replica a", "fleet: replica b"} & procs
+    # per-request tids carry the request id
+    tids = [e for e in evs if e["ph"] == "M"
+            and e["name"] == "thread_name"
+            and e["pid"] == telemetry.ROUTER_PID]
+    assert tids, "router pid needs thread_name metadata"
+
+
+def test_fleet_registry_bucket_exact_merge():
+    """The router's /metrics body merges per-replica heartbeat
+    snapshots exactly: counters sum, histogram buckets add bucket-wise,
+    gauges split under replica labels."""
+    import json as _json
+
+    telemetry.enable()
+    h = telemetry.histogram("serving_ttft_seconds").labels()
+    for v in (0.1, 0.3):
+        h.observe(v)
+    telemetry.inc("serving_requests_total", status="ok")
+    telemetry.set_gauge("serving_active_slots", 1)
+    w0 = _json.loads(_json.dumps(telemetry._registry_state()))
+    telemetry.reset()
+    h = telemetry.histogram("serving_ttft_seconds").labels()
+    for v in (0.2, 4.0, 0.0):
+        h.observe(v)
+    telemetry.inc("serving_requests_total", status="ok")
+    telemetry.inc("serving_requests_total", status="timed_out")
+    telemetry.set_gauge("serving_active_slots", 3)
+    w1 = _json.loads(_json.dumps(telemetry._registry_state()))
+    telemetry.reset()
+
+    telemetry.inc("serve_requests_total", status="ok")  # router's own
+    fleet = _fleet([FakeReplica("r0"), FakeReplica("r1")])
+    fleet._reps[0].tm_state = w0
+    fleet._reps[1].tm_state = w1
+    merged = fleet.fleet_registry()
+
+    hist = merged["serving_ttft_seconds"].children[()]
+    assert hist.count == 5 and hist.zeros == 1
+    assert hist.sum == pytest.approx(0.1 + 0.3 + 0.2 + 4.0)
+    assert hist.min == 0.0 and hist.max == 4.0
+    # bucket-exact: merged buckets equal the per-worker bucket sums
+    import math
+
+    def bucket(v):
+        m, e = math.frexp(v)
+        return e - 1 if m == 0.5 else e
+
+    for v in (0.1, 0.3, 0.2, 4.0):
+        assert hist.buckets.get(bucket(v), 0) >= 1
+    assert sum(hist.buckets.values()) == 4
+
+    counters = merged["serving_requests_total"].children
+    assert counters[(("status", "ok"),)].value == 2.0
+    assert counters[(("status", "timed_out"),)].value == 1.0
+    gauges = merged["serving_active_slots"].children
+    assert gauges[(("replica", "r0"),)].value == 1.0
+    assert gauges[(("replica", "r1"),)].value == 3.0
+
+    body = fleet.fleet_prometheus()
+    assert "serving_active_slots{replica=r0} 1" in body
+    assert "serve_requests_total{status=ok} 1" in body
+
+
+def test_collect_flight_bundle_and_merge_cli(net, tmp_path):
+    """The router commands a worker (thread, FileKV) to dump its flight
+    ring, writes the bundle directory, and the merge CLI stitches the
+    dumps into one ordered timeline."""
+    import json as _json
+
+    from mxnet_tpu import flight
+
+    flight.enable()
+    flight.clear()
+    kv = FileKV(str(tmp_path))
+    t = threading.Thread(
+        target=run_fleet_worker, args=(kv, "w0"),
+        kwargs=dict(server=_mk_server(net), hb_interval_s=0.02,
+                    max_wall_s=120.0),
+        daemon=True)
+    t.start()
+    bundle_dir = str(tmp_path / "bundle")
+    try:
+        fleet = FleetRouter([ProcReplica(kv, "w0")],
+                            heartbeat_timeout_s=60.0,
+                            affinity_blocks=0)
+        fr = fleet.submit(np.arange(1, 5, dtype=np.int32), 4)
+        fleet.run(timeout_s=120)
+        assert fr.status == "ok"
+        flight.record("test", "bundle.unit", marker=1)
+        out = fleet.collect_flight_bundle("unit-test", path=bundle_dir,
+                                          timeout_s=10.0)
+        assert out == bundle_dir == fleet.last_bundle_path
+        manifest = _json.loads(
+            (tmp_path / "bundle" / "manifest.json").read_text())
+        assert manifest["missing"] == []
+        assert "w0.jsonl" in manifest["sources"]
+        assert any(s.startswith("router-p") for s in manifest["sources"])
+        fleet.stop_fleet(timeout_ms=30_000)
+    finally:
+        t.join(timeout=30)
+        flight.disable()
+        flight.clear()
+
+    merged = flight.main(["merge", bundle_dir])
+    assert merged == 0
+    lines = [ln for ln in
+             (tmp_path / "bundle" / "merged.jsonl").read_text()
+             .splitlines() if ln.strip()]
+    head = _json.loads(lines[0])
+    assert head["flight_merge"] == 1 and len(head["sources"]) == 2
+    ts = [_json.loads(ln)["t_unix"] for ln in lines[1:]]
+    assert len(ts) == head["events"] > 0
+    assert ts == sorted(ts)
+    srcs = {_json.loads(ln)["src"] for ln in lines[1:]}
+    assert {"w0"} <= srcs
+    # re-merge is idempotent: merged.jsonl is skipped on a dir rescan
+    flight.merge([bundle_dir])
+    lines2 = [ln for ln in
+              (tmp_path / "bundle" / "merged.jsonl").read_text()
+              .splitlines() if ln.strip()]
+    assert len(lines2) == len(lines)
+
+
+def test_fleet_subprocess_failover_trace_and_metrics(net, tmp_path):
+    """The acceptance scenario end to end: a 2-subprocess fleet over
+    FileKV, telemetry + flight + tracing enabled in the workers, w0
+    SIGKILLed mid-decode by `replica.kill`. The failed-over request
+    yields ONE merged timeline carrying both attempts (distinct
+    replicas, outcomes) plus the winner's prefill/decode spans; the
+    chrome export renders per-replica pids; the router's merged fleet
+    /metrics view matches the per-worker snapshots bucket-exactly."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path)
+    kv = FileKV(d)
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_TPU_FAULTS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MXNET_TPU_TELEMETRY"] = "1"
+        env["MXNET_TPU_FLIGHT"] = "1"
+        env["MXNET_TPU_FLIGHT_DIR"] = d    # fault dumps stay in tmp
+        if i == 0:
+            env["MXNET_TPU_FAULTS"] = "replica.kill:at=4"
+        log = open(os.path.join(d, f"w{i}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", "-m", "mxnet_tpu.serving.router",
+             "--dir", d, "--name", f"w{i}", "--model", "llama_tiny",
+             "--max-prompt", "12", "--max-wall-s", "240"],
+            stdout=log, stderr=log, env=env, cwd=repo))
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 180:
+            if all(kv.get(f"fleet/w{i}/hb") is not None
+                   for i in range(2)):
+                break
+            for i, p in enumerate(procs):
+                assert p.poll() is None, (
+                    f"worker w{i} died during warmup rc={p.returncode}"
+                    f" — see {d}/w{i}.log")
+            time.sleep(0.05)
+        else:
+            pytest.fail("fleet workers never became healthy")
+
+        telemetry.enable()
+        fleet = FleetRouter([ProcReplica(kv, "w0"),
+                             ProcReplica(kv, "w1")],
+                            affinity_blocks=0, backoff_base_s=0.01,
+                            heartbeat_timeout_s=1.0,
+                            hedge_after_s=1.5)
+        rs = np.random.RandomState(11)
+        reqs = _mixed(fleet, rs, 6)
+        fleet.run(timeout_s=200)
+
+        assert all(fr.status == "ok" for _, _, fr in reqs)
+        assert fleet.n_failovers >= 1, fleet.stats()
+        rescued = [fr for _, _, fr in reqs
+                   if len(fr.attempt_log) >= 2
+                   and len({a["replica"] for a in fr.attempt_log}) == 2]
+        assert rescued, "no request failed over between replicas"
+        fr = rescued[0]
+        tr = fleet.trace(fr.id)
+        assert tr["tries"] >= 2
+        atts = tr["attempts"]
+        assert len({a["replica"] for a in atts}) == 2
+        assert atts[-1]["outcome"] == "won"
+        assert any(a["outcome"] in ("failover", "timeout", "lost_hedge")
+                   for a in atts[:-1])
+        winner = atts[-1]["replica"]
+        worker_names = {e["name"] for e in tr["events"]
+                        if e.get("src") == winner}
+        assert "prefill" in worker_names and "decode" in worker_names
+        ts = [e["t"] for e in tr["events"]]
+        assert ts == sorted(ts)
+
+        # chrome export: router + per-replica pids in one file
+        p = tmp_path / "trace.json"
+        telemetry.export_chrome_trace(str(p))
+        evs = _json.loads(p.read_text())["traceEvents"]
+        pids = {e["pid"] for e in evs}
+        assert telemetry.ROUTER_PID in pids
+        assert telemetry.REPLICA_PID_BASE in pids
+
+        # fleet /metrics: merged view == per-worker snapshots (w0 is
+        # dead by now, so the live blobs are w1 + the router's own)
+        blobs = {rep.name: dict(rep.tm_state) for rep in fleet._reps
+                 if rep.tm_state}
+        assert blobs, "no heartbeat-shipped registry snapshots"
+        merged = fleet.fleet_registry()
+        fam = merged.get("serving_requests_total")
+        assert fam is not None
+        merged_ok = sum(ch.value for key, ch in fam.children.items()
+                        if ("status", "ok") in key)
+        expect_ok = sum(
+            float(st)
+            for blob in blobs.values()
+            for key, st in blob.get("serving_requests_total",
+                                    {}).get("c", [])
+            if [list(k) for k in key] == [["status", "ok"]])
+        assert merged_ok == expect_ok > 0
+        hist = merged.get("serving_ttft_seconds")
+        assert hist is not None
+        merged_count = sum(ch.count for ch in hist.children.values())
+        expect_count = sum(
+            st.get("c", 0)
+            for blob in blobs.values()
+            for _key, st in blob.get("serving_ttft_seconds",
+                                     {}).get("c", []))
+        assert merged_count == expect_count > 0
+        body = fleet.fleet_prometheus()
+        assert "replica=w1" in body
+
+        final = fleet.stop_fleet(timeout_ms=30_000)
+        assert final["w1"] is not None
+        rcs = []
+        for p_ in procs:
+            try:
+                rcs.append(p_.wait(timeout=60))
+            except Exception:
+                p_.kill()
+                rcs.append(p_.wait(timeout=30))
+        assert rcs[0] == -9, "w0 must die by SIGKILL mid-run"
+    finally:
+        for p_ in procs:
+            if p_.poll() is None:
+                p_.kill()
+                p_.wait(timeout=30)

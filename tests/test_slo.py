@@ -1,0 +1,238 @@
+"""SLO engine (mxnet_tpu/slo.py): objective sampling against the live
+registry (exact log2-bucket arithmetic, status-labeled availability),
+Google-SRE multi-window burn-rate gating, alert edges + callbacks, the
+published slo_* gauges, and the health-source protocol the /healthz
+endpoint consumes. All ticks are driven with an explicit `now` — no
+wall-clock dependence."""
+import pytest
+
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.slo import Objective, SLOEngine, default_objectives
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    tm.disable()
+    tm.reset()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+def _engine(objectives, **kw):
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    kw.setdefault("burn_threshold", 1.0)
+    kw.setdefault("tick_interval_s", 0.0)
+    return SLOEngine(objectives, **kw)
+
+
+# -- objective declaration ---------------------------------------------------
+
+def test_threshold_snaps_up_to_log2_bucket():
+    o = Objective("a", metric="h", target=0.95, threshold_s=0.6)
+    assert o.effective_threshold == 1.0
+    o = Objective("b", metric="h", target=0.95, threshold_s=0.5)
+    assert o.effective_threshold == 0.5    # exact power: own bucket
+    o = Objective("c", metric="h", target=0.95, threshold_s=0.3)
+    assert o.effective_threshold == 0.5
+
+
+def test_objective_validates_inputs():
+    with pytest.raises(ValueError):
+        Objective("a", metric="h", target=1.0)
+    with pytest.raises(ValueError):
+        Objective("a", metric="h", target=0.9, threshold_s=0.0)
+    with pytest.raises(ValueError):
+        SLOEngine([], fast_window_s=60.0, slow_window_s=60.0)
+
+
+def test_latency_sample_exact_bucket_counts():
+    tm.enable()
+    h = tm.histogram("ttft_s").labels()
+    for v in (0.1, 0.4, 0.5, 0.9, 2.0):   # 3 <= 0.5s, 2 above
+        h.observe(v)
+    h.observe(0.0)                          # zeros count as good
+    o = Objective("ttft", metric="ttft_s", target=0.9, threshold_s=0.5)
+    good, total = o.sample(tm._REGISTRY)
+    assert (good, total) == (4.0, 6.0)
+    # unknown family: no traffic, not an error
+    o2 = Objective("x", metric="nope", target=0.9, threshold_s=0.5)
+    assert o2.sample(tm._REGISTRY) == (0.0, 0.0)
+
+
+def test_availability_sample_status_labels_only():
+    tm.enable()
+    for _ in range(8):
+        tm.inc("req_total", status="ok")
+    tm.inc("req_total", status="failed")
+    tm.inc("req_total", status="cancelled")  # client's choice: ignored
+    tm.inc("req_total")                      # unlabeled: ignored
+    o = Objective("avail", metric="req_total", target=0.99)
+    good, total = o.sample(tm._REGISTRY)
+    assert (good, total) == (8.0, 9.0)
+
+
+def test_default_objectives_shape():
+    objs = default_objectives(availability_metric="serve_requests_total")
+    assert [o.name for o in objs] == ["ttft_p95_s", "tpot_p95_s",
+                                      "availability"]
+    assert objs[2].metric == "serve_requests_total"
+    assert objs[2].threshold_s is None
+
+
+# -- burn-rate evaluation ----------------------------------------------------
+
+def _observe(n_good, n_bad):
+    h = tm.histogram("lat_s").labels()
+    for _ in range(n_good):
+        h.observe(0.1)
+    for _ in range(n_bad):
+        h.observe(4.0)
+
+
+def test_multi_window_gating_blip_does_not_fire():
+    """A bad burst that saturates the fast window must NOT fire while
+    the slow window still holds enough good traffic — the whole point
+    of the two-window policy."""
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj])
+    assert eng.tick(now=0.0) == []          # empty baseline sample
+    _observe(100, 0)
+    assert eng.tick(now=5.0) == []
+    _observe(0, 10)                          # blip: all-bad burst
+    assert eng.tick(now=50.0) == []          # fast burns, slow doesn't
+    st = eng._state["lat"]
+    assert st.burn_fast > eng.burn_threshold
+    assert st.burn_slow < eng.burn_threshold
+    # sustained badness pushes the slow window over too -> fires
+    _observe(0, 30)
+    assert eng.tick(now=55.0) == ["lat"]
+    assert eng.alerts_total == 1
+
+
+def test_alert_edges_fire_once_and_clear():
+    tm.enable()
+    alerts, clears = [], []
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj], on_alert=lambda n, info: alerts.append(info),
+                  on_clear=clears.append)
+    eng.tick(now=0.0)
+    _observe(0, 50)
+    eng.tick(now=5.0)
+    assert [a["objective"] for a in alerts] == ["lat"]
+    assert alerts[0]["burn_rate_fast"] > 1.0
+    _observe(0, 10)
+    eng.tick(now=6.0)                        # still firing: no re-alert
+    assert len(alerts) == 1 and eng.alerts_total == 1
+    # good traffic washes both windows clean once the bad samples age
+    # past the window base
+    _observe(500, 0)
+    eng.tick(now=20.0)
+    _observe(500, 0)
+    assert eng.tick(now=120.0) == []
+    assert clears == ["lat"]
+
+
+def test_no_traffic_means_no_burn():
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj])
+    for t in (0.0, 5.0, 10.0):
+        assert eng.tick(now=t) == []
+    st = eng._state["lat"]
+    assert st.burn_fast == 0.0 and st.burn_slow == 0.0
+
+
+def test_tick_publishes_slo_gauges():
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj])
+    eng.tick(now=0.0)
+    _observe(0, 20)
+    eng.tick(now=5.0)
+    assert tm.read_gauge("slo_burn_rate", objective="lat",
+                         window="fast") > 1.0
+    assert tm.read_gauge("slo_burn_rate", objective="lat",
+                         window="slow") > 1.0
+    assert tm.read_gauge("slo_alert_firing", objective="lat") == 1.0
+    assert tm.read_gauge("slo_error_budget_remaining",
+                         objective="lat") == 0.0
+
+
+def test_error_budget_remaining_partial():
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.5, threshold_s=1.0)
+    eng = _engine([obj])
+    eng.tick(now=0.0)
+    _observe(90, 10)                         # bad_frac 0.1, budget 0.5
+    eng.tick(now=5.0)
+    rem = tm.read_gauge("slo_error_budget_remaining", objective="lat")
+    assert rem == pytest.approx(1.0 - 0.1 / 0.5)
+
+
+def test_tick_interval_throttles_but_reports_firing():
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj], tick_interval_s=1.0)
+    eng.tick(now=0.0)
+    _observe(0, 50)
+    assert eng.tick(now=2.0) == ["lat"]
+    n_samples = len(eng._state["lat"].samples)
+    # inside the throttle window: no new sample, still reports firing
+    assert eng.tick(now=2.5) == ["lat"]
+    assert len(eng._state["lat"].samples) == n_samples
+
+
+def test_disabled_telemetry_keeps_engine_inert():
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj])
+    assert eng.tick(now=0.0) is None
+    assert eng._state["lat"].samples == []
+
+
+def test_health_names_violated_objective():
+    tm.enable()
+    obj = Objective("ttft_p95", metric="lat_s", target=0.9,
+                    threshold_s=1.0)
+    eng = _engine([obj])
+    assert eng.health() == (True, "ok")
+    eng.tick(now=0.0)
+    _observe(0, 50)
+    eng.tick(now=5.0)
+    ok, reason = eng.health()
+    assert not ok and "ttft_p95" in reason and "burn" in reason
+    detail = eng.health_detail()
+    assert detail["kind"] == "slo" and not detail["ok"]
+    assert detail["objectives"][0]["firing"]
+
+
+def test_healthz_endpoint_flips_on_firing_alert():
+    """End to end through telemetry's health aggregation: a firing
+    engine registered as a health source turns overall health not-ok
+    with the objective named in the reason."""
+    tm.enable()
+    obj = Objective("ttft_p95", metric="lat_s", target=0.9,
+                    threshold_s=1.0)
+    eng = _engine([obj])
+    tm.register_health_source(eng)
+    try:
+        ok, _ = tm.health()
+        assert ok
+        eng.tick(now=0.0)
+        _observe(0, 50)
+        eng.tick(now=5.0)
+        ok, reason = tm.health()
+        assert not ok and "ttft_p95" in reason
+    finally:
+        tm.unregister_health_source(eng)
+
+
+def test_sample_history_pruned():
+    tm.enable()
+    obj = Objective("lat", metric="lat_s", target=0.9, threshold_s=1.0)
+    eng = _engine([obj], fast_window_s=1.0, slow_window_s=10.0)
+    for i in range(200):
+        eng.tick(now=float(i))
+    assert len(eng._state["lat"].samples) < 40

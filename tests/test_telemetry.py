@@ -762,3 +762,134 @@ def test_healthz_endpoint_503(monkeypatch):
     finally:
         tm.stop_metrics_server()
         tm.unregister_health_source(stub)
+
+
+# -- fleet observability primitives (ISSUE 14) -------------------------------
+
+def test_read_gauge_and_remove_series():
+    tm.enable()
+    tm.set_gauge("router_replica_health", 0, replica="w0")
+    tm.set_gauge("router_replica_health", 2, replica="w1")
+    assert tm.read_gauge("router_replica_health", replica="w0") == 0.0
+    assert tm.read_gauge("router_replica_health", replica="w1") == 2.0
+    # absent child / family / wrong kind -> default, never created
+    assert tm.read_gauge("router_replica_health", replica="nope") is None
+    assert tm.read_gauge("no_such_gauge", default=-1.0) == -1.0
+    tm.inc("a_counter")
+    assert tm.read_gauge("a_counter", default="x") == "x"
+    fam = tm._REGISTRY["router_replica_health"]
+    assert len(fam.children) == 2   # read_gauge created nothing
+
+    assert tm.remove_series("router_replica_health", replica="w0")
+    assert not tm.remove_series("router_replica_health", replica="w0")
+    assert not tm.remove_series("no_such_gauge", replica="w0")
+    assert tm.read_gauge("router_replica_health", replica="w0") is None
+    assert tm.read_gauge("router_replica_health", replica="w1") == 2.0
+    # the family survives for the remaining children
+    assert "router_replica_health{replica=w1}" \
+        in tm.snapshot()["gauges"]
+
+
+def test_registry_delta_encodes_changes_and_tombstones():
+    tm.enable()
+    tm.inc("steps_total", 3)
+    tm.set_gauge("queue_depth", 7)
+    delta, acked = tm.registry_delta(None)
+    assert set(delta) == {"steps_total", "queue_depth"}
+    assert delta == {k: acked[k] for k in delta}
+    # no change: empty delta, acked unchanged
+    d2, a2 = tm.registry_delta(acked)
+    assert d2 == {} and a2 == acked
+    # one family changes: only it ships
+    tm.inc("steps_total")
+    d3, a3 = tm.registry_delta(a2)
+    assert set(d3) == {"steps_total"}
+    # reset: vanished families ship as None tombstones
+    tm.reset()
+    d4, a4 = tm.registry_delta(a3)
+    assert d4 == {"steps_total": None, "queue_depth": None}
+    assert a4 == {}
+
+
+def test_registry_delta_defers_over_budget_families():
+    tm.enable()
+    tm.inc("tiny_total")
+    h = tm.histogram("big_histogram").labels()
+    for i in range(64):
+        h.observe(2.0 ** (i % 40))
+    small = len(json.dumps({"tiny_total": tm._registry_state()
+                            ["tiny_total"]}))
+    delta, acked = tm.registry_delta(None, max_bytes=small + 4)
+    # the first family always ships; the big one is deferred, stays
+    # un-acked, and arrives on the next (unbounded) beat
+    assert len(delta) >= 1
+    deferred = {"tiny_total", "big_histogram"} - set(delta)
+    assert deferred and not (deferred & set(acked))
+    d2, a2 = tm.registry_delta(acked)
+    assert deferred <= set(d2)
+    assert set(a2) == {"tiny_total", "big_histogram"}
+    # absolute states: re-applying the same delta is idempotent
+    merged1 = tm._merge_registry({0: dict(a2)})
+    merged2 = tm._merge_registry({0: dict(a2)})
+    for name in ("tiny_total", "big_histogram"):
+        c1 = list(merged1[name].children.values())[0]
+        c2 = list(merged2[name].children.values())[0]
+        if name == "tiny_total":
+            assert c1.value == c2.value == 1.0
+        else:
+            assert c1.count == c2.count == 64
+
+
+def test_merge_registry_replica_label():
+    tm.enable()
+    tm.set_gauge("serving_active_slots", 3)
+    state = json.loads(json.dumps(tm._registry_state()))
+    merged = tm._merge_registry({"w0": state, "w1": state},
+                                label="replica")
+    fam = merged["serving_active_slots"]
+    keys = set(fam.children)
+    assert (("replica", "w0"),) in keys
+    assert (("replica", "w1"),) in keys
+
+
+def test_export_chrome_trace_deterministic_bytes(tmp_path):
+    """Same recorded spans -> byte-identical JSON, including a fleet
+    trace source: the chrome-trace diffing workflow (and the repo's
+    own merge-determinism tests) depend on it."""
+
+    class _Src:
+        def fleet_traces(self):
+            return [{"request_id": 7, "events": [
+                {"name": "queued", "t": 10.0, "src": "router",
+                 "dur_s": 0.5},
+                {"name": "attempt 0", "t": 10.5, "src": "router",
+                 "dur_s": 1.0, "replica": "w0", "outcome": "won"},
+                {"name": "prefill", "t": 10.6, "src": "w0",
+                 "dur_s": 0.2},
+                {"name": "decode", "t": 10.8, "src": "w0",
+                 "dur_s": 0.7}]}]
+
+    tm.enable()
+    src = _Src()
+    tm.register_fleet_trace_source(src)
+    tm.mark_phase("forward", 0.001, t0=1.0)
+    tm.mark_phase("backward", 0.002, t0=1.001)
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    tm.export_chrome_trace(str(p1))
+    tm.export_chrome_trace(str(p2))
+    b1, b2 = p1.read_bytes(), p2.read_bytes()
+    assert b1 == b2
+    evs = json.loads(b1)["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert {tm.HOST_PID, tm.ROUTER_PID, tm.REPLICA_PID_BASE} <= pids
+    procs = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"fleet: router", "fleet: replica w0"} <= procs
+    # spans are ordered deterministically: metadata first, then by
+    # (pid, ts) -- a second export after re-registering in a different
+    # order still matches
+    tm._FLEET_TRACE_SOURCES.clear()
+    tm.register_fleet_trace_source(src)
+    p3 = tmp_path / "c.json"
+    tm.export_chrome_trace(str(p3))
+    assert p3.read_bytes() == b1

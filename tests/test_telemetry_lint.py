@@ -212,6 +212,17 @@ def test_router_module_is_scanned_and_clean():
     assert _violations(path) == []
 
 
+def test_slo_module_is_scanned_and_clean():
+    """The SLO engine publishes burn-rate/budget gauges on every tick —
+    it must ride the same cost contract (early-return guards on
+    `_tm._ENABLED`), stay inside the lint's walk, and be free of
+    ungated sites. Same for the fleet trace-propagation paths in the
+    router (covered by test_router_module_is_scanned_and_clean)."""
+    path = os.path.join(PKG, "slo.py")
+    assert path in _module_files(), "slo.py missing from lint walk"
+    assert _violations(path) == []
+
+
 def test_speculative_module_is_scanned_and_clean():
     """Draft proposers run on the host inside the decode tick; the
     module must stay telemetry-free (accept-rate accounting lives in

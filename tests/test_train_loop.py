@@ -571,3 +571,83 @@ def test_trainloop_publishes_step_time():
     finally:
         tm.disable()
         tm.reset()
+
+
+# -- auto-K from the dispatch-overhead gauge (ISSUE 14) ----------------------
+
+def test_auto_k_sizes_window_from_overhead_gauge():
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu import train_loop as tl
+    tm.disable()
+    tm.reset()
+    tm.enable()
+    try:
+        tm.set_gauge("train_dispatch_overhead_ms_per_step", 0.35)
+        assert tl._auto_k() == 4            # ceil(0.35 / 0.1)
+        tm.set_gauge("train_dispatch_overhead_ms_per_step", 0.1)
+        assert tl._auto_k() == 1
+        tm.set_gauge("train_dispatch_overhead_ms_per_step", 1e6)
+        assert tl._auto_k() == tl.AUTO_K_MAX
+        step = FusedTrainStep(_toy_net(),
+                              mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mx.optimizer.SGD(learning_rate=0.1))
+        tm.set_gauge("train_dispatch_overhead_ms_per_step", 0.35)
+        loop = mx.TrainLoop(step, k="auto")
+        assert loop.k == 4
+        assert loop.run(_loop_data(8)) == 8
+        assert tm.snapshot()["gauges"]["train_loop_k"] == 4.0
+    finally:
+        tm.disable()
+        tm.reset()
+
+
+def test_auto_k_without_gauge_warns_once_and_defaults():
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu import train_loop as tl
+    tm.disable()
+    tm.reset()
+    tl._AUTO_K_WARNED = False
+    try:
+        with pytest.warns(RuntimeWarning, match="no train_dispatch"):
+            assert tl._auto_k() == tl.AUTO_K_DEFAULT
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second call: silent
+            assert tl._auto_k() == tl.AUTO_K_DEFAULT
+    finally:
+        tl._AUTO_K_WARNED = False
+        tm.reset()
+
+
+def test_trainloop_rejects_bad_k():
+    step = FusedTrainStep(_toy_net(),
+                          mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.SGD(learning_rate=0.1))
+    with pytest.raises(ValueError, match="k must be"):
+        mx.TrainLoop(step, k=0)
+    with pytest.raises(ValueError, match="k must be"):
+        mx.TrainLoop(step, k="turbo")
+
+
+def test_fused_step_publishes_dispatch_overhead_gauge():
+    """The gauge auto-K feeds on: every timed FusedTrainStep dispatch
+    refreshes train_dispatch_overhead_ms_per_step (host-side prep +
+    async dispatch, NOT device compute)."""
+    from mxnet_tpu import telemetry as tm
+    tm.disable()
+    tm.reset()
+    tm.enable()
+    try:
+        step = FusedTrainStep(_toy_net(),
+                              mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mx.optimizer.SGD(learning_rate=0.1))
+        for xb, yb in _batches(2, seed=3):
+            step(xb, yb)
+        g = tm.snapshot()["gauges"]
+        assert g["train_dispatch_overhead_ms_per_step"] > 0.0
+        # the K-window path refreshes it too (per-step amortized)
+        step.run_steps(_batches(4, seed=4))
+        g2 = tm.snapshot()["gauges"]
+        assert g2["train_dispatch_overhead_ms_per_step"] > 0.0
+    finally:
+        tm.disable()
+        tm.reset()
