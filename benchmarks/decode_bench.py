@@ -884,6 +884,134 @@ def paged_kernel_phase(on_tpu, guard):
     telemetry.reset()
 
 
+def oom_forecast_phase(on_tpu, guard, seed=0):
+    """--oom-forecast: memory-pressure steering end to end. Two
+    in-process replicas behind FleetRouter — r0 with a deliberately
+    tight KV pool (and a slow background decode whose block burn feeds
+    its PoolForecaster a declining free-blocks trend), r1 roomy but
+    more loaded (so least-loaded routing would pack r0). The same long
+    prompts run twice:
+
+    - control leg (`exhaust_window_s=None`): the router packs r0, whose
+      pool exhausts mid-decode — preemptions land (>0).
+    - forecast leg (`exhaust_window_s` armed): r0's heartbeat carries
+      `exhaust_in_s` from the goodput forecaster, the router diverts
+      the long prompts to r1 BEFORE r0 has to preempt — zero
+      preemptions, diverted counter > 0.
+
+    The headline `value` is control preemptions minus forecast
+    preemptions (positive = the forecaster bought real headroom);
+    `forecast_pass` is the acceptance boolean."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import InferenceServer
+    from mxnet_tpu.serving.router import FleetRouter, LocalReplica
+
+    cfg, net = _build_net(on_tpu, serve=True)
+    slots, block, mpl = 4, 8, 16
+    tight_blocks = 14      # ballast + two long decodes overflow this
+    long_T = 2 * block     # >= long_prompt_blocks * block -> "long"
+    n_long, long_new = 4, 24
+
+    def run_leg(use_forecast):
+        telemetry.enable()
+        telemetry.reset()
+        rs = np.random.RandomState(seed)
+        s0 = InferenceServer(net, batch_slots=slots, max_len=64,
+                             block_size=block, max_prompt_len=mpl,
+                             num_blocks=tight_blocks)
+        # r1's block size exceeds its max_len: every sequence lives in
+        # one block forever, so active decodes never allocate — its
+        # blocks_free trace is FLAT and the forecaster reads "no
+        # exhaustion in sight" even while it carries load. That is the
+        # honest roomy-replica shape; r0 is the one burning blocks.
+        s1 = InferenceServer(net, batch_slots=slots, max_len=128,
+                             block_size=128, max_prompt_len=mpl,
+                             num_blocks=8)
+        for s in (s0, s1):     # warm the executables out of the window
+            s.submit(rs.randint(0, cfg.vocab_size, 4).astype(np.int32),
+                     max_new_tokens=2)
+            s.run()
+
+        def ballast(server, n, max_new):
+            return [server.submit(
+                rs.randint(0, cfg.vocab_size, 4).astype(np.int32),
+                max_new_tokens=max_new) for _ in range(n)]
+
+        # r0: one long slow decode — the declining blocks_free trend
+        # its forecaster projects to exhaustion. r1: two (still active
+        # at dispatch time), so least-loaded routing packs r0 with the
+        # long prompts in the control leg.
+        ball = ballast(s0, 1, 48) + ballast(s1, 2, 100)
+        # r1 steps until its forecaster window (64 samples) holds only
+        # flat post-allocation samples; r0 joins late so its ballast is
+        # still mid-burn (declining trend) when the router first probes.
+        for i in range(68):
+            s1.step()
+            if i >= 44:
+                s0.step()
+        eta0 = s0.health_detail().get("exhaust_in_s")
+        eta1 = s1.health_detail().get("exhaust_in_s")
+        # the window only needs to cover r0's measured time-to-exhaust
+        # (r1 forecasts none) — self-calibrate so CPU tick-speed
+        # variance can't push eta0 past a hard-coded horizon
+        window = None
+        if use_forecast:
+            window = max(30.0, 4.0 * eta0) if eta0 is not None else 30.0
+
+        fleet = FleetRouter(
+            [LocalReplica(s0, name="tight"),
+             LocalReplica(s1, name="roomy")],
+            affinity_blocks=0, block_size=block, backoff_base_s=0.01,
+            exhaust_window_s=window, long_prompt_blocks=2)
+        frs = [fleet.submit(
+            rs.randint(0, cfg.vocab_size, long_T).astype(np.int32),
+            long_new) for _ in range(n_long)]
+        fleet.run(timeout_s=120)
+        s0.run()
+        s1.run()               # drain the ballast decodes
+        snap = telemetry.snapshot()
+        out = {
+            "preemptions": int(snap["counters"].get(
+                "serving_preemptions_total", 0)),
+            "diverted": int(snap["counters"].get(
+                "router_exhaust_diverted_total", 0)),
+            "ok": sum(1 for fr in frs if fr.status == "ok")
+            + sum(1 for r in ball if r.status == "ok"),
+            "eta0_s": round(eta0, 3) if eta0 is not None else None,
+            "eta1_s": round(eta1, 3) if eta1 is not None else None,
+            "window_s": round(window, 3) if window is not None else None,
+        }
+        for s in (s0, s1):
+            telemetry.unregister_health_source(s._forecaster)
+            telemetry.unregister_health_source(s)
+        telemetry.disable()
+        telemetry.reset()
+        return out
+
+    control = run_leg(False)
+    forecast = run_leg(True)
+    forecast_pass = bool(control["preemptions"] > 0
+                         and forecast["preemptions"] == 0
+                         and forecast["diverted"] > 0)
+    guard.best.update({
+        "value": control["preemptions"] - forecast["preemptions"],
+        "phase": "oom_forecast",
+        "tight_blocks": tight_blocks,
+        "long_prompts": n_long,
+        "control_preemptions": control["preemptions"],
+        "forecast_preemptions": forecast["preemptions"],
+        "forecast_diverted": forecast["diverted"],
+        "control_ok": control["ok"],
+        "forecast_ok": forecast["ok"],
+        "control_eta0_s": control["eta0_s"],
+        "forecast_eta0_s": forecast["eta0_s"],
+        "forecast_eta1_s": forecast["eta1_s"],
+        "forecast_window_s": forecast["window_s"],
+        "forecast_pass": forecast_pass,
+    })
+    guard.emit()
+
+
 def main():
     global _guard
     ap = argparse.ArgumentParser()
@@ -901,6 +1029,11 @@ def main():
                     help="resilient-fleet bench: N subprocess replicas "
                          "behind FleetRouter, incl. a kill-one-replica "
                          "leg asserting zero lost requests")
+    ap.add_argument("--oom-forecast", action="store_true",
+                    help="memory-pressure steering bench: router must "
+                         "divert long prompts off a replica forecast "
+                         "to exhaust its KV pool (0 preemptions) vs a "
+                         "control leg without forecasting (>0)")
     ap.add_argument("--slo", action="store_true",
                     help="with --fleet: add SLO legs — a clean leg "
                          "where the burn-rate alert must stay silent "
@@ -914,6 +1047,8 @@ def main():
 
     if args.paged_kernel:
         metric, unit = "paged_decode_bytes_ratio", "x"
+    elif args.oom_forecast:
+        metric, unit = "oom_forecast_preemptions_avoided", "count"
     elif args.mixed:
         metric, unit = "mixed_max_tick_gap_ratio", "x"
     elif args.fleet:
@@ -933,6 +1068,8 @@ def main():
     guard.emit()
     if args.paged_kernel:
         paged_kernel_phase(on_tpu, guard)
+    elif args.oom_forecast:
+        oom_forecast_phase(on_tpu, guard, seed=args.seed)
     elif args.mixed:
         mixed_phase(on_tpu, guard, num_requests=args.requests,
                     seed=args.seed)
@@ -946,6 +1083,23 @@ def main():
                     arrival_rate=args.arrival_rate, seed=args.seed)
     else:
         run_phase(on_tpu, guard)
+
+    # regression-sentinel verdict vs the BENCH_*.json trajectory
+    # (advisory here — `python -m mxnet_tpu.goodput check` gates)
+    from mxnet_tpu import goodput
+    hist_dir = os.environ.get(
+        "BENCH_HISTORY_DIR",
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    metrics = {k: float(v) for k, v in guard.best.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    try:
+        v = goodput.check_against_history(metrics, hist_dir)
+        guard.best["sentinel"] = {"ok": v["ok"], "compared": v["compared"],
+                                  "regressions": v["regressions"][:5]}
+    except Exception as e:  # the sentinel must never sink the bench
+        guard.best["sentinel"] = {"ok": True,
+                                  "error": f"{type(e).__name__}: {e}"[:120]}
+    guard.emit()
 
 
 if __name__ == "__main__":

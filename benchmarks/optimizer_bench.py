@@ -46,7 +46,27 @@ def _mirror_to_telemetry(guard, prefix):
     path = os.environ.get("BENCH_TELEMETRY_JSON",
                           f"/tmp/{prefix}_telemetry.json")
     guard.best["telemetry_json"] = telemetry.dump_json(path)
+    guard.best["sentinel"] = _sentinel_verdict(guard)
     guard.emit()
+
+
+def _sentinel_verdict(guard):
+    """Regression-sentinel verdict for this run's numeric metrics vs
+    the BENCH_*.json trajectory at the repo root (same check the
+    standalone `python -m mxnet_tpu.goodput check` runs). Advisory in
+    the emitted JSON — the sentinel CLI is where it gates."""
+    from mxnet_tpu import goodput
+    hist_dir = os.environ.get(
+        "BENCH_HISTORY_DIR",
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    metrics = {k: float(v) for k, v in guard.best.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    try:
+        v = goodput.check_against_history(metrics, hist_dir)
+    except Exception as e:  # the sentinel must never sink the bench
+        return {"ok": True, "error": f"{type(e).__name__}: {e}"[:120]}
+    return {"ok": v["ok"], "compared": v["compared"],
+            "regressions": v["regressions"][:5]}
 
 
 def _make_trainer(mx, jnp, shapes, multi_tensor, optimizer="sgd",
@@ -368,6 +388,13 @@ _TM_HOT = ("phase", "mark_phase", "step_done", "inc", "set_gauge",
 #: measured A/B gap covers flight recording compiled in but disabled
 _FL_HOT = ("record", "dump")
 
+#: goodput's hot feeders — the fused-step path calls these behind
+#: `_gp._ENABLED` gates; B-side no-ops them (and clears the telemetry/
+#: flight consumption hooks goodput.enable() would install) so the gap
+#: also covers the goodput ledger compiled in but disabled
+_GP_HOT = ("charge_span", "charge_gap", "note_compile", "note_tokens",
+           "note_train_step", "publish")
+
 
 class _NullCtx:
     def __enter__(self):
@@ -396,12 +423,13 @@ def main_telemetry_overhead():
     jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import flight, telemetry
+    from mxnet_tpu import flight, goodput, telemetry
     from mxnet_tpu.parallel.data_parallel import FusedTrainStep
 
     telemetry.disable()
     telemetry.reset()
     flight.disable()
+    goodput.disable()  # enabled-but-idle is what the A side measures
 
     batch = int(os.environ.get("BENCH_TM_BATCH", "64"))
     hidden = int(os.environ.get("BENCH_TM_HIDDEN", "256"))
@@ -434,6 +462,13 @@ def main_telemetry_overhead():
 
     saved = {n: getattr(telemetry, n) for n in _TM_HOT}
     saved_fl = {n: getattr(flight, n) for n in _FL_HOT}
+    saved_gp = {n: getattr(goodput, n) for n in _GP_HOT}
+    # the consumption hooks goodput.enable() installs into telemetry/
+    # flight — cleared on the B side so a mark_phase that slipped past
+    # the no-op patch still cannot reach the ledger
+    saved_gp_hooks = {"tm_note": telemetry._goodput_note,
+                      "tm_section": telemetry._goodput_section,
+                      "fl_note": flight._note_hook}
     null = _NullCtx()
     noops = {
         "phase": lambda name, device=False: null,
@@ -446,6 +481,7 @@ def main_telemetry_overhead():
     }
     fl_noops = {"record": lambda *a, **k: None,
                 "dump": lambda *a, **k: None}
+    gp_noops = {n: (lambda *a, **k: None) for n in _GP_HOT}
 
     # the fleet-observability hooks ride the same cost contract: B-side
     # no-ops the SLO engine tick and the router's trace-propagation
@@ -465,11 +501,16 @@ def main_telemetry_overhead():
     for _ in range(rounds):
         if a_ms and guard.remaining() < 15.0:
             break
-        a_ms.append(timed())  # A: shipped disabled path (tm + flight)
+        a_ms.append(timed())  # A: shipped disabled path (tm+fl+gp)
         for name, fn in noops.items():
             setattr(telemetry, name, fn)
         for name, fn in fl_noops.items():
             setattr(flight, name, fn)
+        for name, fn in gp_noops.items():
+            setattr(goodput, name, fn)
+        telemetry._goodput_note = None
+        telemetry._goodput_section = None
+        flight._note_hook = None
         for (cls, name), fn in hook_noops.items():
             setattr(cls, name, fn)
         try:
@@ -479,6 +520,11 @@ def main_telemetry_overhead():
                 setattr(telemetry, name, fn)
             for name, fn in saved_fl.items():
                 setattr(flight, name, fn)
+            for name, fn in saved_gp.items():
+                setattr(goodput, name, fn)
+            telemetry._goodput_note = saved_gp_hooks["tm_note"]
+            telemetry._goodput_section = saved_gp_hooks["tm_section"]
+            flight._note_hook = saved_gp_hooks["fl_note"]
             for (cls, name), fn in saved_hooks.items():
                 setattr(cls, name, fn)
 

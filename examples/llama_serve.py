@@ -59,6 +59,7 @@ def main():
     step.sync_to_params()
 
     telemetry.enable()
+    mx.goodput.enable()    # wall-clock attribution + tokens/s/chip
     server = mx.serving.InferenceServer(net, batch_slots=4, max_len=64,
                                         block_size=8,
                                         max_prompt_len=16)
@@ -165,6 +166,21 @@ def main():
     telemetry.export_chrome_trace("llama_serve_fleet_trace.json")
     print("chrome trace (router + replica pids): "
           "llama_serve_fleet_trace.json")
+
+    # -- goodput + memory pressure: where did the wall clock go, and
+    # how much KV headroom is left? ------------------------------------
+    mx.goodput.publish()
+    print(mx.goodput.format_summary())
+    tps = telemetry.read_gauge("goodput_serve_tokens_per_sec_per_chip")
+    if tps is not None:
+        print(f"serve throughput: {tps:.1f} tokens/s/chip")
+    for rep in fleet._reps:
+        det = rep.detail or {}   # the same heartbeat the router routes on
+        eta = det.get("exhaust_in_s")
+        print(f"kv pool {rep.name}: {det.get('blocks_free')} blocks "
+              "free, "
+              + (f"exhaustion forecast in {eta:.1f}s"
+                 if eta is not None else "no exhaustion in sight"))
 
 
 if __name__ == "__main__":

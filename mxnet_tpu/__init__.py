@@ -43,6 +43,7 @@ from . import train_loop
 from .train_loop import TrainLoop
 from . import faults
 from . import flight
+from . import goodput
 from . import monitor
 from . import profiler
 from . import slo

@@ -33,6 +33,7 @@ import hashlib
 import json
 import os
 import signal as _signal
+import time
 import warnings
 from typing import Any, Dict, List, Optional
 
@@ -43,6 +44,7 @@ import jax.numpy as jnp
 
 from . import faults as _ft
 from . import flight as _fl
+from . import goodput as _gp
 from . import random as _random
 from . import telemetry as _tm
 
@@ -278,6 +280,7 @@ class Checkpointer:
         ``force_sync=True`` blocks until committed even on an
         async_save checkpointer (the preemption-drain final save)."""
         ocp = self._ocp
+        _t0 = time.perf_counter() if _gp._ENABLED else None
         if self._pending_manifest:
             # previous async save: wait for its commit so the manifest
             # lands before a new save can race the step-dir scan
@@ -300,6 +303,10 @@ class Checkpointer:
         arrays["rng_key"] = _random._st().key
         if extra:
             meta["extra"] = extra
+        if _gp._ENABLED:
+            # the goodput ledger rides the manifest so a SIGKILL
+            # restart charges the dead time instead of losing it
+            meta.setdefault("extra", {})["goodput"] = _gp.state_dict()
         if jax.process_count() > 1:
             # orbax refuses host-local jax arrays on multi-process
             # jobs; ours are replicated-identical (gathered by
@@ -332,6 +339,11 @@ class Checkpointer:
             self._mngr.wait_until_finished()
             self._commit_manifest(int(step), leaves)
             self._apply_truncate(int(step), trunc)
+        if _t0 is not None:
+            # only the synchronous portion is badput: an async save
+            # overlaps the next steps by design
+            _gp.charge_span("checkpoint_save",
+                            time.perf_counter() - _t0)
 
     # -- restore ------------------------------------------------------------
     def restore(self, net=None, trainer=None, fused_step=None,
@@ -350,6 +362,7 @@ class Checkpointer:
         :class:`FileNotFoundError`; pass ``missing_ok=True`` for the
         resume-or-cold-start pattern (returns None)."""
         ocp = self._ocp
+        _t0 = time.perf_counter() if _gp._ENABLED else None
         self.wait()  # drain any in-flight async save + its manifest
         steps = sorted(self._mngr.all_steps())
         if not steps:
@@ -424,6 +437,14 @@ class Checkpointer:
             self._restore_fused(fused_step, arrays, meta)
         elif trainer is not None and "opt" in arrays:
             self._restore_trainer(trainer, arrays, meta)
+        if _t0 is not None:
+            _gp.charge_span("checkpoint_restore",
+                            time.perf_counter() - _t0)
+            st = (meta.get("extra") or {}).get("goodput")
+            if st:
+                # resume the prior run's ledger; the save→restart gap
+                # lands in fault_recovery
+                _gp.restore_state(st)
         return meta
 
     def _restore_trainer(self, trainer, arrays, meta):

@@ -76,6 +76,11 @@ _EVENTS: deque = deque(maxlen=_env_capacity())
 #: post-mortem tooling read this instead of globbing the dump dir
 last_dump_path: Optional[str] = None
 
+#: event hook set EXTERNALLY by mxnet_tpu.goodput.enable() (this
+#: module stays import-free); called as hook(kind, site, payload) for
+#: every recorded event so stalls/crashes become badput
+_note_hook = None
+
 _DUMP_SEQ = 0
 
 
@@ -115,6 +120,8 @@ def record(kind: str, site: str, **payload):
     if not _ENABLED:
         return
     _EVENTS.append((time.monotonic(), kind, site, payload or None))
+    if _note_hook is not None:
+        _note_hook(kind, site, payload)
 
 
 def events() -> List[Tuple[float, str, str, Optional[dict]]]:

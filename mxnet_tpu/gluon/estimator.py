@@ -144,10 +144,16 @@ class TelemetryHandler(EventHandler):
                         + telemetry.breakdown_table())
 
     def train_end(self, estimator):
+        from .. import goodput
         from .. import telemetry
         if telemetry.enabled():
+            # breakdown_table() already carries the goodput category
+            # section when the ledger is on; the summary adds the
+            # headline fraction / MFU / tokens-per-chip lines
             self._print("[telemetry: final]\n"
                         + telemetry.breakdown_table())
+            if goodput._ENABLED:
+                self._print(goodput.format_summary())
 
 
 class CheckpointHandler(EventHandler):

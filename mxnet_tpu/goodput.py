@@ -1,0 +1,819 @@
+"""Goodput ledger, MFU/HFU accounting, and memory-pressure forecasting.
+
+The two questions that decide whether a pod is worth its cost are
+*what fraction of wall clock was productive* and *how close are we to
+the hardware ceiling* (arXiv:1909.09756 ranks pod-scale systems by
+per-chip efficiency; the serving comparisons in arXiv:2605.25645 rank
+by tokens/sec/chip). This module turns the telemetry phase marks and
+flight events the stack already emits into those numbers:
+
+- ``GoodputLedger`` — a wall-clock ledger that attributes EVERY second
+  of the job to ``productive`` or one of the badput categories in
+  :data:`CATEGORIES`. Attribution is *frontier-clipping*: each charged
+  span ``[end - dur, end]`` is clipped to the part after the ledger's
+  frontier (the latest instant already attributed), the gap between the
+  frontier and the span start accrues to ``idle``, and the frontier
+  advances to the span end. Overlapping instrumentation (device vs
+  host timings of the same step, an admit phase that brackets a
+  prefill) therefore never double-counts, and the conservation
+  invariant — categories sum exactly to elapsed wall clock — holds by
+  construction (``tests/test_goodput.py`` fuzzes it).
+- hooks — :func:`enable` installs a phase hook in ``telemetry``
+  (every ``mark_phase`` feeds the ledger), an event hook in ``flight``
+  (serving stalls / crashes become ``stall`` / ``fault_recovery``
+  time), and a compile hook via ``tracing.record_compile_seconds``.
+  Disabled, each hook site costs one attribute load + branch — the
+  same cost contract the telemetry lint enforces.
+- persistence — :func:`state_dict` rides the checkpoint manifest
+  (``Checkpointer.save(extra=...)``) and :func:`restore_state` charges
+  the wall-clock gap between the save and the restarted process's
+  ledger start to ``fault_recovery``, so badput from a SIGKILL restart
+  is charged, not lost.
+- fleet merge — :func:`publish` exports settled ledger seconds as the
+  ``goodput_seconds_total{category=}`` counter. Counters SUM across
+  the registry-delta plane, so the primary's ``/metrics`` serves fleet
+  goodput with no extra wiring, and :class:`mxnet_tpu.slo
+  .GoodputObjective` can burn-rate-alert on efficiency collapse.
+- efficiency — :func:`note_train_step` publishes ``goodput_mfu`` /
+  ``goodput_hfu`` (model / hardware FLOPs per step ÷ step time × chips
+  × per-chip peak from :data:`PEAK_FLOPS_BY_KIND`), with honest source
+  labels: ``analytic`` (6·N·D) vs ``cost_analysis`` flops, and
+  ``device_table`` vs ``nominal`` peak (there is no honest CPU peak).
+  :func:`note_tokens` feeds the comparable headline gauges
+  ``goodput_{train,serve}_tokens_per_sec_per_chip``.
+- memory pressure — :func:`note_hbm_watermark` records per-executable
+  HBM watermarks via ``memory_analysis()`` (``bytes_source`` label
+  says whether the number is measured or an analytic fallback), and
+  :class:`PoolForecaster` fits a rolling line over KV ``blocks_free``
+  to forecast time-to-exhaustion; it registers as a ``/healthz``
+  health source and feeds ``FleetRouter`` admission so a replica
+  forecast to exhaust within its drain window stops taking long-prompt
+  work *before* it preempts.
+- ``python -m mxnet_tpu.goodput check`` — regression sentinel over the
+  ``BENCH_*.json`` trajectory: exits nonzero when the newest record
+  regresses any shared metric by more than ``--tolerance`` (10%
+  default), making the benches CI-enforceable.
+
+Everything here is off by default (``MXNET_TPU_GOODPUT=1`` or
+:func:`enable` opts in) and rides — never replaces — the existing
+telemetry registry.
+"""
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import flight as _fl
+from . import telemetry as _tm
+
+__all__ = [
+    "CATEGORIES",
+    "PEAK_FLOPS_BY_KIND",
+    "GoodputLedger",
+    "PoolForecaster",
+    "enable",
+    "disable",
+    "reset",
+    "ledger",
+    "charge_span",
+    "charge_gap",
+    "note_compile",
+    "note_tokens",
+    "note_train_step",
+    "note_hbm_watermark",
+    "publish",
+    "snapshot",
+    "state_dict",
+    "restore_state",
+    "format_summary",
+    "load_bench_history",
+    "check_metrics",
+    "check_against_history",
+    "main",
+]
+
+#: every second of wall clock lands in exactly one of these
+CATEGORIES = (
+    "productive",
+    "compile",
+    "data_wait",
+    "checkpoint_save",
+    "checkpoint_restore",
+    "fault_recovery",
+    "stall",
+    "dispatch_overhead",
+    "idle",
+)
+
+#: phase-mark name -> ledger category (prefix rules in _category_for)
+_PHASE_CATEGORY = {
+    "data": "data_wait",
+    "serve_admit": "dispatch_overhead",
+    "fused_step": "productive",
+    "fused_step_host": "productive",
+    "fused_loop_host": "productive",
+    "forward": "productive",
+    "backward": "productive",
+    "optimizer": "productive",
+    "grad_comm": "productive",
+    "weight_gather": "productive",
+    "serve_prefill": "productive",
+    "serve_decode": "productive",
+    "checkpoint_save": "checkpoint_save",
+    "checkpoint_restore": "checkpoint_restore",
+}
+
+#: dense bf16 peak FLOPs per chip (public spec numbers); matched by
+#: device_kind prefix, longest match wins
+PEAK_FLOPS_BY_KIND = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+#: there is no honest CPU peak — this keeps the MFU gauge defined on
+#: the 8-way virtual CPU mesh, labelled peak_source="nominal"
+_CPU_NOMINAL_FLOPS = 1e12
+
+
+def _category_for(phase: str) -> Optional[str]:
+    cat = _PHASE_CATEGORY.get(phase)
+    if cat is None and phase.startswith(("pipeline", "stage")):
+        cat = "productive"
+    return cat
+
+
+class GoodputLedger:
+    """Frontier-clipping wall-clock attribution ledger.
+
+    ``charge_span(cat, dur, end)`` clips the span ``[end - dur, end]``
+    to the part after ``_frontier``, charges the frontier→start gap to
+    ``idle``, and advances the frontier — so the invariant
+    ``sum(seconds) == frontier - t0 + base_elapsed`` holds after every
+    charge, and :meth:`snapshot` (which adds the frontier→now gap as
+    pending idle) sums exactly to :meth:`elapsed`.
+    """
+
+    def __init__(self, t0: Optional[float] = None):
+        self.t0 = time.perf_counter() if t0 is None else float(t0)
+        self._frontier = self.t0
+        #: wall-clock anchor for cross-restart gap accounting
+        self._wall0 = time.time()
+        #: elapsed seconds carried over from restored ledgers
+        self._base_elapsed = 0.0
+        self.seconds: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        self._lock = threading.Lock()
+
+    # -- attribution --------------------------------------------------
+    def charge_span(self, category: str, dur_s: float,
+                    end: Optional[float] = None) -> None:
+        if category not in self.seconds:
+            raise KeyError(f"unknown goodput category {category!r}; "
+                           f"one of {CATEGORIES}")
+        now = time.perf_counter() if end is None else float(end)
+        with self._lock:
+            self._charge_locked(category, now - max(0.0, float(dur_s)),
+                                now)
+
+    def charge_gap(self, category: str,
+                   now: Optional[float] = None) -> None:
+        """Attribute everything since the frontier to *category*."""
+        if category not in self.seconds:
+            raise KeyError(f"unknown goodput category {category!r}; "
+                           f"one of {CATEGORIES}")
+        now = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            self._charge_locked(category, self._frontier, now)
+
+    def _charge_locked(self, category: str, start: float,
+                       end: float) -> None:
+        f = self._frontier
+        if end <= f:
+            return  # span entirely inside already-attributed time
+        if start > f:
+            self.seconds["idle"] += start - f
+            f = start
+        self.seconds[category] += end - f
+        self._frontier = end
+
+    # -- readout ------------------------------------------------------
+    def elapsed(self, now: Optional[float] = None) -> float:
+        now = time.perf_counter() if now is None else float(now)
+        return (now - self.t0) + self._base_elapsed
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Categories summing exactly to elapsed (pending frontier→now
+        gap shown as idle, but NOT settled — a still-open phase may yet
+        claim it)."""
+        now = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            secs = dict(self.seconds)
+            secs["idle"] += max(0.0, now - self._frontier)
+        return {"elapsed_s": self.elapsed(now), "seconds": secs}
+
+    def settled(self) -> Tuple[Dict[str, float], float]:
+        """Attributed seconds only (no pending idle) — what the fleet
+        counters export, so a later stall/phase claim never makes the
+        already-published sum overshoot elapsed."""
+        with self._lock:
+            return dict(self.seconds), \
+                (self._frontier - self.t0) + self._base_elapsed
+
+    # -- persistence --------------------------------------------------
+    def state_dict(self) -> dict:
+        snap = self.snapshot()
+        return {"schema": 1, "wall": time.time(),
+                "elapsed_s": snap["elapsed_s"],
+                "seconds": snap["seconds"]}
+
+    def restore_state(self, st: dict) -> None:
+        """Merge a saved ledger; the dead time between the save and
+        THIS process's ledger start is charged to ``fault_recovery``
+        (time since our own start is already live-tracked)."""
+        if not st:
+            return
+        gap = max(0.0, self._wall0 - float(st.get("wall", self._wall0)))
+        with self._lock:
+            for c, v in (st.get("seconds") or {}).items():
+                if c in self.seconds:
+                    self.seconds[c] += float(v)
+            self.seconds["fault_recovery"] += gap
+            self._base_elapsed += float(st.get("elapsed_s", 0.0)) + gap
+
+
+# -- module state (one process-wide ledger, like telemetry's registry)
+_ENABLED = False
+_LEDGER: Optional[GoodputLedger] = None
+_TOKENS: Dict[str, int] = {"train": 0, "serve": 0}
+_MODEL_FLOPS = 0.0
+_HW_FLOPS = 0.0
+_LAST_MFU: Optional[float] = None
+_LAST_HFU: Optional[float] = None
+_PEAK_CACHE: Optional[Tuple[float, str]] = None
+_LAST_PUB: Dict[str, float] = {}
+_PUB_LOCK = threading.Lock()
+
+
+def enable() -> None:
+    """Turn goodput accounting on (idempotent). Rides the telemetry
+    phase marks, so this also enables telemetry."""
+    global _ENABLED, _LEDGER
+    if _ENABLED:
+        return
+    _tm.enable()
+    if _LEDGER is None:
+        _LEDGER = GoodputLedger()
+    _ENABLED = True
+    _tm._goodput_note = _note_phase
+    _tm._goodput_section = _breakdown_section
+    _fl._note_hook = _note_flight
+
+
+def disable() -> None:
+    """Stop accounting and uninstall the hooks (ledger kept for
+    readout; see :func:`reset`)."""
+    global _ENABLED
+    _ENABLED = False
+    _tm._goodput_note = None
+    _tm._goodput_section = None
+    _fl._note_hook = None
+
+
+def reset() -> None:
+    """disable() plus drop all ledger/efficiency state (tests)."""
+    global _LEDGER, _MODEL_FLOPS, _HW_FLOPS, _LAST_MFU, _LAST_HFU, \
+        _PEAK_CACHE
+    disable()
+    _LEDGER = None
+    _TOKENS.clear()
+    _TOKENS.update(train=0, serve=0)
+    _MODEL_FLOPS = 0.0
+    _HW_FLOPS = 0.0
+    _LAST_MFU = None
+    _LAST_HFU = None
+    _PEAK_CACHE = None
+    with _PUB_LOCK:
+        _LAST_PUB.clear()
+
+
+def ledger() -> Optional[GoodputLedger]:
+    return _LEDGER
+
+
+# -- hook targets (installed by enable()) -----------------------------
+def _note_phase(name: str, seconds: float,
+                t0: Optional[float] = None) -> None:
+    """telemetry.mark_phase hook: every phase mark feeds the ledger."""
+    if not _ENABLED or _LEDGER is None:
+        return
+    cat = _category_for(name)
+    if cat is None:
+        return  # unmapped phase: left to the idle remainder
+    end = None if t0 is None else t0 + seconds
+    _LEDGER.charge_span(cat, seconds, end=end)
+
+
+def _note_flight(kind: str, site: str, payload: dict) -> None:
+    """flight.record hook: stall watchdog fires / crashes become
+    badput for the whole unattributed window leading up to them."""
+    if not _ENABLED or _LEDGER is None:
+        return
+    if kind == "stall":
+        _LEDGER.charge_gap("stall")
+    elif kind == "exception":
+        _LEDGER.charge_gap("fault_recovery")
+
+
+# -- gated module-level helpers (the hot API; disabled cost is one
+# attribute load + branch, enforced by tests/test_telemetry_lint.py)
+def charge_span(category: str, dur_s: float,
+                end: Optional[float] = None) -> None:
+    if not _ENABLED or _LEDGER is None:
+        return
+    _LEDGER.charge_span(category, dur_s, end=end)
+
+
+def charge_gap(category: str) -> None:
+    if not _ENABLED or _LEDGER is None:
+        return
+    _LEDGER.charge_gap(category)
+
+
+def note_compile(seconds: float) -> None:
+    """tracing.record_compile_seconds feeds every jit compile here."""
+    if not _ENABLED or _LEDGER is None:
+        return
+    _LEDGER.charge_span("compile", seconds)
+
+
+def note_tokens(kind: str, n: int) -> None:
+    """Accumulate train/serve tokens for the tokens/sec/chip gauges."""
+    if not _ENABLED or n <= 0:
+        return
+    _TOKENS[kind] = _TOKENS.get(kind, 0) + int(n)
+
+
+def _chips() -> int:
+    try:
+        import jax
+        return max(1, jax.local_device_count())
+    except Exception:
+        return 1
+
+
+def _peak_flops() -> Tuple[float, str]:
+    """(per-chip peak FLOPs, source) — ``device_table`` when the
+    device kind is a known TPU, else the ``nominal`` CPU stand-in."""
+    global _PEAK_CACHE
+    if _PEAK_CACHE is None:
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = "cpu"
+        best = None
+        for k, v in PEAK_FLOPS_BY_KIND.items():
+            if kind.lower().startswith(k.lower()):
+                if best is None or len(k) > len(best[0]):
+                    best = (k, v)
+        if best is None:
+            _PEAK_CACHE = (_CPU_NOMINAL_FLOPS, "nominal")
+        else:
+            _PEAK_CACHE = (best[1], "device_table")
+    return _PEAK_CACHE
+
+
+def note_train_step(step_s: float, model_flops: Optional[float] = None,
+                    hw_flops: Optional[float] = None) -> None:
+    """Publish MFU/HFU for one train step.
+
+    ``model_flops`` is the analytic 6·N·D estimate (MFU numerator);
+    ``hw_flops`` is the traced ``cost_analysis()`` count, which
+    includes rematerialization (HFU numerator). Either sticks for
+    subsequent steps once seen.
+    """
+    global _MODEL_FLOPS, _HW_FLOPS, _LAST_MFU, _LAST_HFU
+    if not _ENABLED:
+        return
+    if model_flops:
+        _MODEL_FLOPS = float(model_flops)
+    if hw_flops:
+        _HW_FLOPS = float(hw_flops)
+    if step_s <= 0:
+        return
+    peak, peak_src = _peak_flops()
+    denom = step_s * _chips() * peak
+    if _MODEL_FLOPS > 0:
+        _LAST_MFU = _MODEL_FLOPS / denom
+        _tm.set_gauge("goodput_mfu", _LAST_MFU,
+                      flops_source="analytic", peak_source=peak_src)
+    if _HW_FLOPS > 0:
+        _LAST_HFU = _HW_FLOPS / denom
+        _tm.set_gauge("goodput_hfu", _LAST_HFU,
+                      flops_source="cost_analysis",
+                      peak_source=peak_src)
+
+
+def note_hbm_watermark(name: str, jit_fn, args) -> None:
+    """Per-executable HBM watermark from AOT ``memory_analysis()``.
+
+    *args* is a tree of ``ShapeDtypeStruct`` avals (what the serving
+    ``Program`` already builds for compile-cache tracing). Falls back
+    to the summed aval footprint, honestly labelled
+    ``bytes_source="analytic"`` — same idiom as the paged-kernel
+    bench.
+    """
+    if not _ENABLED:
+        return
+    temp = arg_b = out_b = None
+    total = None
+    source = "analytic"
+    try:
+        mem = jit_fn.lower(*args).compile().memory_analysis()
+        temp = float(getattr(mem, "temp_size_in_bytes", 0) or 0)
+        arg_b = float(getattr(mem, "argument_size_in_bytes", 0) or 0)
+        out_b = float(getattr(mem, "output_size_in_bytes", 0) or 0)
+        alias = float(getattr(mem, "alias_size_in_bytes", 0) or 0)
+        total = temp + arg_b + out_b - alias
+        source = "memory_analysis"
+    except Exception:
+        try:
+            import jax
+            import numpy as np
+            total = 0.0
+            for leaf in jax.tree_util.tree_leaves(args):
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    total += float(np.dtype(leaf.dtype).itemsize *
+                                   np.prod(leaf.shape, dtype=np.int64))
+        except Exception:
+            return
+    _tm.set_gauge("goodput_hbm_bytes", total, program=name,
+                  kind="peak", bytes_source=source)
+    if source == "memory_analysis":
+        for kind, v in (("temp", temp), ("args", arg_b),
+                        ("output", out_b)):
+            _tm.set_gauge("goodput_hbm_bytes", v, program=name,
+                          kind=kind, bytes_source=source)
+
+
+def publish() -> None:
+    """Export the ledger over the fleet metrics plane.
+
+    Settled seconds go out as deltas on the
+    ``goodput_seconds_total{category=}`` counter (counters SUM on
+    registry merge → the primary's /metrics shows fleet goodput), plus
+    the headline fraction and tokens/sec/chip gauges.
+    """
+    if not _ENABLED or _LEDGER is None:
+        return
+    secs, settled_el = _LEDGER.settled()
+    with _PUB_LOCK:
+        for c, v in secs.items():
+            d = v - _LAST_PUB.get(c, 0.0)
+            if d > 0:
+                _tm.inc("goodput_seconds_total", d, category=c)
+                _LAST_PUB[c] = v
+    el = _LEDGER.elapsed()
+    if el <= 0:
+        return
+    _tm.set_gauge("goodput_productive_fraction",
+                  secs["productive"] / el)
+    chips = _chips()
+    for kind in ("train", "serve"):
+        tok = _TOKENS.get(kind, 0)
+        if tok:
+            _tm.set_gauge(f"goodput_{kind}_tokens_per_sec_per_chip",
+                          tok / (el * chips))
+
+
+def snapshot() -> dict:
+    """Ledger snapshot (categories sum exactly to ``elapsed_s``)."""
+    if _LEDGER is None:
+        return {"elapsed_s": 0.0,
+                "seconds": {c: 0.0 for c in CATEGORIES}}
+    return _LEDGER.snapshot()
+
+
+# -- persistence (rides the checkpoint manifest) ----------------------
+def state_dict() -> dict:
+    if _LEDGER is None:
+        return {}
+    st = _LEDGER.state_dict()
+    st["tokens"] = dict(_TOKENS)
+    return st
+
+
+def restore_state(st: dict) -> None:
+    if not _ENABLED or _LEDGER is None or not st:
+        return
+    _LEDGER.restore_state(st)
+    for k, v in (st.get("tokens") or {}).items():
+        _TOKENS[k] = _TOKENS.get(k, 0) + int(v)
+
+
+# -- human-facing summary ---------------------------------------------
+def format_summary() -> str:
+    """Multi-line goodput summary (TrainLoop/Estimator exit print)."""
+    if _LEDGER is None:
+        return "goodput: ledger not enabled"
+    snap = _LEDGER.snapshot()
+    el = snap["elapsed_s"]
+    secs = snap["seconds"]
+    lines = [f"goodput over {el:.1f}s wall clock:"]
+    for c in CATEGORIES:
+        v = secs[c]
+        if v <= 0.0 and c != "productive":
+            continue
+        lines.append(f"  {c:<18s} {v:10.2f}s  "
+                     f"{100.0 * v / max(el, 1e-9):5.1f}%")
+    chips = _chips()
+    if el > 0:
+        for kind in ("train", "serve"):
+            tok = _TOKENS.get(kind, 0)
+            if tok:
+                lines.append(f"  {kind} tokens/sec/chip: "
+                             f"{tok / (el * chips):.1f}")
+    peak, peak_src = _peak_flops()
+    if _LAST_MFU is not None:
+        lines.append(f"  MFU {100.0 * _LAST_MFU:.1f}% "
+                     f"(analytic flops / {peak_src} peak "
+                     f"{peak / 1e12:.0f} TFLOPs/chip)")
+    if _LAST_HFU is not None:
+        lines.append(f"  HFU {100.0 * _LAST_HFU:.1f}% "
+                     f"(cost_analysis flops / {peak_src} peak)")
+    return "\n".join(lines)
+
+
+def _breakdown_section() -> List[str]:
+    """telemetry.breakdown_table() hook: compact goodput lines."""
+    if _LEDGER is None:
+        return []
+    snap = _LEDGER.snapshot()
+    el = max(snap["elapsed_s"], 1e-9)
+    out = []
+    for c in CATEGORIES:
+        v = snap["seconds"][c]
+        if v <= 0.0 and c != "productive":
+            continue
+        out.append((c, v))
+    out.sort(key=lambda cv: -cv[1])
+    return [f"  goodput {c:<18s} {v:9.2f}s {100.0 * v / el:5.1f}%"
+            for c, v in out]
+
+
+class PoolForecaster:
+    """Time-to-exhaustion forecast over a shrinking block pool.
+
+    O(1) ``add(t, blocks_free)`` per tick into a rolling window; a
+    lazy least-squares fit turns the trend into seconds until
+    ``blocks_free`` crosses zero. Registers as a telemetry health
+    source: with ``critical_s`` set, ``/healthz`` flips not-ok when
+    exhaustion is forecast inside that window; the serving
+    ``health_detail`` carries ``exhaust_in_s`` either way so the
+    ``FleetRouter`` can steer long-prompt work off the replica before
+    it preempts.
+    """
+
+    def __init__(self, window: int = 64, min_samples: int = 8,
+                 critical_s: Optional[float] = None,
+                 name: str = "kv_pool"):
+        self.window = int(window)
+        self.min_samples = max(2, int(min_samples))
+        self.critical_s = critical_s
+        self.name = name
+        self._samples = deque(maxlen=self.window)
+
+    def add(self, t: float, blocks_free: float) -> None:
+        self._samples.append((float(t), float(blocks_free)))
+
+    def _fit(self) -> Optional[Tuple[float, float]]:
+        """(slope blocks/s, intercept at the window's first sample)."""
+        n = len(self._samples)
+        if n < self.min_samples:
+            return None
+        t0 = self._samples[0][0]
+        sx = sy = sxx = sxy = 0.0
+        for t, y in self._samples:
+            x = t - t0
+            sx += x
+            sy += y
+            sxx += x * x
+            sxy += x * y
+        denom = n * sxx - sx * sx
+        if denom <= 1e-12:
+            return None
+        slope = (n * sxy - sx * sy) / denom
+        intercept = (sy - slope * sx) / n
+        return slope, intercept
+
+    def exhaust_in_s(self,
+                     now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the pool is forecast empty; None when the
+        trend is flat/recovering or the window is too thin."""
+        fit = self._fit()
+        if fit is None:
+            return None
+        slope, intercept = fit
+        if slope >= -1e-9:
+            return None
+        t0 = self._samples[0][0]
+        now = self._samples[-1][0] if now is None else float(now)
+        free_now = intercept + slope * (now - t0)
+        if free_now <= 0.0:
+            return 0.0
+        return free_now / -slope
+
+    # -- telemetry health-source protocol -----------------------------
+    def health(self) -> Tuple[bool, str]:
+        if self.critical_s is not None:
+            eta = self.exhaust_in_s()
+            if eta is not None and eta < self.critical_s:
+                return False, (f"{self.name} exhaustion forecast in "
+                               f"{eta:.1f}s (< {self.critical_s:.0f}s)")
+        return True, "ok"
+
+    def health_detail(self) -> dict:
+        ok, reason = self.health()
+        fit = self._fit()
+        last = self._samples[-1] if self._samples else (0.0, 0.0)
+        return {"ok": ok, "reason": reason,
+                "samples": len(self._samples),
+                "blocks_free": last[1],
+                "slope_blocks_per_s": fit[0] if fit else None,
+                "exhaust_in_s": self.exhaust_in_s()}
+
+
+# -- bench regression sentinel ----------------------------------------
+#: metric-name suffixes where smaller is the good direction
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_bytes", "_ratio",
+                          "_pct", "_overhead", "_failures", "_errors")
+
+#: throughput-flavoured names where bigger stays the good direction
+#: even when the name ends in a latency-like suffix (`tok_per_s`)
+_HIGHER_BETTER_MARKERS = ("per_s", "per_sec", "throughput", "speedup",
+                          "tok_s", "tokens_s", "mfu", "hfu", "goodput")
+
+
+def _lower_is_better(metric: str) -> bool:
+    m = metric.lower()
+    if any(k in m for k in _HIGHER_BETTER_MARKERS):
+        return False
+    return metric.endswith(_LOWER_BETTER_SUFFIXES)
+
+
+def _metrics_from_record(rec: dict) -> Dict[str, float]:
+    """Pull {metric: value} out of one BENCH record — its ``parsed``
+    dict plus any ``{"metric": ..., "value": ...}`` JSON lines the
+    bench printed into ``tail``."""
+    out: Dict[str, float] = {}
+
+    def _take(d):
+        if isinstance(d, dict) and "metric" in d and "value" in d:
+            try:
+                out[str(d["metric"])] = float(d["value"])
+            except (TypeError, ValueError):
+                pass
+
+    _take(rec.get("parsed"))
+    for line in str(rec.get("tail", "")).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            _take(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def load_bench_history(directory: str = ".") \
+        -> List[Tuple[int, str, Dict[str, float]]]:
+    """BENCH_*.json records as (n, filename, metrics), oldest first."""
+    recs = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    for fn in names:
+        if not (fn.startswith("BENCH") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, fn)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict):
+            recs.append((int(rec.get("n") or 0), fn,
+                         _metrics_from_record(rec)))
+    recs.sort(key=lambda r: (r[0], r[1]))
+    return recs
+
+
+def check_metrics(current: Dict[str, float],
+                  history: Dict[str, List[float]],
+                  tolerance: float = 0.10) -> dict:
+    """Compare a run's metrics against their historical best.
+
+    Direction is inferred from the metric name (latency/size/ratio
+    suffixes → lower is better, else higher). A metric regresses when
+    it is worse than the best historical value by more than
+    *tolerance* (relative).
+    """
+    regressions = []
+    compared = 0
+    for metric in sorted(current):
+        past = history.get(metric) or []
+        if not past:
+            continue
+        compared += 1
+        value = float(current[metric])
+        lower = _lower_is_better(metric)
+        baseline = min(past) if lower else max(past)
+        if baseline == 0:
+            continue
+        delta = (value - baseline) / abs(baseline)
+        regressed = delta > tolerance if lower else delta < -tolerance
+        if regressed:
+            regressions.append({
+                "metric": metric,
+                "value": value,
+                "baseline": baseline,
+                "delta_pct": round(100.0 * delta, 2),
+                "direction": ("lower_is_better" if lower
+                              else "higher_is_better"),
+            })
+    return {"ok": not regressions, "compared": compared,
+            "tolerance": tolerance, "regressions": regressions}
+
+
+def check_against_history(current: Dict[str, float],
+                          directory: str = ".",
+                          tolerance: float = 0.10) -> dict:
+    """Sentinel entry point for the benches: verdict for *current*
+    metrics vs the whole BENCH_*.json trajectory in *directory*."""
+    hist: Dict[str, List[float]] = {}
+    for _n, _fn, metrics in load_bench_history(directory):
+        for m, v in metrics.items():
+            hist.setdefault(m, []).append(v)
+    return check_metrics(dict(current), hist, tolerance)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.goodput",
+        description="goodput tooling (bench regression sentinel)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ck = sub.add_parser(
+        "check",
+        help="compare the newest BENCH_*.json (or --current) against "
+             "the trajectory; exit 1 on >tolerance regression")
+    ck.add_argument("--dir", default=".",
+                    help="directory holding BENCH_*.json (default .)")
+    ck.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative regression tolerance (default 0.10)")
+    ck.add_argument("--current", default=None,
+                    help="JSON file of {metric: value} (or one bench "
+                         "emit line) to check instead of the newest "
+                         "BENCH record")
+    args = ap.parse_args(argv)
+
+    recs = load_bench_history(args.dir)
+    if args.current:
+        with open(args.current) as f:
+            cur = json.load(f)
+        if isinstance(cur, dict) and "metric" in cur and "value" in cur:
+            cur = {str(cur["metric"]): float(cur["value"])}
+        hist_recs = recs
+    else:
+        if len(recs) < 2:
+            print(f"goodput check: {len(recs)} BENCH_*.json record(s) "
+                  f"in {args.dir!r} — nothing to compare")
+            return 0
+        cur = recs[-1][2]
+        hist_recs = recs[:-1]
+    hist: Dict[str, List[float]] = {}
+    for _n, _fn, metrics in hist_recs:
+        for m, v in metrics.items():
+            hist.setdefault(m, []).append(v)
+    verdict = check_metrics(cur, hist, args.tolerance)
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+if os.environ.get("MXNET_TPU_GOODPUT", "").lower() in ("1", "true",
+                                                       "yes"):
+    enable()
+
+
+if __name__ == "__main__":
+    import sys
+    raise SystemExit(main(sys.argv[1:]))

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd
 from .. import faults as _ft
 from .. import flight as _fl
+from .. import goodput as _gp
 from .. import random as _random
 from .. import telemetry as _tm
 from ..ndarray import NDArray
@@ -1794,6 +1795,11 @@ class FusedTrainStep:
                           (t_disp - t0) * 1e3)
             jax.block_until_ready(loss)
             dt = _time.perf_counter() - t0
+            if _gp._ENABLED:
+                # claim the host dispatch window first so the fused
+                # device span's clipped remainder lands as productive
+                _gp.charge_span("dispatch_overhead", t_disp - t0,
+                                end=t_disp)
             _tm.mark_phase("fused_step", dt, t0=t0, device=True)
             if self._pp_staged is not None:
                 # attribute the device span to fill/steady/drain and
@@ -1808,7 +1814,53 @@ class FusedTrainStep:
                 raw[0], "ndim", 0) else None
             _tm.step_done(nb)
             self._count_wire_bytes(1)
+            if _gp._ENABLED:
+                tok = None
+                if nb:
+                    shp = raw[0].shape
+                    tok = int(nb) * (int(shp[1])
+                                     if len(shp) > 1 else 1)
+                if tok:
+                    _gp.note_tokens("train", tok)
+                if self._pp_mask is not None:
+                    gargs = (self._tr, self._pp_mask, self._states,
+                             hyper, key)
+                else:
+                    gargs = (self._tr, self._aux, self._states,
+                             hyper, key)
+                if self._resid is not None:
+                    gargs += (self._resid,)
+                self._goodput_step(dt, tok, gargs + tuple(raw))
         return NDArray(loss)
+
+    #: goodput efficiency caches, filled by the first timed step
+    _gp_nparams = None
+    _gp_hw_flops = None
+
+    def _goodput_step(self, step_s, tokens, call_args=None):
+        """Feed the MFU/HFU gauges for one (per-)step: analytic
+        6·N·tokens model FLOPs, plus traced ``cost_analysis()`` FLOPs
+        once per build when *call_args* is given (a one-time AOT
+        lower/compile — acceptable, goodput is an opt-in observer)."""
+        if not _gp._ENABLED:
+            return
+        if self._gp_nparams is None:
+            self._gp_nparams = sum(
+                int(getattr(leaf, "size", 0) or 0)
+                for leaf in jax.tree_util.tree_leaves(self._tr))
+        model = 6.0 * self._gp_nparams * tokens if tokens else None
+        if self._gp_hw_flops is None and call_args is not None:
+            try:
+                cost = self._compiled.lower(
+                    *call_args).compile().cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0] if cost else {}
+                self._gp_hw_flops = float((cost or {}).get("flops",
+                                                           0.0))
+            except Exception:
+                self._gp_hw_flops = 0.0
+        _gp.note_train_step(step_s, model_flops=model,
+                            hw_flops=self._gp_hw_flops or None)
 
     def _count_wire_bytes(self, k):
         """Feed the `comm_bytes_{gathered,permuted}` counter families
@@ -2155,6 +2207,11 @@ class FusedTrainStep:
             jax.block_until_ready(losses)
             dt = _time.perf_counter() - t_start
             per = dt / k
+            if _gp._ENABLED:
+                # whole-window host dispatch claimed before the
+                # synthesized per-step device spans land as productive
+                _gp.charge_span("dispatch_overhead",
+                                t_disp - t_start, end=t_disp)
             # per-step device spans are synthesized by even split: the
             # K steps ran back-to-back inside one executable, so the
             # per-step timeline shows K contiguous spans with the
@@ -2174,4 +2231,15 @@ class FusedTrainStep:
                           (t_disp - t_start) / k * 1e3)
             _tm.inc("train_loop_dispatches_total")
             self._count_wire_bytes(k)
+            if _gp._ENABLED:
+                tok = None
+                if nb:
+                    shp = raw[0][0].shape
+                    tok = int(nb) * (int(shp[1])
+                                     if len(shp) > 1 else 1)
+                if tok:
+                    _gp.note_tokens("train", tok * k)
+                # no AOT re-lower of the scan executable: the fused
+                # window would recompile; MFU rides the analytic flops
+                self._goodput_step(per, tok)
         return NDArray(losses)

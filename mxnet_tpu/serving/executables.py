@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import goodput as _gp
 from .. import tracing
 
 __all__ = ["Program", "decoder_programs", "scan_program",
@@ -94,6 +95,11 @@ class Program:
                                    _LowerShim(self._jit, avals))
             tracing.record_compile_seconds(
                 self.name, time.perf_counter() - t0)
+            if _gp._ENABLED:
+                # per-executable HBM watermark off the fresh compile
+                # (goodput is opt-in, so the AOT re-lower is off the
+                # default path entirely)
+                _gp.note_hbm_watermark(self.name, self._jit, avals)
         else:
             tracing.record_hit(self.name)
         return out

@@ -150,6 +150,31 @@ class PagedKVCache:
     def can_alloc(self, num_tokens: int) -> bool:
         return len(self._free) >= self.blocks_for(num_tokens)
 
+    def fragmentation(self) -> float:
+        """Free-list contiguity: 1 − (largest contiguous free run /
+        free blocks). 0.0 when the free pool is one solid run (or has
+        ≤1 block); →1.0 as the pool shatters into single-block holes.
+        Paged attention doesn't need physical contiguity, but a
+        shattered pool is the fingerprint of alloc/free churn and of
+        prefix-parked blocks pinning holes open — the memory-pressure
+        signal goodput exports alongside the exhaustion forecast."""
+        n = len(self._free)
+        if n <= 1:
+            return 0.0
+        ids = sorted(self._free)
+        best = run = 1
+        for prev, cur in zip(ids, ids[1:]):
+            run = run + 1 if cur == prev + 1 else 1
+            if run > best:
+                best = run
+        return 1.0 - best / n
+
+    def parked_blocks(self) -> int:
+        """Free blocks still holding registered prefix content
+        (resurrectable until reused) — the prefix cache's share of the
+        free pool."""
+        return sum(1 for b in self._free if b in self._block_key)
+
     def stats(self) -> dict:
         cap = self.num_blocks - 1
         return {"num_blocks": cap, "block_size": self.block_size,
@@ -160,7 +185,9 @@ class PagedKVCache:
                 "shared_blocks": int((self._refcount > 1).sum()),
                 "prefix_hits": self.prefix_hits,
                 "prefix_tokens_shared": self.prefix_tokens_shared,
-                "cow_copies": self.cow_count}
+                "cow_copies": self.cow_count,
+                "fragmentation": self.fragmentation(),
+                "parked_blocks": self.parked_blocks()}
 
     def slot_len(self, slot: int) -> int:
         return int(self._slot_len[slot])

@@ -565,7 +565,14 @@ class FleetRouter:
     `heartbeat_timeout_s` declares a silent ProcReplica dead;
     `breaker_threshold` / `breaker_cooldown_s` shape the circuit
     breaker; `affinity_blocks` is how many leading prompt blocks feed
-    the prefix-affinity hash (0 disables affinity)."""
+    the prefix-affinity hash (0 disables affinity);
+    `exhaust_window_s` (None = off) arms memory-pressure steering — a
+    replica whose heartbeat forecasts KV-pool exhaustion within the
+    window (the `exhaust_in_s` health detail from the goodput
+    forecaster) stops receiving prompts of `long_prompt_blocks` blocks
+    or more BEFORE it has to preempt; short prompts still land, and if
+    every eligible replica is at risk the filter is dropped
+    (availability over protection)."""
 
     def __init__(self, replicas, *,
                  max_fleet_queue: int = 256,
@@ -582,6 +589,8 @@ class FleetRouter:
                  affinity_blocks: int = 2,
                  affinity_capacity: int = 4096,
                  block_size: int = 16,
+                 exhaust_window_s: Optional[float] = None,
+                 long_prompt_blocks: int = 4,
                  watchdog_s: float = 120.0,
                  poll_s: float = 0.002):
         if not replicas:
@@ -605,6 +614,8 @@ class FleetRouter:
         self.affinity_blocks = int(affinity_blocks)
         self.affinity_capacity = int(affinity_capacity)
         self.block_size = int(block_size)
+        self.exhaust_window_s = exhaust_window_s
+        self.long_prompt_blocks = int(long_prompt_blocks)
         self.watchdog_s = float(watchdog_s)
         self.poll_s = float(poll_s)
         self._queue: deque = deque()
@@ -847,12 +858,34 @@ class FleetRouter:
                 int(d.get("prefill_backlog_tokens", 0)),
                 -int(d.get("blocks_free", 0)))
 
+    def _exhaust_risk(self, rep: _Rep) -> bool:
+        """Replica forecast to exhaust its KV pool inside the
+        admission window (the goodput forecaster's `exhaust_in_s`
+        rides health_detail / the ProcReplica heartbeat wholesale, so
+        no wire change was needed)."""
+        if self.exhaust_window_s is None:
+            return False
+        eta = (rep.detail or {}).get("exhaust_in_s")
+        return eta is not None and eta < self.exhaust_window_s
+
     def _pick(self, fr: FleetRequest, now: float,
               exclude=()) -> Optional[_Rep]:
         elig = [rep for rep in self._reps
                 if rep not in exclude and self._eligible(rep, now)]
         if not elig:
             return None
+        if self.exhaust_window_s is not None and len(fr.prompt) >= \
+                self.long_prompt_blocks * self.block_size:
+            # memory-pressure steering: long prompts avoid replicas
+            # forecast to exhaust — BEFORE they preempt. Short prompts
+            # still land (they fit the margin), and when every replica
+            # is at risk the filter drops: availability wins.
+            safe = [rep for rep in elig
+                    if not self._exhaust_risk(rep)]
+            if safe:
+                if len(safe) < len(elig) and telemetry._ENABLED:
+                    telemetry.inc("router_exhaust_diverted_total")
+                elig = safe
         key = self._affinity_key(fr.prompt)
         if key is not None:
             tgt = self._affinity.get(key)

@@ -98,6 +98,7 @@ import jax.numpy as jnp
 
 from .. import faults as _ft
 from .. import flight as _fl
+from .. import goodput as _gp
 from .. import telemetry
 from ..ndarray import NDArray
 from .kv_cache import PagedKVCache
@@ -349,9 +350,17 @@ class InferenceServer:
         self._trace_on = self._trace_every > 0 or trace_slow_s is not None
         self._traces: "OrderedDict[int, dict]" = OrderedDict()
         self._submit_seq = 0
+        # KV-pool time-to-exhaustion forecaster: O(1) per-tick samples,
+        # lazy rolling fit. critical_s=None keeps this server's own
+        # /healthz steady — the FleetRouter reads `exhaust_in_s` from
+        # health_detail() and steers long-prompt work away instead
+        # (pass a threshold via PoolForecaster directly to make it
+        # page; see docs/observability.md)
+        self._forecaster = _gp.PoolForecaster()
         # /healthz flips to 503 during stall/drain/shutdown; chrome
-        # traces gain the request-span pid (both weakref-held)
+        # traces gain the request-span pid (all weakref-held)
         telemetry.register_health_source(self)
+        telemetry.register_health_source(self._forecaster)
         telemetry.register_request_trace_source(self)
         # opt-in /metrics endpoint (MXNET_TPU_METRICS_PORT): no-op
         # unless the env var is set
@@ -956,6 +965,10 @@ class InferenceServer:
         self.ticks += 1
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
+        self._forecaster.add(now, self.cache.num_free_blocks)
+        if _gp._ENABLED:
+            _gp.note_tokens("serve", net_new)
+            _gp.publish()
         if telemetry._ENABLED:
             telemetry.inc("serving_tokens_total", net_new)
             if self._kernel_paged:
@@ -1007,6 +1020,13 @@ class InferenceServer:
                             int(self._active.sum()))
         telemetry.set_gauge("serving_kv_blocks_free",
                             self.cache.num_free_blocks)
+        telemetry.set_gauge("serving_kv_fragmentation",
+                            self.cache.fragmentation())
+        telemetry.set_gauge("serving_kv_parked_blocks",
+                            self.cache.parked_blocks())
+        eta = self._forecaster.exhaust_in_s()
+        if eta is not None:
+            telemetry.set_gauge("serving_kv_exhaust_in_s", eta)
         if self._spec is not None and self._spec_window:
             prop = sum(p for _, p in self._spec_window)
             if prop:
@@ -1167,6 +1187,8 @@ class InferenceServer:
                 "queue_age_p95_s":
                     float(np.percentile(ages, 95)) if ages else 0.0,
                 "blocks_free": self.cache.num_free_blocks,
+                "kv_fragmentation": self.cache.fragmentation(),
+                "exhaust_in_s": self._forecaster.exhaust_in_s(),
                 "queued": len(self.queue),
                 "active": int(self._active.sum()),
                 "slots": self.batch_slots,

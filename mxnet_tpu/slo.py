@@ -44,7 +44,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import telemetry as _tm
 
-__all__ = ["Objective", "SLOEngine", "default_objectives"]
+__all__ = ["Objective", "GoodputObjective", "SLOEngine",
+           "default_objectives"]
 
 
 def _bucket_exp(threshold: float) -> int:
@@ -118,6 +119,38 @@ class Objective:
                 total += ch.value
                 if status in self.good_statuses:
                     good += ch.value
+        return good, total
+
+
+class GoodputObjective(Objective):
+    """Efficiency objective over the goodput ledger's fleet counters:
+    good = ``goodput_seconds_total{category=productive}``, total =
+    every attributed second. The burn-rate machinery then pages on
+    efficiency COLLAPSE — badput seconds eating the ``1 - target``
+    budget — with the same multi-window policy the latency objectives
+    use, except the "events" are wall-clock seconds (merged across the
+    fleet, since the category counters SUM on registry merge). Enable
+    ``mxnet_tpu.goodput`` and have someone call ``goodput.publish()``
+    (TrainLoop's K boundary and the serving tick already do) or the
+    objective sees no traffic and stays silent."""
+
+    def __init__(self, name: str = "goodput", *,
+                 metric: str = "goodput_seconds_total",
+                 target: float = 0.90):
+        super().__init__(name, metric=metric, target=target)
+
+    def sample(self, registry) -> Tuple[float, float]:
+        fam = registry.get(self.metric)
+        if fam is None:
+            return 0.0, 0.0
+        good = total = 0.0
+        for key, ch in list(fam.children.items()):
+            cat = dict(key).get("category")
+            if cat is None:
+                continue
+            total += ch.value
+            if cat == "productive":
+                good += ch.value
         return good, total
 
 

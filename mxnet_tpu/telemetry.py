@@ -137,6 +137,14 @@ _FLEET_TRACE_SOURCES: List[weakref.ref] = []
 #: FleetRouter); when set, /metrics serves the fleet-merged view
 _FLEET_METRICS_PROVIDER: Optional[weakref.ref] = None
 
+#: goodput hooks (installed by mxnet_tpu.goodput.enable()): every
+#: resolved phase mark feeds the wall-clock ledger, and
+#: breakdown_table() appends the ledger's category section. Plain
+#: module globals so the not-installed cost is one attribute load +
+#: branch — the same contract as _ENABLED.
+_goodput_note = None
+_goodput_section = None
+
 
 def enable():
     """Turn telemetry on for this process."""
@@ -389,6 +397,8 @@ def mark_phase(name: str, seconds: float, t0: Optional[float] = None,
     if not _ENABLED:
         return
     histogram("step_time_breakdown").labels(phase=name).observe(seconds)
+    if _goodput_note is not None:
+        _goodput_note(name, seconds, t0)
     if _flight._ENABLED:
         _flight.record("phase", name, dur_s=seconds)
     start = t0 if t0 is not None else time.perf_counter() - seconds
@@ -1076,6 +1086,8 @@ def breakdown_table() -> str:
     sps = snap.get("samples_per_sec", 0.0)
     if sps:
         lines.append(f"samples/sec: {sps:.1f}")
+    if _goodput_section is not None:
+        lines.extend(_goodput_section())
     return "\n".join(lines)
 
 

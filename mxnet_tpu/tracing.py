@@ -89,6 +89,9 @@ def record_compile_seconds(name: str, seconds: float):
     from . import flight as _fl
     if _fl._ENABLED:
         _fl.record("compile", name, seconds=seconds)
+    from . import goodput as _gp
+    if _gp._ENABLED:
+        _gp.note_compile(seconds)
 
 
 def record_compile(name: str, entry) -> None:

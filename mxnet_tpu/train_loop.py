@@ -25,6 +25,7 @@ import warnings
 from typing import Callable, Iterable, Optional
 
 from . import flight as _fl
+from . import goodput as _gp
 from . import telemetry as _tm
 from .gluon.data.dataloader import DevicePrefetcher, window_iter
 
@@ -134,6 +135,10 @@ class TrainLoop:
                     # published so the primary's /metrics can merge it
                     _tm.publish_step_time(
                         (time.perf_counter() - t_win) / len(window))
+                    if _gp._ENABLED:
+                        # ledger deltas ride the same K-boundary
+                        # publish, so the primary merges fleet goodput
+                        _gp.publish()
                     _tm.publish_snapshot()
                 if on_flush is not None:
                     on_flush(step._step_count, losses)
@@ -157,4 +162,7 @@ class TrainLoop:
             raise
         if _tm._ENABLED:
             _tm.set_gauge("train_loop_k", self.k)
+        if _gp._ENABLED:
+            _gp.publish()
+            print(_gp.format_summary())
         return step._step_count

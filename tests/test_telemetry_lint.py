@@ -10,8 +10,9 @@ though the helper's own `if not _ENABLED: return` discards the work).
 This test walks the ASTs of every module under `mxnet_tpu/` and fails
 when a call to an observe-family helper (`inc` / `observe` /
 `set_gauge` / `mark_phase` / `step_done` on a telemetry alias,
-`record` / `dump` on a flight alias) is not protected by the
-module-flag gate pattern. Accepted gates:
+`record` / `dump` on a flight alias, the ledger/gauge feeders on a
+goodput alias) is not protected by the module-flag gate pattern.
+Accepted gates:
 
 - an enclosing `if` whose test mentions `_ENABLED` / `_ACTIVE` /
   `enabled()` / `active()` — directly, or through a local variable
@@ -34,7 +35,10 @@ PKG = os.path.join(REPO, "mxnet_tpu")
 
 #: the helpers whose call sites must be gated, per instrumented module
 FAMILY = {"inc", "observe", "set_gauge", "mark_phase", "step_done",
-          "record", "dump"}
+          "record", "dump",
+          # goodput's hot feeders ride the same cost contract
+          "charge_span", "charge_gap", "note_compile", "note_tokens",
+          "note_train_step", "note_hbm_watermark", "publish"}
 
 #: substrings that make an `if` test (or a flag-variable initializer)
 #: count as the module-flag gate
@@ -61,12 +65,13 @@ def _instrumentation_aliases(tree):
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom):
             for a in node.names:
-                if a.name in ("telemetry", "flight", "faults"):
+                if a.name in ("telemetry", "flight", "faults",
+                              "goodput"):
                     aliases.add(a.asname or a.name)
         elif isinstance(node, ast.Import):
             for a in node.names:
                 mod = a.name.rsplit(".", 1)[-1]
-                if mod in ("telemetry", "flight", "faults"):
+                if mod in ("telemetry", "flight", "faults", "goodput"):
                     aliases.add(a.asname or a.name.split(".")[0])
     return aliases
 
@@ -220,6 +225,17 @@ def test_slo_module_is_scanned_and_clean():
     router (covered by test_router_module_is_scanned_and_clean)."""
     path = os.path.join(PKG, "slo.py")
     assert path in _module_files(), "slo.py missing from lint walk"
+    assert _violations(path) == []
+
+
+def test_goodput_module_is_scanned_and_clean():
+    """The goodput ledger consumes every phase mark and exports the
+    MFU/fraction gauges — its own registry calls must ride the same
+    cost contract (early-return guards on the module `_ENABLED`), and
+    every EXTERNAL `_gp.charge_span`/`note_*`/`publish` site in the
+    stack must be gated (those helper names are in FAMILY above)."""
+    path = os.path.join(PKG, "goodput.py")
+    assert path in _module_files(), "goodput.py missing from lint walk"
     assert _violations(path) == []
 
 
