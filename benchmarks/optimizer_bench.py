@@ -487,6 +487,7 @@ def main_telemetry_overhead():
     # no-ops the SLO engine tick and the router's trace-propagation
     # hook too, so the measured gap covers them compiled in but idle
     from mxnet_tpu import slo as _slo
+    from mxnet_tpu.serving import kv_tier as _kvt
     from mxnet_tpu.serving import router as _router
 
     saved_hooks = {(_slo.SLOEngine, "tick"): _slo.SLOEngine.tick,
@@ -496,6 +497,15 @@ def main_telemetry_overhead():
                       lambda self, now=None: None,
                   (_router.FleetRouter, "_note_result"):
                       lambda self, *a, **k: None}
+    # the KV-tier telemetry funnels (spill/restore/stream/persist
+    # accounting) ride the same contract — no-op them on the B side
+    for _hook in ("_note_spill", "_note_restore", "_note_restore_failed",
+                  "_note_restore_timeout", "_note_stream",
+                  "_note_persist"):
+        saved_hooks[(_kvt.KVTierManager, _hook)] = \
+            getattr(_kvt.KVTierManager, _hook)
+        hook_noops[(_kvt.KVTierManager, _hook)] = \
+            lambda self, *a, **k: None
 
     a_ms, b_ms = [], []
     for _ in range(rounds):

@@ -53,6 +53,14 @@ site                   fires at
 ``router.drop``         ``FleetRouter`` result intake — discards a
                         completed attempt's result as if the reply got
                         lost, exercising the retry + idempotency path
+``kv.spill_corrupt``    ``KVTierManager`` spill — flips a payload byte
+                        AFTER the integrity digest is sealed, so the
+                        restore-side verification catches it and falls
+                        back to recompute
+                        (``serving_tier_restore_failed_total``)
+``kv.restore_slow``     ``KVTierManager`` restore — sleeps ``ms`` before
+                        the device copy, exercising the admit-time
+                        prefetch timeout path
 ====================== ====================================================
 
 Env grammar (``;``-separated entries, ``:``-separated fields, first
@@ -102,7 +110,8 @@ __all__ = ["SITES", "FaultInjected", "FaultTimeout",
 #: the named injection sites instrumented across the stack
 SITES = ("checkpoint.truncate", "collective.timeout", "grad.nonfinite",
          "step.kill", "host.slow", "serving.stall", "multihost.break",
-         "replica.kill", "replica.stall", "router.drop")
+         "replica.kill", "replica.stall", "router.drop",
+         "kv.spill_corrupt", "kv.restore_slow")
 
 
 class FaultInjected(RuntimeError):

@@ -20,6 +20,12 @@ request scheduler instead of one-shot `generate()` calls.
             for p in prompts]
     server.run()
 
+- `kv_tier.KVTierManager` / `kv_tier.PrefixStore` — the KV-block
+  memory hierarchy: parked prefixes demote to a host-RAM spill tier
+  instead of dying under pool pressure, persist to a disk-backed
+  prefix store across restarts, and stream prefill→decode over the
+  router's kv channel (`InferenceServer(kv_tiering=True,
+  prefix_store_dir=...)`, `FleetRouter(disaggregate=True)`).
 - `router.FleetRouter` — the resilient fleet: health-gated routing
   over N replicas (least-loaded + prefix-affinity) with circuit
   breakers, failover retries, hedging, load shedding, and drain-aware
@@ -32,22 +38,25 @@ request scheduler instead of one-shot `generate()` calls.
 See docs/serving.md for the architecture and the block-table math.
 """
 from . import kv_cache
+from . import kv_tier
 from . import sampling
 from . import executables
 from . import speculative
 from . import server
 from . import router
 from .kv_cache import PagedKVCache
+from .kv_tier import KVTierManager, PrefixStore
 from .server import InferenceServer, Request, ServerStalledError
 from .speculative import NgramProposer
 from .router import (FleetRouter, FleetRequest, LocalReplica,
                      ProcReplica, CircuitBreaker, FileKV, CoordKV,
                      RouterStalledError, run_fleet_worker)
 
-__all__ = ["PagedKVCache", "InferenceServer", "Request",
+__all__ = ["PagedKVCache", "KVTierManager", "PrefixStore",
+           "InferenceServer", "Request",
            "ServerStalledError", "NgramProposer",
            "FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
            "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
            "run_fleet_worker",
-           "kv_cache", "sampling", "executables", "server", "router",
-           "speculative"]
+           "kv_cache", "kv_tier", "sampling", "executables", "server",
+           "router", "speculative"]

@@ -222,6 +222,12 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         prefix-cache copy-on-write (src/dst traced scalars, so every
         CoW shares one executable).
 
+    spill_block(pages, src) -> {field: (L, K, bs, ·)} /
+    restore_block(pages, payload, dst) -> pages: the KV tier
+        hierarchy's device↔host block movers (serving/kv_tier.py).
+        Same traced-index discipline as copy_block — one executable
+        each, regardless of which block spills or restores.
+
     decode(params, pages, block_tables, pos, last_logits, keys,
            temps, top_ks, top_ps, active)
         -> (pages, tok, logits, keys): one continuous-batching tick —
@@ -440,12 +446,32 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         return [{f: a.at[dst].set(a[src]) for f, a in pg.items()}
                 for pg in pages]
 
+    def spill_block(pages, src):
+        # gather ONE block across every layer into a host-transfer
+        # bundle {field: (L, K, bs, ·)}; src is a traced scalar, so
+        # every spill rides one executable (copy_block discipline).
+        # Pages are NOT donated: the spill is a read-only snapshot.
+        return {f: jnp.stack([pg[f][src] for pg in pages])
+                for f in pages[0]}
+
+    def restore_block(pages, payload, dst):
+        # inverse scatter of a spill_block bundle into block `dst` of
+        # every layer; dst traced, payload shape fixed at (L, ...) —
+        # zero per-shape recompiles
+        return [{f: a.at[dst].set(payload[f][layer])
+                 for f, a in pg.items()}
+                for layer, pg in enumerate(pages)]
+
     ent = {"prefill": Program("serving_prefill", prefill,
                               donate_argnums=(1,)),
            "decode": Program("serving_decode", decode,
                              donate_argnums=(1,)),
            "copy_block": Program("serving_copy_block", copy_block,
-                                 donate_argnums=(0,))}
+                                 donate_argnums=(0,)),
+           "spill_block": Program("serving_spill_block", spill_block),
+           "restore_block": Program("serving_restore_block",
+                                    restore_block,
+                                    donate_argnums=(0,))}
     if prefill_chunk:
         ent["prefill_chunk"] = Program(
             "serving_prefill_chunk", make_prefill_chunk(prefill_chunk),

@@ -132,6 +132,10 @@ class PagedKVCache:
         self.prefix_hits = 0
         self.prefix_tokens_shared = 0
         self.cow_count = 0
+        #: optional KVTierManager (serving/kv_tier.py). When attached,
+        #: _purge DEMOTES registered content to the host tier instead
+        #: of discarding it, and park_restored re-admits it.
+        self.tier = None
 
     # -- accounting ---------------------------------------------------------
 
@@ -157,7 +161,11 @@ class PagedKVCache:
         Paged attention doesn't need physical contiguity, but a
         shattered pool is the fingerprint of alloc/free churn and of
         prefix-parked blocks pinning holes open — the memory-pressure
-        signal goodput exports alongside the exhaustion forecast."""
+        signal goodput exports alongside the exhaustion forecast.
+        Tier-aware by construction: spilling a parked block to the
+        host tier leaves it plain-free (registration demoted with the
+        content), so spilled prefixes stop pinning holes open and the
+        gauge relaxes instead of double-counting them."""
         n = len(self._free)
         if n <= 1:
             return 0.0
@@ -172,22 +180,42 @@ class PagedKVCache:
     def parked_blocks(self) -> int:
         """Free blocks still holding registered prefix content
         (resurrectable until reused) — the prefix cache's share of the
-        free pool."""
-        return sum(1 for b in self._free if b in self._block_key)
+        free pool. Tier-aware: content that has DEMOTED to the host
+        tier no longer pins a device block, so spilled prefixes never
+        double-count as free-list pressure (the PoolForecaster reads
+        num_free_blocks; this gauge explains how much of it is
+        parked)."""
+        if self.tier is None:
+            return sum(1 for b in self._free if b in self._block_key)
+        host = self.tier.resident_keys()
+        n = 0
+        for b in self._free:
+            key = self._block_key.get(b)
+            if key is None:
+                continue
+            # defensive: a key resident in the host tier is not
+            # parked here (check() asserts the tiers are disjoint)
+            if self.tier.flat_key(key) in host:
+                continue
+            n += 1
+        return n
 
     def stats(self) -> dict:
         cap = self.num_blocks - 1
-        return {"num_blocks": cap, "block_size": self.block_size,
-                "free_blocks": self.num_free_blocks,
-                "used_blocks": self.num_used_blocks,
-                "utilization": self.num_used_blocks / cap if cap else 0,
-                "allocs": self.alloc_count, "frees": self.free_count,
-                "shared_blocks": int((self._refcount > 1).sum()),
-                "prefix_hits": self.prefix_hits,
-                "prefix_tokens_shared": self.prefix_tokens_shared,
-                "cow_copies": self.cow_count,
-                "fragmentation": self.fragmentation(),
-                "parked_blocks": self.parked_blocks()}
+        out = {"num_blocks": cap, "block_size": self.block_size,
+               "free_blocks": self.num_free_blocks,
+               "used_blocks": self.num_used_blocks,
+               "utilization": self.num_used_blocks / cap if cap else 0,
+               "allocs": self.alloc_count, "frees": self.free_count,
+               "shared_blocks": int((self._refcount > 1).sum()),
+               "prefix_hits": self.prefix_hits,
+               "prefix_tokens_shared": self.prefix_tokens_shared,
+               "cow_copies": self.cow_count,
+               "fragmentation": self.fragmentation(),
+               "parked_blocks": self.parked_blocks()}
+        if self.tier is not None:
+            out.update(self.tier.stats())
+        return out
 
     def slot_len(self, slot: int) -> int:
         return int(self._slot_len[slot])
@@ -197,12 +225,22 @@ class PagedKVCache:
 
     # -- alloc / extend / free ----------------------------------------------
 
+    def attach_tier(self, tier):
+        """Attach a KVTierManager: from now on reclaiming a parked
+        block demotes its content to the host tier instead of erasing
+        the index entry outright."""
+        self.tier = tier
+
     def _purge(self, blk: int):
         """Drop the block's content registration (its data is about to
-        be reused or overwritten below the registered length)."""
+        be reused or overwritten below the registered length). With a
+        tier attached, the content demotes to the host tier first —
+        the block's data is still intact at purge time."""
         key = self._block_key.pop(blk, None)
         if key is not None and self._chain.get(key) == blk:
             del self._chain[key]
+            if self.tier is not None:
+                self.tier.on_purge(blk, key)
 
     def _pop_free(self) -> int:
         """Claim a fresh block for private use: registered content (a
@@ -478,7 +516,31 @@ class PagedKVCache:
                     and blk != 0:
                 self._chain[key] = blk
                 self._block_key[blk] = key
+                if self.tier is not None:
+                    # the freshly computed device copy supersedes any
+                    # stale host-tier copy (one-tier residency)
+                    self.tier.on_register(key)
             parent = key
+
+    def park_restored(self, key) -> Optional[int]:
+        """Tier-restore adoption point: claim a free block and
+        register restored content under chain `key`, PARKED (refcount
+        0, free-list bottom) — exactly the state of a finished
+        request's prefix, so the next alloc_shared resurrects it
+        through the normal sharing path. The caller (KVTierManager)
+        then runs the restore executable into the returned block.
+        Returns None when the pool has no free block or the key is
+        already resident."""
+        if not self.prefix_cache or key is None:
+            return None
+        if key in self._chain or not self._free:
+            return None
+        blk = self._free.pop()
+        self._purge(blk)  # demotes the evicted content, if any
+        self._chain[key] = blk
+        self._block_key[blk] = key
+        self._free.insert(0, blk)
+        return blk
 
     def check(self):
         """Allocator invariants (tests + debugging): refcounts match
@@ -514,3 +576,7 @@ class PagedKVCache:
             assert self._block_key.get(blk) == key, \
                 f"chain entry for block {blk} out of sync"
             assert blk != 0, "scratch block registered"
+        if self.tier is not None:
+            # tier invariants: one tier per content key, conservation
+            # across spill/restore/adopt (KVTierManager.check)
+            self.tier.check()

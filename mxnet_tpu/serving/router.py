@@ -302,6 +302,11 @@ class FleetRequest:
         self.retries = 0                # re-dispatches after a failure
         self.hedged = False
         self.attempts: List["_Attempt"] = []
+        #: disaggregated serving: the serialized KV-block wire payload
+        #: produced by a prefill replica (None = not yet / not
+        #: disaggregating, "" = disaggregation fell back to a combined
+        #: replica — don't try again)
+        self.kv_wire: Optional[str] = None
         #: distributed-trace record, one entry per attempt (replica,
         #: routing decision, outcome, shipped worker timeline + clock
         #: offset); only populated while telemetry is enabled
@@ -349,7 +354,8 @@ class LocalReplica:
 
     def __init__(self, server: Optional[InferenceServer] = None,
                  factory: Optional[Callable[[], InferenceServer]] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 role: Optional[str] = None):
         if server is None:
             if factory is None:
                 raise ValueError("need a server or a factory")
@@ -357,6 +363,9 @@ class LocalReplica:
         self.server = server
         self.factory = factory
         self.name = name or f"local{id(server) & 0xffff:x}"
+        #: disaggregated serving role ("prefill" | "decode" | None =
+        #: combined); the router's `disaggregate` flow keys off this
+        self.role = role
         self.dead = False
         self.restarts = 0
         self._stall_ticks_left = 0
@@ -377,6 +386,17 @@ class LocalReplica:
                deadline_s: Optional[float]):
         if self.dead:
             raise RuntimeError(f"replica {self.name} is dead")
+        wire = getattr(fr, "kv_wire", None)
+        if wire:
+            # streamed prefill: adopt the shipped KV blocks into the
+            # host tier BEFORE admission, so the prefix match covers
+            # the prompt and prefill is skipped (adoption is
+            # best-effort — a mismatched wire just means a cold
+            # prefill, never a failed request)
+            try:
+                self.server.adopt_wire_blocks(wire)
+            except Exception:
+                pass
         req = self.server.submit(
             fr.prompt, fr.max_new_tokens,
             temperature=fr.params["temperature"],
@@ -384,6 +404,28 @@ class LocalReplica:
             eos_id=fr.params["eos_id"], seed=fr.params["seed"],
             deadline_s=deadline_s, trace_ctx=attempt_key)
         return req
+
+    def prefill_export(self, fr: FleetRequest, key: str):
+        """Start a prefill-and-export job: run the prompt through this
+        replica's prefill (one generated token, discarded) so its KV
+        blocks land in the prefix cache, ready to serialize. Returns a
+        job handle for `poll_export`."""
+        if self.dead:
+            raise RuntimeError(f"replica {self.name} is dead")
+        req = self.server.submit(fr.prompt, 1,
+                                 seed=fr.params["seed"], trace_ctx=key)
+        return (req, fr.prompt)
+
+    def poll_export(self, job) -> Optional[str]:
+        """None while the prefill is still running; the wire payload
+        once exported; "" when the export failed (caller falls back to
+        combined serving)."""
+        req, prompt = job
+        if req.state != "finished":
+            return None
+        if req.status != "ok":
+            return ""
+        return self.server.export_prefix(prompt) or ""
 
     def drive(self) -> int:
         """One scheduler tick (0 tokens when dead/stalled/idle)."""
@@ -444,11 +486,15 @@ class ProcReplica:
     - ``hb``: worker → router heartbeat — the `health_detail()` dict
       plus a wall-clock stamp; staleness past `heartbeat_timeout_s`
       (router-side) is how a SIGKILLed worker is detected.
+    - ``kv/<token>``: worker → router exported KV-block wire payloads
+      (disaggregated prefill; "" marks a failed export).
     """
 
-    def __init__(self, channel, name: str):
+    def __init__(self, channel, name: str,
+                 role: Optional[str] = None):
         self.channel = channel
         self.name = name
+        self.role = role
         self.ns = f"fleet/{name}"
         self.dead = False               # router marks on staleness
         self._cmd_seq = 0
@@ -471,11 +517,24 @@ class ProcReplica:
 
     def submit(self, fr: FleetRequest, attempt_key: str,
                deadline_s: Optional[float]):
-        self._send({"op": "submit", "token": attempt_key,
-                    "prompt": [int(t) for t in fr.prompt],
-                    "max_new": fr.max_new_tokens,
-                    "deadline_s": deadline_s, **fr.params})
+        cmd = {"op": "submit", "token": attempt_key,
+               "prompt": [int(t) for t in fr.prompt],
+               "max_new": fr.max_new_tokens,
+               "deadline_s": deadline_s, **fr.params}
+        wire = getattr(fr, "kv_wire", None)
+        if wire:
+            cmd["kv"] = wire            # worker adopts before admit
+        self._send(cmd)
         return attempt_key
+
+    def prefill_export(self, fr: FleetRequest, key: str):
+        self._send({"op": "prefill_export", "token": key,
+                    "prompt": [int(t) for t in fr.prompt],
+                    "seed": fr.params["seed"]})
+        return key
+
+    def poll_export(self, job) -> Optional[str]:
+        return self.channel.get(f"{self.ns}/kv/{job}", timeout_ms=0)
 
     def drive(self) -> int:
         return 0                        # the worker drives itself
@@ -572,7 +631,13 @@ class FleetRouter:
     forecaster) stops receiving prompts of `long_prompt_blocks` blocks
     or more BEFORE it has to preempt; short prompts still land, and if
     every eligible replica is at risk the filter is dropped
-    (availability over protection)."""
+    (availability over protection);
+    `disaggregate` arms prefill/decode disaggregation — a queued
+    request is first prefilled on a ``role="prefill"`` replica, its KV
+    blocks exported over the kv channel, then dispatched (wire
+    attached) to a ``role="decode"`` replica that adopts the blocks
+    and skips prefill; when no prefill replica is eligible the request
+    falls back to ordinary least-loaded combined serving."""
 
     def __init__(self, replicas, *,
                  max_fleet_queue: int = 256,
@@ -591,6 +656,7 @@ class FleetRouter:
                  block_size: int = 16,
                  exhaust_window_s: Optional[float] = None,
                  long_prompt_blocks: int = 4,
+                 disaggregate: bool = False,
                  watchdog_s: float = 120.0,
                  poll_s: float = 0.002):
         if not replicas:
@@ -616,6 +682,10 @@ class FleetRouter:
         self.block_size = int(block_size)
         self.exhaust_window_s = exhaust_window_s
         self.long_prompt_blocks = int(long_prompt_blocks)
+        self.disaggregate = bool(disaggregate)
+        #: fr.token -> (fr, rep, job, t0): prefill-export jobs in
+        #: flight on prefill-role replicas
+        self._prefill_jobs: Dict[str, tuple] = {}
         self.watchdog_s = float(watchdog_s)
         self.poll_s = float(poll_s)
         self._queue: deque = deque()
@@ -631,6 +701,9 @@ class FleetRouter:
         self.n_failovers = 0
         self.n_hedges = 0
         self.n_duplicates = 0
+        self.n_prefill_exports = 0
+        self.n_stream_dispatches = 0
+        self.n_disagg_fallbacks = 0
         self._pick_how = "least_loaded"     # last routing decision
         self._slo = None                    # attach_slo() sets this
         self._bundle_seq = 0
@@ -686,6 +759,8 @@ class FleetRouter:
         self._refresh(now)
         progress = self._failover_dead(now)
         self._expire(now)
+        if self.disaggregate:
+            progress += self._prefill_tick(now)
         progress += self._dispatch(now)
         progress += self._drive(now)
         progress += self._collect(now)
@@ -868,12 +943,30 @@ class FleetRouter:
         eta = (rep.detail or {}).get("exhaust_in_s")
         return eta is not None and eta < self.exhaust_window_s
 
+    @staticmethod
+    def _role(rep: _Rep) -> Optional[str]:
+        """A replica's disaggregation role: the handle attribute when
+        set, else whatever the heartbeat reports (None = combined)."""
+        r = getattr(rep.handle, "role", None)
+        if r is None and rep.detail is not None:
+            r = rep.detail.get("role")
+        return r
+
     def _pick(self, fr: FleetRequest, now: float,
-              exclude=()) -> Optional[_Rep]:
+              exclude=(), role: Optional[str] = None) -> Optional[_Rep]:
         elig = [rep for rep in self._reps
                 if rep not in exclude and self._eligible(rep, now)]
         if not elig:
             return None
+        if role is not None:
+            match = [rep for rep in elig if self._role(rep) == role]
+            if match:
+                elig = match
+            elif role == "prefill":
+                # no prefill replica eligible: the caller falls back
+                # to combined least-loaded serving, NOT to prefilling
+                # on a decode replica
+                return None
         if self.exhaust_window_s is not None and len(fr.prompt) >= \
                 self.long_prompt_blocks * self.block_size:
             # memory-pressure steering: long prompts avoid replicas
@@ -902,6 +995,72 @@ class FleetRouter:
                 self._affinity.popitem(last=False)
         return best
 
+    def _prefill_tick(self, now: float) -> int:
+        """Poll in-flight prefill-export jobs: a finished export
+        attaches the wire payload to its request (next dispatch ships
+        it to a decode replica); a dead prefill replica, a timed-out
+        job, or a failed export falls the request back to combined
+        serving."""
+        n = 0
+        for tok, (fr, rep, job, t0) in list(self._prefill_jobs.items()):
+            if fr.terminal:
+                del self._prefill_jobs[tok]
+                continue
+            wire = None
+            failed = rep.state == DEAD
+            if not failed:
+                try:
+                    wire = rep.handle.poll_export(job)
+                except Exception:
+                    failed = True
+            if self.attempt_timeout_s is not None and \
+                    wire is None and now - t0 > self.attempt_timeout_s:
+                failed = True
+            if failed or wire == "":
+                del self._prefill_jobs[tok]
+                fr.kv_wire = ""         # combined serving from here on
+                self.n_disagg_fallbacks += 1
+                if telemetry._ENABLED:
+                    telemetry.inc("router_disagg_fallback_total")
+                if _fl._ENABLED:
+                    _fl.record("route", "router.disagg_fallback",
+                               token=fr.token, replica=rep.name)
+                continue
+            if wire is None:
+                continue                # still prefilling
+            del self._prefill_jobs[tok]
+            fr.kv_wire = wire
+            self.n_prefill_exports += 1
+            n += 1
+            if telemetry._ENABLED:
+                telemetry.inc("router_prefill_exports_total")
+            if _fl._ENABLED:
+                _fl.record("route", "router.prefill_export",
+                           token=fr.token, replica=rep.name,
+                           bytes=len(wire))
+        return n
+
+    def _start_prefill(self, fr: FleetRequest, now: float) -> bool:
+        """Try to start a prefill-export job for a queued request.
+        False means no prefill replica took it — fall back."""
+        rep = self._pick(fr, now, role="prefill")
+        if rep is None:
+            return False
+        try:
+            job = rep.handle.prefill_export(fr, f"{fr.token}.pf")
+        except Exception as e:
+            rep.breaker.record_failure(now)
+            if _fl._ENABLED:
+                _fl.record("route", "router.prefill_error",
+                           token=fr.token, replica=rep.name,
+                           error=repr(e)[:120])
+            return False
+        self._prefill_jobs[fr.token] = (fr, rep, job, now)
+        if _fl._ENABLED:
+            _fl.record("route", "router.prefill_start",
+                       token=fr.token, replica=rep.name)
+        return True
+
     def _dispatch(self, now: float) -> int:
         n = 0
         work = list(self._queue)
@@ -913,7 +1072,21 @@ class FleetRouter:
             if fr.next_eligible_t > now:
                 keep.append(fr)
                 continue
-            rep = self._pick(fr, now)
+            if self.disaggregate and fr.kv_wire is None:
+                if fr.token in self._prefill_jobs:
+                    keep.append(fr)     # prefill still in flight
+                    continue
+                if self._start_prefill(fr, now):
+                    keep.append(fr)
+                    n += 1
+                    continue
+                # least-loaded fallback: no prefill replica eligible
+                fr.kv_wire = ""
+                self.n_disagg_fallbacks += 1
+                if telemetry._ENABLED:
+                    telemetry.inc("router_disagg_fallback_total")
+            rep = self._pick(fr, now,
+                             role="decode" if fr.kv_wire else None)
             if rep is None:
                 keep.append(fr)
                 continue
@@ -942,6 +1115,10 @@ class FleetRouter:
                 self._retry(fr, now, f"submit to {rep.name}: {e}")
             return False
         att = _Attempt(rep, sub, now, hedge)
+        if fr.kv_wire:
+            self.n_stream_dispatches += 1
+            if telemetry._ENABLED:
+                telemetry.inc("router_stream_dispatch_total")
         if telemetry._ENABLED:
             att.log = {"attempt": fr.tries - 1, "replica": rep.name,
                        "key": attempt_key, "t0": now, "hedge": hedge,
@@ -1149,7 +1326,8 @@ class FleetRouter:
             att = fr.attempts[0]
             if now - att.t0 < thr:
                 continue
-            rep = self._pick(fr, now, exclude=(att.rep,))
+            rep = self._pick(fr, now, exclude=(att.rep,),
+                             role="decode" if fr.kv_wire else None)
             if rep is None:
                 continue
             fr.hedged = True
@@ -1265,11 +1443,15 @@ class FleetRouter:
                 "shed": self.n_shed, "retries": self.n_retries,
                 "failovers": self.n_failovers, "hedges": self.n_hedges,
                 "duplicates": self.n_duplicates,
+                "prefill_exports": self.n_prefill_exports,
+                "stream_dispatches": self.n_stream_dispatches,
+                "disagg_fallbacks": self.n_disagg_fallbacks,
                 "replicas": {rep.name: {
                     "state": _STATE_NAMES[rep.state],
                     "breaker": rep.breaker.state,
                     "attempts": len(rep.attempts),
                     "restarts": getattr(rep.handle, "restarts", 0),
+                    "role": self._role(rep),
                 } for rep in self._reps}}
 
     # -- distributed tracing -------------------------------------------------
@@ -1530,6 +1712,8 @@ def run_fleet_worker(channel, name: str,
     next_cmd = 0
     live: Dict[str, object] = {}        # attempt token -> Request
     done: Dict[str, str] = {}           # attempt token -> result json
+    live_exports: Dict[str, tuple] = {}  # token -> (Request, prompt)
+    done_exports: Dict[str, str] = {}    # token -> wire ("" = failed)
     last_hb = 0.0
     t_start = time.time()
     stopping = False
@@ -1544,6 +1728,11 @@ def run_fleet_worker(channel, name: str,
         wreq = server.submit([1, 2], 2)
         while wreq.state != "finished":
             server.step()
+        if getattr(server, "tier", None) is not None:
+            # compile the spill/restore program pair up front too: a
+            # disaggregated decode replica must adopt streamed blocks
+            # with ZERO extra compiles after warm-up
+            server.warm_tier()
 
     # clock handshake, recorded at warm-up: perf_counter and wall clock
     # sampled together, shipped on every heartbeat so the router can
@@ -1588,6 +1777,15 @@ def run_fleet_worker(channel, name: str,
                 if tok in done:         # idempotent republish
                     channel.set(f"{ns}/res/{tok}", done[tok])
                 elif tok not in live:
+                    kv = cmd.get("kv")
+                    if kv:
+                        # disaggregated decode: adopt the streamed
+                        # prefill blocks before admission (best
+                        # effort — failure just means a cold prefill)
+                        try:
+                            server.adopt_wire_blocks(kv)
+                        except Exception:
+                            pass
                     try:
                         live[tok] = server.submit(
                             cmd["prompt"], cmd["max_new"],
@@ -1604,6 +1802,19 @@ def run_fleet_worker(channel, name: str,
                              "finish_reason": f"submit: {e}"[:200]})
                         done[tok] = res
                         channel.set(f"{ns}/res/{tok}", res)
+            elif op == "prefill_export":
+                tok = cmd["token"]
+                if tok in done_exports:  # idempotent republish
+                    channel.set(f"{ns}/kv/{tok}", done_exports[tok])
+                elif tok not in live_exports:
+                    try:
+                        req = server.submit(cmd["prompt"], 1,
+                                            seed=cmd.get("seed", 0),
+                                            trace_ctx=tok)
+                        live_exports[tok] = (req, cmd["prompt"])
+                    except Exception:
+                        done_exports[tok] = ""
+                        channel.set(f"{ns}/kv/{tok}", "")
             elif op == "cancel":
                 req = live.get(cmd.get("token"))
                 if req is not None:
@@ -1617,6 +1828,9 @@ def run_fleet_worker(channel, name: str,
                     telemetry.unregister_health_source(server)
                     server = server_factory()
                     live.clear()
+                    live_exports.clear()
+                    if getattr(server, "tier", None) is not None:
+                        server.warm_tier()
                 else:
                     server.end_drain()  # best effort: reopen admission
             elif op == "flight_dump":
@@ -1661,6 +1875,18 @@ def run_fleet_worker(channel, name: str,
                 done[tok] = res
                 channel.set(f"{ns}/res/{tok}", res)
                 live.pop(tok)
+        for tok, (req, prompt) in list(live_exports.items()):
+            if req.state != "finished":
+                continue
+            wire = ""
+            if req.status == "ok":
+                try:
+                    wire = server.export_prefix(prompt) or ""
+                except Exception:
+                    wire = ""
+            done_exports[tok] = wire
+            channel.set(f"{ns}/kv/{tok}", wire)
+            live_exports.pop(tok)
         if fatal is not None:
             _beat(now, reason=f"fatal: {fatal}")
             raise RuntimeError(f"fleet worker {name}: {fatal}")
@@ -1701,6 +1927,12 @@ def _worker_main(argv=None):
     ap.add_argument("--block", type=int, default=8)
     ap.add_argument("--max-prompt", type=int, default=16)
     ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--tiering", action="store_true",
+                    help="enable the KV-block memory hierarchy "
+                         "(host spill tier + block streaming)")
+    ap.add_argument("--persist-dir", default=None,
+                    help="disk-backed prefix store directory "
+                         "(implies tiering)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-wall-s", type=float, default=None)
     args = ap.parse_args(argv)
@@ -1720,7 +1952,9 @@ def _worker_main(argv=None):
         return InferenceServer(
             net, batch_slots=args.slots, max_len=args.max_len,
             block_size=args.block, max_prompt_len=args.max_prompt,
-            prefix_cache=args.prefix_cache)
+            prefix_cache=args.prefix_cache,
+            kv_tiering=args.tiering,
+            prefix_store_dir=args.persist_dir)
 
     run_fleet_worker(FileKV(args.dir), args.name,
                      server_factory=factory,

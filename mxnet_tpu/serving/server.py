@@ -236,7 +236,13 @@ class InferenceServer:
                  trace_slow_s: Optional[float] = None,
                  trace_capacity: int = 256,
                  prefill_chunk_tokens: Optional[int] = None,
-                 speculative=None):
+                 speculative=None,
+                 kv_tiering: bool = False,
+                 tier_host_blocks: Optional[int] = None,
+                 tier_spill_exhaust_s: Optional[float] = 3.0,
+                 tier_spill_batch: int = 4,
+                 tier_prefetch_timeout_s: Optional[float] = None,
+                 prefix_store_dir: Optional[str] = None):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -247,6 +253,10 @@ class InferenceServer:
         self.block_size = block_size
         self.max_prompt_len = max_prompt_len or min(max_len, 64)
         self.kv_cache_dtype = kv_cache_dtype
+        # the tier hierarchy rides the content index — tiering (or a
+        # persistent prefix store) implies the prefix cache
+        if kv_tiering or prefix_store_dir is not None:
+            prefix_cache = True
         self.prefix_cache = prefix_cache
         if prefill_chunk_tokens is not None:
             prefill_chunk_tokens = int(prefill_chunk_tokens)
@@ -274,6 +284,28 @@ class InferenceServer:
             kv_cache_dtype=kv_cache_dtype,
             prefill_chunk=prefill_chunk_tokens or 0,
             spec_k=self._spec.k if self._spec is not None else 0)
+
+        # KV-block memory hierarchy (serving/kv_tier.py): host-RAM
+        # spill tier + optional disk-backed persistent prefix store.
+        # With a tier attached, reclaiming a parked block demotes its
+        # content instead of discarding it, preemptions spill instead
+        # of forcing a recompute, and admits prefetch-restore matching
+        # host/disk prefixes through the restore executable.
+        self.tier = None
+        if kv_tiering or prefix_store_dir is not None:
+            from .kv_tier import KVTierManager, PrefixStore
+            store = PrefixStore(prefix_store_dir) \
+                if prefix_store_dir else None
+            self.tier = KVTierManager(
+                self.cache, self.programs,
+                host_capacity_blocks=tier_host_blocks,
+                store=store,
+                spill_exhaust_s=tier_spill_exhaust_s,
+                spill_batch=tier_spill_batch,
+                prefetch_timeout_s=tier_prefetch_timeout_s)
+            self.cache.attach_tier(self.tier)
+            if store is not None:
+                self.tier.load_store()
 
         # host-side probe of the decode kernel's dispatch: traced code
         # cannot bump counters, so the per-tick HBM bytes the in-kernel
@@ -319,6 +351,11 @@ class InferenceServer:
         self._prefill_pos = np.zeros(B, np.int32)
         self._warm = np.zeros(B, bool)
         self.prefills_skipped = 0
+        #: hard preemptions (recompute cliff) vs spill preemptions
+        #: (victim's prefix demoted to the host tier — re-admission
+        #: restores it with a copy, not a recompute)
+        self.preemptions = 0
+        self.spill_preemptions = 0
         self.spec_tokens_accepted = 0
         self.spec_tokens_rejected = 0
         self._spec_window: deque = deque(maxlen=256)
@@ -547,6 +584,12 @@ class InferenceServer:
             # the prompt's blocks now; the first decode block comes
             # lazily via ensure()
             if self.prefix_cache:
+                if self.tier is not None:
+                    # prefetch-on-LCP-match: restore host/disk-tier
+                    # blocks extending the device prefix into PARKED
+                    # blocks, so alloc_shared below adopts them (a
+                    # copy instead of a recompute)
+                    self.tier.prefetch(req.prompt)
                 # alloc_shared is its own feasibility check: a prefix
                 # hit can admit where a cold can_alloc would refuse
                 plan = self.cache.alloc_shared(free[0], req.prompt)
@@ -579,12 +622,23 @@ class InferenceServer:
         victim = max(running, key=lambda i: self._slot_admit[i])
         req = self._slot_req[victim]
         req.preemptions += 1
-        req._tev("preempt", slot=victim, n=req.preemptions)
+        # with a tier attached this is a SPILL preemption: the
+        # victim's registered prefix demotes to the host tier below,
+        # so re-admission costs a restore copy instead of a recompute
+        # — a tiered-latency event, not the preemption cliff
+        spill = self.tier is not None
+        if spill:
+            self.spill_preemptions += 1
+        else:
+            self.preemptions += 1
+        req._tev("preempt", slot=victim, n=req.preemptions,
+                 spill=spill)
         if telemetry._ENABLED:
-            telemetry.inc("serving_preemptions_total")
+            telemetry.inc("serving_spill_preemptions_total" if spill
+                          else "serving_preemptions_total")
         if _fl._ENABLED:
             _fl.record("sched", "serving.preempt", request=req.id,
-                       slot=victim, n=req.preemptions)
+                       slot=victim, n=req.preemptions, spill=spill)
         if self.max_preemptions is not None \
                 and req.preemptions > self.max_preemptions:
             # retry budget exhausted: fail the request terminally
@@ -594,6 +648,11 @@ class InferenceServer:
         req.state = _QUEUED
         req.output_tokens = []          # greedy rerun is identical
         self._evict(victim)
+        if spill:
+            # demote every parked prefix NOW (the victim's prompt
+            # chain included): the freed blocks become genuinely
+            # reusable while the content stays restorable
+            self.tier.spill_parked()
         self.queue.appendleft(req)
         return True
 
@@ -966,6 +1025,14 @@ class InferenceServer:
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
         self._forecaster.add(now, self.cache.num_free_blocks)
+        if self.tier is not None \
+                and self.tier.spill_exhaust_s is not None:
+            # the forecaster's exhaust signal is the spill TRIGGER:
+            # under forecast pressure, demote parked prefixes ahead of
+            # the preemption cliff (spill-ahead)
+            eta = self._forecaster.exhaust_in_s()
+            if eta is not None and eta < self.tier.spill_exhaust_s:
+                self.tier.spill_parked(self.tier.spill_batch)
         if _gp._ENABLED:
             _gp.note_tokens("serve", net_new)
             _gp.publish()
@@ -1027,6 +1094,11 @@ class InferenceServer:
         eta = self._forecaster.exhaust_in_s()
         if eta is not None:
             telemetry.set_gauge("serving_kv_exhaust_in_s", eta)
+        if self.tier is not None:
+            telemetry.set_gauge("serving_tier_host_blocks",
+                                self.tier.host_blocks())
+            for t, v in self.tier.hit_rates().items():
+                telemetry.set_gauge("serving_tier_hit_rate", v, tier=t)
         if self._spec is not None and self._spec_window:
             prop = sum(p for _, p in self._spec_window)
             if prop:
@@ -1085,6 +1157,50 @@ class InferenceServer:
                 return True
         return False
 
+    # -- KV tier hierarchy ---------------------------------------------------
+
+    def warm_tier(self):
+        """Compile the spill/restore executable pair ahead of traffic
+        (one round-trip through scratch block 0 — content unchanged).
+        Fleet workers call this at warmup so tier adoption on a
+        serving replica costs ZERO extra compiles."""
+        if self.tier is None:
+            return
+        bundle = self.programs["spill_block"](
+            self.cache.pages, jnp.asarray(0, jnp.int32))
+        self.cache.pages = self.programs["restore_block"](
+            self.cache.pages, bundle, jnp.asarray(0, jnp.int32))
+
+    def export_prefix(self, prompt_ids) -> Optional[str]:
+        """Serialize the resident KV chain covering `prompt_ids` to
+        the wire format (prefill→decode block streaming: the payload a
+        decode replica adopts via :meth:`adopt_wire_blocks`). Returns
+        None when tiering is off or nothing of the prefix is
+        resident."""
+        if self.tier is None:
+            return None
+        if isinstance(prompt_ids, NDArray):
+            prompt_ids = prompt_ids.asnumpy()
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        return self.tier.export_chain(prompt)
+
+    def adopt_wire_blocks(self, wire: str) -> int:
+        """Adopt streamed KV blocks (digest-verified) into the host
+        tier; the next matching admit restores them through the
+        restore executable + alloc_shared. Returns blocks adopted."""
+        if self.tier is None or not wire:
+            return 0
+        return self.tier.adopt_wire(wire)
+
+    def persist_prefixes(self) -> int:
+        """Write the resident prefix chains to the disk store (no-op
+        without ``prefix_store_dir``). Also called from
+        :meth:`begin_drain` and :meth:`shutdown`, so rolling restarts
+        come back warm."""
+        if self.tier is None:
+            return 0
+        return self.tier.persist()
+
     # -- graceful teardown --------------------------------------------------
 
     def begin_drain(self):
@@ -1094,6 +1210,7 @@ class InferenceServer:
         non-blocking half of :meth:`drain` — a fleet router uses it to
         stop routing at a replica while it finishes in-flight work."""
         self._draining = True
+        self.persist_prefixes()
 
     def end_drain(self):
         """Reopen admission after :meth:`begin_drain` (a cancelled
@@ -1139,6 +1256,9 @@ class InferenceServer:
                 self._finish(slot, "shutdown", status=_REJECTED)
         while self.queue:
             self._terminate(self.queue.popleft(), "shutdown", _REJECTED)
+        # warm-restart path: the evicted slots' prefixes just parked,
+        # so this persist captures the full resident chain set
+        self.persist_prefixes()
         self._shutdown = True
         self._update_gauges()
 
@@ -1175,26 +1295,30 @@ class InferenceServer:
             if self._prefilling[i]:
                 backlog += len(self._slot_req[i].prompt) \
                     - int(self._prefill_pos[i])
-        return {"ok": ok, "reason": reason,
-                "prefill_backlog_tokens": int(backlog),
-                "prefill_chunk_tokens": self.prefill_chunk_tokens or 0,
-                "speculative": self._spec is not None,
-                "draining": self._draining,
-                "shutdown": self._shutdown,
-                "stalled": self._stalled,
-                "queue_age_p50_s":
-                    float(np.percentile(ages, 50)) if ages else 0.0,
-                "queue_age_p95_s":
-                    float(np.percentile(ages, 95)) if ages else 0.0,
-                "blocks_free": self.cache.num_free_blocks,
-                "kv_fragmentation": self.cache.fragmentation(),
-                "exhaust_in_s": self._forecaster.exhaust_in_s(),
-                "queued": len(self.queue),
-                "active": int(self._active.sum()),
-                "slots": self.batch_slots,
-                "block_size": self.block_size,
-                "max_prompt_len": self.max_prompt_len,
-                "max_len": self.max_len}
+        out = {"ok": ok, "reason": reason,
+               "prefill_backlog_tokens": int(backlog),
+               "prefill_chunk_tokens": self.prefill_chunk_tokens or 0,
+               "speculative": self._spec is not None,
+               "draining": self._draining,
+               "shutdown": self._shutdown,
+               "stalled": self._stalled,
+               "queue_age_p50_s":
+                   float(np.percentile(ages, 50)) if ages else 0.0,
+               "queue_age_p95_s":
+                   float(np.percentile(ages, 95)) if ages else 0.0,
+               "blocks_free": self.cache.num_free_blocks,
+               "kv_fragmentation": self.cache.fragmentation(),
+               "exhaust_in_s": self._forecaster.exhaust_in_s(),
+               "queued": len(self.queue),
+               "active": int(self._active.sum()),
+               "slots": self.batch_slots,
+               "block_size": self.block_size,
+               "max_prompt_len": self.max_prompt_len,
+               "max_len": self.max_len,
+               "tiering": self.tier is not None}
+        if self.tier is not None:
+            out["tier_host_blocks"] = self.tier.host_blocks()
+        return out
 
     def _assemble_trace(self, req: Request) -> dict:
         """The span timeline + derived latency breakdown for one traced
@@ -1277,6 +1401,14 @@ class InferenceServer:
         if v is not None:
             out["verify_compiles"] = v.compiles
             out["verify_calls"] = v.calls
+        s = self.programs.get("spill_block")
+        r = self.programs.get("restore_block")
+        if s is not None:
+            out["spill_compiles"] = s.compiles
+            out["spill_calls"] = s.calls
+        if r is not None:
+            out["restore_compiles"] = r.compiles
+            out["restore_calls"] = r.calls
         return out
 
     def stats(self) -> dict:
@@ -1300,6 +1432,8 @@ class InferenceServer:
                 "active": int(self._active.sum()),
                 "prefilling": int(self._prefilling.sum()),
                 "prefills_skipped": self.prefills_skipped,
+                "preemptions": self.preemptions,
+                "spill_preemptions": self.spill_preemptions,
                 "spec_tokens_accepted": self.spec_tokens_accepted,
                 "spec_tokens_rejected": self.spec_tokens_rejected,
                 "draft_accept_rate":

@@ -239,6 +239,18 @@ def test_goodput_module_is_scanned_and_clean():
     assert _violations(path) == []
 
 
+def test_kv_tier_module_is_scanned_and_clean():
+    """The KV tier manager instruments every spill/restore/stream/
+    persist with counters, histograms, AND goodput ledger charges —
+    all funneled through the `_note_*` hooks, which must gate on the
+    module flags (they double as the --telemetry-overhead B-side
+    no-op targets). The module must be inside the lint's walk and
+    free of ungated sites."""
+    path = os.path.join(PKG, "serving", "kv_tier.py")
+    assert path in _module_files(), "kv_tier.py missing from lint walk"
+    assert _violations(path) == []
+
+
 def test_speculative_module_is_scanned_and_clean():
     """Draft proposers run on the host inside the decode tick; the
     module must stay telemetry-free (accept-rate accounting lives in
