@@ -300,6 +300,7 @@ def reset() -> None:
     _LAST_MFU = None
     _LAST_HFU = None
     _PEAK_CACHE = None
+    _PLAN_AXES.clear()
     with _PUB_LOCK:
         _LAST_PUB.clear()
 
@@ -391,6 +392,21 @@ def _peak_flops() -> Tuple[float, str]:
     return _PEAK_CACHE
 
 
+#: active ParallelPlan axis sizes — the MFU/HFU gauges carry them as
+#: labels so plan choices are comparable across BENCH rounds
+_PLAN_AXES: Dict[str, str] = {}
+
+
+def set_plan_axes(dp: int = 1, tp: int = 1, pp: int = 1,
+                  ep: int = 1) -> None:
+    """Record the active parallel plan's mesh-axis sizes (set by the
+    FusedTrainStep builders / ``ParallelPlan.lower``); every subsequent
+    ``note_train_step`` labels its MFU/HFU gauges with them."""
+    _PLAN_AXES.clear()
+    _PLAN_AXES.update(dp=str(int(dp)), tp=str(int(tp)),
+                      pp=str(int(pp)), ep=str(int(ep)))
+
+
 def note_train_step(step_s: float, model_flops: Optional[float] = None,
                     hw_flops: Optional[float] = None) -> None:
     """Publish MFU/HFU for one train step.
@@ -398,7 +414,8 @@ def note_train_step(step_s: float, model_flops: Optional[float] = None,
     ``model_flops`` is the analytic 6·N·D estimate (MFU numerator);
     ``hw_flops`` is the traced ``cost_analysis()`` count, which
     includes rematerialization (HFU numerator). Either sticks for
-    subsequent steps once seen.
+    subsequent steps once seen. Gauges carry the active plan's axis
+    sizes as labels (see :func:`set_plan_axes`).
     """
     global _MODEL_FLOPS, _HW_FLOPS, _LAST_MFU, _LAST_HFU
     if not _ENABLED:
@@ -414,12 +431,13 @@ def note_train_step(step_s: float, model_flops: Optional[float] = None,
     if _MODEL_FLOPS > 0:
         _LAST_MFU = _MODEL_FLOPS / denom
         _tm.set_gauge("goodput_mfu", _LAST_MFU,
-                      flops_source="analytic", peak_source=peak_src)
+                      flops_source="analytic", peak_source=peak_src,
+                      **_PLAN_AXES)
     if _HW_FLOPS > 0:
         _LAST_HFU = _HW_FLOPS / denom
         _tm.set_gauge("goodput_hfu", _LAST_HFU,
                       flops_source="cost_analysis",
-                      peak_source=peak_src)
+                      peak_source=peak_src, **_PLAN_AXES)
 
 
 def note_hbm_watermark(name: str, jit_fn, args) -> None:

@@ -10,6 +10,9 @@ def __getattr__(name):
     # heavier submodules load lazily to keep `import mxnet_tpu` light
     import importlib
     if name in ("data_parallel", "tensor_parallel", "pipeline",
-                "ring_attention", "moe", "multihost"):
+                "ring_attention", "moe", "multihost", "plan"):
         return importlib.import_module(f".{name}", __name__)
+    if name in ("ParallelPlan", "PlanError"):
+        mod = importlib.import_module(".plan", __name__)
+        return getattr(mod, name)
     raise AttributeError(name)

@@ -205,10 +205,18 @@ class FusedTrainStep:
                  dp_axis: str = "dp", donate: bool = True,
                  n_model_inputs: int = 1, grad_accum: int = 1,
                  compression=None, zero1: bool = False, zero=None,
-                 pipeline=None, pp_axis: str = "pp"):
+                 pipeline=None, pp_axis: str = "pp", plan=None,
+                 virtual: int = 1):
         from ..gluon.trainer import Trainer
         self.net = net
         self.loss_fn = loss_fn
+        # plan mode: a validated ParallelPlan drives the composition —
+        # the legacy warn-once degrade matrices below are BYPASSED
+        # (the plan already rejected every unfusable combination loudly)
+        # and the plan's extra axes (tp/ep manual modes, interleaved
+        # virtual stages, real pp x zero=3) unlock in the builders
+        self._plan = plan
+        self.virtual = max(1, int(virtual))
         if isinstance(trainer, Trainer):
             self.optimizer = trainer._optimizer
             self._trainer = trainer
@@ -284,32 +292,39 @@ class FusedTrainStep:
         self.pp_axis = pp_axis
         # degrade matrix for the widened wire-compression config: each
         # unfusable combination warns ONCE (at construction) and runs
-        # without the requested compression rather than failing the run
+        # without the requested compression rather than failing the run.
+        # The warnings diagnose; the REQUEST itself is kept — builders
+        # resolve it against what each build actually puts on the wire,
+        # so a config forwarded through Trainer(pipeline=M) cannot be
+        # silently dropped before the pipeline builder ever sees it.
+        # Under plan mode the ParallelPlan already rejected these.
         import warnings as _warnings
-        if self._wire_weights is not None and self.zero_stage == 0:
-            _warnings.warn(
-                "compression={'weights': ...} requested without ZeRO "
-                "(zero=0): there is no weight all-gather on the wire "
-                "to compress — training with uncompressed weights",
-                RuntimeWarning, stacklevel=2)
-            self._wire_weights = None
-        if self._wire_weights is not None and \
-                self._wire_weights["residual"] and self.zero_stage != 3:
-            _warnings.warn(
-                "weight-compression residual mode applies to zero=3 "
-                "(resident shards re-gathered every step); under "
-                f"zero={self.zero_stage} the gather source is already "
-                "the exact post-update shard — ignoring residual=True",
-                RuntimeWarning, stacklevel=2)
-            self._wire_weights = dict(self._wire_weights,
-                                      residual=False)
-        if self._wire_acts is not None and self.pipeline is None:
-            _warnings.warn(
-                "compression={'activations': ...} requested without "
-                "pipeline=M: there are no activation ppermute hops to "
-                "compress — ignoring the activations entry",
-                RuntimeWarning, stacklevel=2)
-            self._wire_acts = None
+        if plan is None:
+            if self._wire_weights is not None and self.zero_stage == 0:
+                _warnings.warn(
+                    "compression={'weights': ...} requested without ZeRO "
+                    "(zero=0): there is no weight all-gather on the wire "
+                    "to compress — training with uncompressed weights",
+                    RuntimeWarning, stacklevel=2)
+                self._wire_weights = None
+            if self._wire_weights is not None and \
+                    self._wire_weights["residual"] and \
+                    self.zero_stage != 3:
+                _warnings.warn(
+                    "weight-compression residual mode applies to zero=3 "
+                    "(resident shards re-gathered every step); under "
+                    f"zero={self.zero_stage} the gather source is already "
+                    "the exact post-update shard — ignoring residual=True",
+                    RuntimeWarning, stacklevel=2)
+                self._wire_weights = dict(self._wire_weights,
+                                          residual=False)
+            if self._wire_acts is not None and self.pipeline is None:
+                _warnings.warn(
+                    "compression={'activations': ...} requested without "
+                    "pipeline=M: there are no activation ppermute hops "
+                    "to compress — ignoring the activations entry",
+                    RuntimeWarning, stacklevel=2)
+                self._wire_acts = None
         # static per-step (logical, wire) byte totals for the quantized
         # gather/permute directions — filled by the builders, flushed
         # to the comm_bytes_{gathered,permuted} counters per step
@@ -317,6 +332,9 @@ class FusedTrainStep:
         self._wire_permuted = None
         self._pp_staged = None
         self._pp_mask = None
+        self._pp_flat_meta = None   # pp x zero=3: {name: (numel, padded, ssz)}
+        self._pp_full_shapes = None  # pp x zero=3: {name: stacked shape}
+        self._pp_total_ticks = None  # interleaved schedule length
         self._compiled = None
         self._params = None
         self._tr = None
@@ -335,6 +353,9 @@ class FusedTrainStep:
         self._loop_cache = {}
         self._loop_streak = 0
         self._loop_warned = False
+        # run_steps double buffer: the NEXT window's device-resident
+        # (ids, raw, stacked) staged while the current window runs
+        self._feed_staged = None
         import weakref
         from .. import profiler as _prof
         ref = weakref.ref(self)
@@ -379,9 +400,20 @@ class FusedTrainStep:
         ZeRO-3 flat weight shards gather and unflatten per bucket — the
         checkpoint is full-size and replica-count portable."""
         if self._pp_staged is not None:
-            self._pp_staged.unstack_into_net(
-                {n: _unshard(self._tr[n])
-                 for n in self._pp_staged.param_names})
+            if self._pp_flat_meta is not None:
+                # pp x zero=3: residents are flat padded per-stage
+                # shards — unpad and reshape to the stacked layout
+                full = {}
+                for n in self._pp_staged.param_names:
+                    numel = self._pp_flat_meta[n][0]
+                    flat = _unshard(self._tr[n])
+                    full[n] = flat[:, :numel].reshape(
+                        self._pp_full_shapes[n])
+                self._pp_staged.unstack_into_net(full)
+            else:
+                self._pp_staged.unstack_into_net(
+                    {n: _unshard(self._tr[n])
+                     for n in self._pp_staged.param_names})
             return
         if self._zero3:
             from .. import multi_tensor as _mt
@@ -406,8 +438,21 @@ class FusedTrainStep:
             else self.net.collect_params()
         if self._pp_staged is not None:
             restacked = self._pp_staged.restack()
-            self._tr = {n: _global_put(restacked[n], self._tr_sh[n])
-                        for n in self._pp_staged.param_names}
+            if self._pp_flat_meta is not None:
+                new_tr = {}
+                for n in self._pp_staged.param_names:
+                    numel, padded, _ssz = self._pp_flat_meta[n]
+                    flat = restacked[n].reshape(
+                        restacked[n].shape[0], -1)
+                    if padded > numel:
+                        flat = jnp.pad(flat,
+                                       ((0, 0), (0, padded - numel)))
+                    new_tr[n] = _global_put(flat, self._tr_sh[n])
+                self._tr = new_tr
+            else:
+                self._tr = {n: _global_put(restacked[n],
+                                           self._tr_sh[n])
+                            for n in self._pp_staged.param_names}
             return
         if self._zero3:
             from .. import multi_tensor as _mt
@@ -497,12 +542,13 @@ class FusedTrainStep:
                 "hybrid_mesh(dp=..., pp=...) to pipeline",
                 RuntimeWarning, stacklevel=3)
             if self._wire_acts is not None:
+                # diagnose only — the REQUEST survives, so a later
+                # rebuild on a pp mesh still compresses its hops
                 warnings.warn(
                     "activation wire compression requested but the "
                     "pipeline fell back to the plain step — no "
                     "inter-stage hops exist; ignoring the "
                     "'activations' entry", RuntimeWarning, stacklevel=3)
-                self._wire_acts = None
         with use_mesh(self.mesh):
             entry = self.net.trace_entry(
                 list(args[:self.n_model_inputs]), training=True)
@@ -780,12 +826,28 @@ class FusedTrainStep:
         from .compression import compressed_psum_scatter
         from ..gluon.contrib import SyncBatchNorm
 
+        plan = self._plan
+        ep_on = plan is not None and getattr(plan, "ep", 1) > 1
+        # ep(MoE) sharing the dp axis: expert parameters (leading dim
+        # sharded over dp) stay OUT of the flat buckets — each rank
+        # holds its own experts' weights, grads and optimizer state
+        # locally, and the forward does the token exchange explicitly
+        # (MoEMLP manual mode). Everything else buckets as usual.
+        ep_names = set()
         for n in tr_names:
-            if self._params[n].sharding is not None:
-                raise ValueError(
-                    "zero1 shards the weight update over flat dp "
-                    f"buckets; parameter {n!r} carries a TP sharding. "
-                    "Drop zero1= or the tensor-parallel spec")
+            sh = self._params[n].sharding
+            if sh is None:
+                continue
+            if ep_on and len(sh) >= 1 and sh[0] == self.dp_axis:
+                ep_names.add(n)
+                continue
+            raise ValueError(
+                "zero1 shards the weight update over flat dp "
+                f"buckets; parameter {n!r} carries a TP sharding. "
+                "Drop zero1= or the tensor-parallel spec "
+                "(expert parallelism composes through "
+                "ParallelPlan(ep=..., zero=1) with the expert axis "
+                "on the dp mesh axis)")
 
         def _blocks(b):
             yield b
@@ -837,6 +899,8 @@ class FusedTrainStep:
         # save/restore
         groups, order = {}, []
         for i, n in enumerate(tr_names):
+            if n in ep_names:
+                continue
             w = self._tr[n]
             probe = jax.eval_shape(
                 lambda i=i, w=w: opt.create_state(
@@ -852,6 +916,37 @@ class FusedTrainStep:
 
         shard = NamedSharding(mesh, P(dp))
         repl = NamedSharding(mesh, P())
+        if ep_names:
+            # the plan already restricted ep x zero to stage 1 with an
+            # elementwise optimizer and no grad compression; the local
+            # expert update additionally needs E % dp == 0 and weight-
+            # shaped state leaves (sharded along the expert dim)
+            if self.zero_stage >= 2 or scheme is not None:
+                raise ValueError(
+                    "expert parallelism under ZeRO supports zero=1 "
+                    "without gradient compression")
+            for n in sorted(ep_names):
+                E = self._tr[n].shape[0]
+                if E % ndp:
+                    raise ValueError(
+                        f"expert parameter {n!r} has {E} experts, not "
+                        f"divisible by the dp/ep axis size {ndp}")
+                probe = jax.eval_shape(
+                    lambda n=n: opt.create_state(
+                        0, _mt._FlatWeight(jax.ShapeDtypeStruct(
+                            self._tr[n].shape,
+                            jnp.dtype(self._tr[n].dtype)))))
+                for leaf in jax.tree_util.tree_leaves(probe):
+                    if tuple(leaf.shape) != tuple(self._tr[n].shape):
+                        raise ValueError(
+                            f"optimizer state for expert parameter "
+                            f"{n!r} is not weight-shaped "
+                            f"({leaf.shape}); expert-local updates "
+                            "need an elementwise optimizer")
+        ep_shard_specs = {}
+        for n in sorted(ep_names):
+            ep_shard_specs[n] = P(dp, *([None] *
+                                        (self._tr[n].ndim - 1)))
 
         class _Grp:
             __slots__ = ("names", "plans", "padded", "segs", "treedef")
@@ -900,6 +995,11 @@ class FusedTrainStep:
                         jax.tree_util.tree_unflatten(
                             treedef, [per_leaf[L][j]
                                       for L in range(nleaf)])
+            for n in sorted(ep_names):
+                # expert state shards along the expert (dp) dim —
+                # weight-shaped leaves, so the P(dp) prefix applies
+                new_states[n] = jax.tree_util.tree_map(
+                    lambda v: _global_put(v, shard), self._states[n])
         self._states = new_states
         state_keys = [_skey(gi, j) for gi, g in enumerate(grp_list)
                       for j in range(len(g.plans))]
@@ -1002,6 +1102,17 @@ class FusedTrainStep:
                 loss, new_aux, red = sharded_accum_grads(
                     tr, aux, key, batch)
                 new_resid = {}
+            elif ep_names:
+                # manual-ep region: MoE layers see their LOCAL expert
+                # shards and exchange tokens with explicit all_gathers;
+                # the all_gather VJP (psum) already sums each expert's
+                # grad over every rank's loss shard, so expert grads
+                # only need the 1/N loss-mean scale, no reduce
+                from .mesh import manual_axes as _ma
+                with _ma({"ep": dp}):
+                    loss, new_aux, grads = local_grads(tr, aux, key,
+                                                       batch)
+                red, new_resid = _reduce_shards(grads, resid)
             else:
                 loss, new_aux, grads = local_grads(tr, aux, key, batch)
                 red, new_resid = _reduce_shards(grads, resid)
@@ -1009,6 +1120,11 @@ class FusedTrainStep:
             # a distinct 1/N slice; pad lanes are zero)
             gn2 = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
                       for v in red.values())
+            if ep_names:
+                gn2 = gn2 + sum(
+                    jnp.sum(jnp.square(
+                        (grads[n] / ndp).astype(jnp.float32)))
+                    for n in sorted(ep_names))
             gnorm = jnp.sqrt(lax.psum(gn2, dp))
             loss = lax.pmean(loss, dp)
             new_aux = {n: lax.pmean(v, dp)
@@ -1046,6 +1162,14 @@ class FusedTrainStep:
                     for n, w in zip(g.names, _mt.unflatten_buckets(
                             full, g.plans, len(g.names))):
                         new_tr[n] = w
+            for n in sorted(ep_names):
+                # expert-local update: this rank's experts, complete
+                # grads (see above), shard-resident state — never
+                # gathered
+                nw, nst = opt._step(tr[n], grads[n] / ndp, states[n],
+                                    hyper)
+                new_tr[n] = nw
+                new_states[n] = nst
             out = (loss, gnorm, new_tr, new_aux, new_states)
             if has_resid:
                 return out + ({**new_resid, **new_wres},)
@@ -1055,9 +1179,17 @@ class FusedTrainStep:
             _np.ndim(a._data if isinstance(a, NDArray) else a), 0, dp)
             for a in args)
         st_spec = {k: P(dp) for k in state_keys}
+        st_spec.update({n: ep_shard_specs[n] for n in sorted(ep_names)})
+        state_keys = state_keys + sorted(ep_names)
         z3_keys = [_sk3(gi, j) for gi, g in enumerate(grp_list)
                    for j in range(len(g.plans))]
-        tr_spec = {k: P(dp) for k in z3_keys} if z3 else P()
+        if z3:
+            tr_spec = {k: P(dp) for k in z3_keys}
+        elif ep_names:
+            tr_spec = {n: ep_shard_specs.get(n, P())
+                       for n in tr_names}
+        else:
+            tr_spec = P()
         in_specs = (tr_spec, P(), st_spec, P(), P())
         out_specs = (P(), tr_spec, P(), st_spec)
         loop_out_specs = (P(), P()) + out_specs[1:]
@@ -1126,7 +1258,8 @@ class FusedTrainStep:
                     new_tr[_sk3(gi, j)] = _global_put(b, shard)
             self._tr = new_tr
         else:
-            self._tr = {n: _global_put(v, repl)
+            self._tr = {n: _global_put(v, shard if n in ep_names
+                                       else repl)
                         for n, v in self._tr.items()}
         self._aux = {n: _global_put(v, repl)
                      for n, v in self._aux.items()}
@@ -1170,7 +1303,8 @@ class FusedTrainStep:
         # zero1 state keys (and zero3 weight keys) are bucket ids,
         # sharded over dp
         self._tr_sh = ({k: shard for k in z3_keys} if z3
-                       else {n: repl for n in tr_names})
+                       else {n: shard if n in ep_names else repl
+                             for n in tr_names})
         self._aux_sh = {n: repl for n in aux_names}
         self._st_sh = {k: jax.tree_util.tree_map(lambda _: shard,
                                                  self._states[k])
@@ -1223,6 +1357,11 @@ class FusedTrainStep:
         accum = self.grad_accum
         opt = self.optimizer
         loss_fn = self.loss_fn
+        plan = self._plan
+        virt = self.virtual
+        tpx = getattr(plan, "tp_axis", "tp")
+        manual_tp = plan is not None and getattr(plan, "tp", 1) > 1
+        ntp = axis_size(mesh, tpx) if manual_tp else 1
 
         if self.n_model_inputs != 1 or len(args) != 2:
             raise ValueError(
@@ -1230,10 +1369,26 @@ class FusedTrainStep:
                 f"model input; got n_model_inputs={self.n_model_inputs}"
                 f", {len(args)} args")
         for n in self._tr_names:
-            if self._params[n].sharding is not None:
+            sh = self._params[n].sharding
+            if sh is None:
+                continue
+            if not manual_tp:
                 raise ValueError(
                     "pipeline stages shard over the pp axis; parameter "
-                    f"{n!r} carries a TP sharding — drop one of them")
+                    f"{n!r} carries a TP sharding — drop one of them "
+                    "(pp x tp composes through ParallelPlan(pp=..., "
+                    "tp=...))")
+            axes = set()
+            for e in sh:
+                if isinstance(e, str):
+                    axes.add(e)
+                elif e is not None:
+                    axes.update(e)
+            if axes - {tpx}:
+                raise ValueError(
+                    f"ParallelPlan pipeline: parameter {n!r} sharding "
+                    f"{sh} mentions axes {sorted(axes - {tpx})} beyond "
+                    f"the plan's tp axis {tpx!r}")
         if self._aux_names:
             raise ValueError(
                 "pipeline=M requires a stateless net (no aux params "
@@ -1242,10 +1397,33 @@ class FusedTrainStep:
         x0 = args[0]
         x0 = x0 if isinstance(x0, NDArray) else NDArray(jnp.asarray(x0))
         with use_mesh(None):
-            staged = _pl.pipeline_stages(self.net, npp, sample=x0)
+            staged = _pl.pipeline_stages(self.net, npp, sample=x0,
+                                         virtual=virt)
         self._pp_staged = staged
         names = staged.param_names
         s = staged.num_slots
+        # interleaved virtual stages: one host-precomputed tick table
+        # drives the whole schedule (chunk index stays traced — one
+        # executable per plan signature)
+        sched = _pl.interleaved_schedule(npp, virt, M) if virt > 1 \
+            else None
+        # per-canonical-name TP sharding (manual mode): every block
+        # carries the same Parameter specs by the identical-structure
+        # staging contract
+        tp_sharding = {}
+        if manual_tp:
+            for k in names:
+                shs = {tuple(bp[k].sharding) if bp[k].sharding
+                       is not None else None
+                       for bp in staged._block_params}
+                if len(shs) != 1:
+                    raise ValueError(
+                        f"ParallelPlan pipeline: parameter {k!r} has "
+                        f"inconsistent TP shardings across blocks: "
+                        f"{shs}")
+                spec = shs.pop()
+                if spec is not None and any(e is not None for e in spec):
+                    tp_sharding[k] = spec
         xr = x0._data
         yr = args[1]._data if isinstance(args[1], NDArray) \
             else jnp.asarray(args[1])
@@ -1257,11 +1435,16 @@ class FusedTrainStep:
         mbsz = B // (ndp * accum * M)
 
         stage = self.zero_stage
-        if stage >= 3:
+        if stage >= 3 and plan is None:
+            # legacy path keeps the historical clamp; a ParallelPlan
+            # runs REAL pp x zero=3 — the stage weights live as flat
+            # (pp, dp)-sharded buckets, gathered transiently at step
+            # entry and emitted as shards after the update
             warnings.warn(
                 "pipeline + zero=3 is clamped to zero=2: stage-stacked "
                 "weights must stay resident for checkpoint restacking; "
-                "grads and optimizer state still shard over dp",
+                "grads and optimizer state still shard over dp "
+                "(ParallelPlan(pp=..., zero=3) runs the real thing)",
                 RuntimeWarning, stacklevel=3)
             stage = 2
         if stage >= 1 and not _mt.is_elementwise_rule(opt):
@@ -1319,7 +1502,8 @@ class FusedTrainStep:
         ascheme = acfg["type"] if acfg is not None else None
         ablock = acfg["block"] if acfg is not None else None
         awire = (ascheme, ablock) if ascheme is not None else None
-        if wscheme is not None or ascheme is not None:
+        z3 = stage >= 3
+        if wscheme is not None or ascheme is not None or z3:
             from .compression import quantized_all_gather, wire_nbytes
 
         # loss dtype probe (the 1F1B accumulator matches it — bf16
@@ -1423,7 +1607,23 @@ class FusedTrainStep:
         def body(tr, mask_l, states_l, hyper, key, resid, xb, yb):
             # local views: tr leaves (1, s, *shape) -> (s, *shape);
             # zero states (1, ssz) -> (ssz,); mask (1, s) -> (s,)
-            params = {n: tr[n][0] for n in names}
+            rank = lax.axis_index(dp) if ndp > 1 else 0
+            if z3:
+                # transient gather: resident (1, ssz) flat shards
+                # become full stage weights only inside the executable
+                params = {}
+                for n in names:
+                    w_sh = tr[n][0]
+                    if wscheme is not None:
+                        wf = quantized_all_gather(w_sh, dp, wscheme,
+                                                  wblock)
+                    else:
+                        wf = lax.all_gather(w_sh, dp, axis=0,
+                                            tiled=True)
+                    params[n] = wf[:flat_meta[n][0]].reshape(
+                        stacked[n].shape[1:])
+            else:
+                params = {n: tr[n][0] for n in names}
             params["__mask__"] = mask_l[0]
             states_ = {n: jax.tree_util.tree_map(lambda v: v[0],
                                                  states_l[n])
@@ -1431,8 +1631,21 @@ class FusedTrainStep:
             if ndp > 1:
                 key = jax.random.fold_in(key, lax.axis_index(dp))
             key = jax.random.fold_in(key, lax.axis_index(ppx))
-            rank = lax.axis_index(dp) if ndp > 1 else 0
             stage_fn = staged.make_stage_fn(jax.random.fold_in(key, 1))
+            if manual_tp:
+                # manual-TP context: the blocks' forwards re-execute at
+                # trace time, see the flag, and issue local matmuls +
+                # explicit psum(tp) instead of GSPMD constraints
+                from .mesh import manual_axes as _manual_axes
+                base_fn = stage_fn
+                if virt > 1:
+                    def stage_fn(p, c, h):
+                        with _manual_axes({"tp": tpx}):
+                            return base_fn(p, c, h)
+                else:
+                    def stage_fn(p, h):
+                        with _manual_axes({"tp": tpx}):
+                            return base_fn(p, h)
             mb_loss = _mb_loss(key)
 
             def run_pipe(xc, yc):
@@ -1440,9 +1653,14 @@ class FusedTrainStep:
                 microbatch loss and the mean local grads (stacked)."""
                 mbs = xc.reshape(M, mbsz, *xc.shape[1:])
                 ybs = yc.reshape(M, mbsz, *yc.shape[1:])
-                loss_sum, grads = _pl._1f1b_local(
-                    params, mbs, ybs, stage_fn, mb_loss, ppx,
-                    loss_dtype=ld, wire=awire)
+                if sched is not None:
+                    loss_sum, grads = _pl._1f1b_interleaved_local(
+                        params, mbs, ybs, stage_fn, mb_loss, ppx,
+                        sched, loss_dtype=ld, wire=awire)
+                else:
+                    loss_sum, grads = _pl._1f1b_local(
+                        params, mbs, ybs, stage_fn, mb_loss, ppx,
+                        loss_dtype=ld, wire=awire)
                 loss_sum = lax.psum(loss_sum, ppx)  # lives on last stage
                 grads = {n: grads[n] / M for n in names}
                 return loss_sum / M, grads
@@ -1490,8 +1708,16 @@ class FusedTrainStep:
             # global grad norm: each pp rank holds its stage's slice of
             # `red` (full stacked for stage 0, 1/ndp flat shards under
             # zero) — sum locally, psum across the axes that partition
-            gn2 = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
-                      for v in red.values())
+            if manual_tp and tp_sharding:
+                gn2 = sum(jnp.sum(jnp.square(
+                    red[n].astype(jnp.float32)))
+                    for n in names if n not in tp_sharding)
+                gn2 = gn2 + lax.psum(sum(
+                    jnp.sum(jnp.square(red[n].astype(jnp.float32)))
+                    for n in tp_sharding), tpx)
+            else:
+                gn2 = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                          for v in red.values())
             gn2 = lax.psum(gn2, ppx)
             if stage >= 1:
                 gn2 = lax.psum(gn2, dp)
@@ -1501,43 +1727,78 @@ class FusedTrainStep:
             if stage == 0:
                 # per-slot vmap: norm-based rules see each block's own
                 # tensor, exactly like the unpipelined per-name loop
+                # (interleaved runs fold the virtual dim into it)
                 def upd(w, g, st):
                     return opt._step(w, g, st, hyper)
                 for n in names:
-                    nw, nst = jax.vmap(upd)(params[n], red[n],
-                                            states_[n])
+                    w, g, st = params[n], red[n], states_[n]
+                    if virt > 1:
+                        nw, nst = jax.vmap(upd)(
+                            w.reshape((-1,) + w.shape[2:]),
+                            g.reshape((-1,) + g.shape[2:]),
+                            jax.tree_util.tree_map(
+                                lambda v: v.reshape((-1,) + v.shape[2:]),
+                                st))
+                        nw = nw.reshape(w.shape)
+                        nst = jax.tree_util.tree_map(
+                            lambda v, o: v.reshape(o.shape), nst, st)
+                    else:
+                        nw, nst = jax.vmap(upd)(w, g, st)
                     new_tr[n] = nw[None]
                     new_states[n] = jax.tree_util.tree_map(
                         lambda v: v[None], nst)
             else:
                 for n in names:
                     numel, padded, ssz = flat_meta[n]
-                    wf = _pad_flat(params[n], padded)
-                    w_sh = lax.dynamic_slice(wf, (rank * ssz,), (ssz,))
+                    if z3:
+                        w_sh = tr[n][0]
+                    else:
+                        wf = _pad_flat(params[n], padded)
+                        w_sh = lax.dynamic_slice(wf, (rank * ssz,),
+                                                 (ssz,))
                     nw, nst = opt._step(w_sh, red[n], states_[n],
                                         hyper)
-                    if wscheme is not None:
-                        full = quantized_all_gather(nw, dp, wscheme,
-                                                    wblock)
+                    if z3:
+                        # ZeRO-3: the updated SHARD is the resident
+                        # form — no post-update gather; the next step
+                        # re-gathers at entry
+                        new_tr[n] = nw[None]
                     else:
-                        full = lax.all_gather(nw, dp, axis=0,
-                                              tiled=True)
-                    new_tr[n] = full[:numel].reshape(
-                        stacked[n].shape[1:])[None]
+                        if wscheme is not None:
+                            full = quantized_all_gather(nw, dp, wscheme,
+                                                        wblock)
+                        else:
+                            full = lax.all_gather(nw, dp, axis=0,
+                                                  tiled=True)
+                        new_tr[n] = full[:numel].reshape(
+                            stacked[n].shape[1:])[None]
                     new_states[n] = jax.tree_util.tree_map(
                         lambda v: v[None], nst)
             out = (loss.astype(jnp.float32), gnorm, new_tr, new_states)
             return out + ((new_resid,) if scheme is not None else ())
 
-        pspec = {n: P(ppx, *([None] * (stacked[n].ndim - 1)))
-                 for n in names}
+        def _wspec(n):
+            """Stacked-weight spec: pp on the stage dim; a manual-TP
+            parameter keeps its own axes on the trailing dims; ZeRO-3
+            residents are flat (pp, dp) buckets instead."""
+            if z3:
+                return P(ppx, dp)
+            lead = 1 + (1 if virt > 1 else 0)  # [virtual,] slots
+            if n in tp_sharding:
+                return P(ppx, *([None] * lead), *tp_sharding[n])
+            return P(ppx, *([None] * (stacked[n].ndim - 1)))
+
+        pspec = {n: _wspec(n) for n in names}
         st_spec = {n: jax.tree_util.tree_map(
             lambda _: P(ppx) if stage == 0 else P(ppx, dp), states[n])
             for n in names}
-        # stage-0 state leaves mirror the stacked weight's rank
+        # stage-0 state leaves mirror the stacked weight's rank (and
+        # its manual-TP axes — momentum shards live beside the weight)
         if stage == 0:
             st_spec = {n: jax.tree_util.tree_map(
-                lambda v: P(ppx, *([None] * (v.ndim - 1))), states[n])
+                lambda v, n=n: _wspec(n)
+                if v.ndim == stacked[n].ndim
+                else P(ppx, *([None] * (v.ndim - 1))), states[n])
                 for n in names}
         dpn = dp if ndp > 1 else None
         batch_specs = (split_batch_spec(xr.ndim, 0, dpn),
@@ -1608,8 +1869,17 @@ class FusedTrainStep:
         def _nsh(spec):
             return NamedSharding(mesh, spec)
 
-        self._tr = {n: _global_put(stacked[n], _nsh(pspec[n]))
-                    for n in names}
+        if z3:
+            self._tr = {}
+            for n in names:
+                numel, padded, _ssz = flat_meta[n]
+                flat = stacked[n].reshape(npp, -1)
+                if padded > numel:
+                    flat = jnp.pad(flat, ((0, 0), (0, padded - numel)))
+                self._tr[n] = _global_put(flat, _nsh(pspec[n]))
+        else:
+            self._tr = {n: _global_put(stacked[n], _nsh(pspec[n]))
+                        for n in names}
         self._pp_mask = _global_put(mask, _nsh(P(ppx)))
         self._states = {
             n: jax.tree_util.tree_map(
@@ -1636,11 +1906,20 @@ class FusedTrainStep:
         self._aux = {}
         self.zero_stage = stage
         self._pp_nstages = npp
+        self._pp_virtual = virt
+        self._pp_total_ticks = sched.total_ticks if sched is not None \
+            else None
+        self._pp_flat_meta = flat_meta if z3 else None
+        self._pp_full_shapes = {n: tuple(stacked[n].shape)
+                                for n in names} if z3 else None
+        _gp.set_plan_axes(dp=ndp, tp=ntp, pp=npp,
+                          ep=getattr(plan, "ep", 1)
+                          if plan is not None else 1)
 
         # static wire-vs-logical byte accounting per step, one rank's
         # perspective (mirrors the kvstore counters): the dp weight
         # gather of each stage's flat shards, and the 1F1B activation/
-        # cotangent ppermute hops across all M + 2(n-1) ticks
+        # cotangent ppermute hops across all the schedule's ticks
         if stage >= 1 and ndp > 1:
             lg = wr = 0
             for n in names:
@@ -1653,7 +1932,12 @@ class FusedTrainStep:
         if npp > 1:
             act_elems = mbsz * int(_np.prod(xr.shape[1:]))
             isz = jnp.dtype(xr.dtype).itemsize
-            hops = (M + 2 * (npp - 1)) * 2 * (npp - 1) * accum
+            if sched is not None:
+                # interleaved: both full rings (npp edges) shift every
+                # one of the schedule's measured ticks
+                hops = sched.total_ticks * 2 * npp * accum
+            else:
+                hops = (M + 2 * (npp - 1)) * 2 * (npp - 1) * accum
             lg = hops * act_elems * isz
             wr = hops * wire_nbytes(act_elems, ascheme, ablock) \
                 if ascheme is not None else lg
@@ -1803,9 +2087,11 @@ class FusedTrainStep:
             _tm.mark_phase("fused_step", dt, t0=t0, device=True)
             if self._pp_staged is not None:
                 # attribute the device span to fill/steady/drain and
-                # publish the (n-1)/(M+n-1) bubble_ratio gauge
-                _tm.record_pipeline_step(self._pp_nstages,
-                                         self.pipeline, dt, t0=t0)
+                # publish the measured bubble_ratio gauge
+                _tm.record_pipeline_step(
+                    self._pp_nstages, self.pipeline, dt, t0=t0,
+                    virtual=getattr(self, "_pp_virtual", 1),
+                    total_ticks=self._pp_total_ticks)
             # host-side view of the same span: the eager phases land on
             # pid 0, so the fused step needs a host event there too for
             # a complete per-step host timeline
@@ -1993,8 +2279,20 @@ class FusedTrainStep:
             fn = jax.jit(loop, donate_argnums=donate)
         return {"fn": fn, "fresh": True}
 
+    def _stack_window(self, raw):
+        """Host-stack one K-window to (K, ...) per argument and place
+        it on the mesh (batch dim sharded per `self._batch_sh`)."""
+        stacked = []
+        for j in range(len(raw[0])):
+            s = jnp.stack([raw[i][j] for i in range(len(raw))])
+            if self.mesh is not None:
+                s = _global_put(s, NamedSharding(
+                    self.mesh, P(None, *self._batch_sh[j].spec)))
+            stacked.append(s)
+        return stacked
+
     def run_steps(self, batches, skip_nonfinite=None,
-                  unroll=None) -> NDArray:
+                  unroll=None, next_batches=None) -> NDArray:
         """Run ``len(batches)`` fused steps as ONE ``lax.scan``
         dispatch and return the stacked (K,) per-step losses.
 
@@ -2063,8 +2361,21 @@ class FusedTrainStep:
         from .. import tracing as _tracing
         import time as _time
 
-        raw = [[a._data if isinstance(a, NDArray) else jnp.asarray(a)
-                for a in b] for b in batches]
+        # double-buffer feed: if the previous dispatch staged THIS
+        # window (run_steps(..., next_batches=window)) while the device
+        # was busy, consume the device-resident copy instead of paying
+        # the host stack + device_put on the critical path. Identity of
+        # the original batch objects keys the hand-off.
+        staged, self._feed_staged = self._feed_staged, None
+        ids = tuple(id(a) for b in batches for a in b)
+        pre_stacked = None
+        if staged is not None and staged[0] == ids:
+            raw, pre_stacked = staged[1], staged[2]
+            if _tm._ENABLED:
+                _tm.inc("train_feed_window_hits_total")
+        else:
+            raw = [[a._data if isinstance(a, NDArray)
+                    else jnp.asarray(a) for a in b] for b in batches]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in raw[0])
         # unroll=k flattens the scan into straight-line code: same
         # single dispatch, but no while-loop boundary, so XLA keeps the
@@ -2099,13 +2410,8 @@ class FusedTrainStep:
         # would consume, so dropout/RNG parity is bitwise
         keys = jnp.stack([_random.next_key() for _ in range(k)])
         with _tm.phase("data"):
-            stacked = []
-            for j in range(len(raw[0])):
-                s = jnp.stack([raw[i][j] for i in range(k)])
-                if self.mesh is not None:
-                    s = _global_put(s, NamedSharding(
-                        self.mesh, P(None, *self._batch_sh[j].spec)))
-                stacked.append(s)
+            stacked = pre_stacked if pre_stacked is not None \
+                else self._stack_window(raw)
 
         hyper0 = {
             "lr": jnp.asarray(opt.lr, jnp.float32),
@@ -2159,6 +2465,23 @@ class FusedTrainStep:
             if self._wire_permuted is not None:
                 _fl.record("collective_done", "fused.ppermute",
                            key="__activations__", dur_s=dtf)
+        if next_batches is not None:
+            # stage window i+1 while window i runs: the dispatch above
+            # is async, so this host stack + device_put overlaps the
+            # device scan. Dropping the previous staged refs here is
+            # the donation — XLA reuses the freed buffers.
+            t_feed = _time.perf_counter()
+            nxt = [tuple(b) if isinstance(b, (tuple, list)) else (b,)
+                   for b in next_batches]
+            nraw = [[a._data if isinstance(a, NDArray)
+                     else jnp.asarray(a) for a in b] for b in nxt]
+            self._feed_staged = (
+                tuple(id(a) for b in nxt for a in b), nraw,
+                self._stack_window(nraw))
+            if _tm._ENABLED:
+                _tm.set_gauge("train_feed_overlap_ms",
+                              (_time.perf_counter() - t_feed) * 1e3)
+                _tm.inc("train_feed_windows_staged_total")
         if fresh:
             jax.block_until_ready(losses)
             _tracing.record_compile(name, None)
@@ -2220,8 +2543,10 @@ class FusedTrainStep:
                 _tm.mark_phase("fused_step", per, t0=t_start + i * per,
                                device=True)
             if self._pp_staged is not None:
-                _tm.record_pipeline_step(self._pp_nstages,
-                                         self.pipeline, dt, t0=t_start)
+                _tm.record_pipeline_step(
+                    self._pp_nstages, self.pipeline, dt, t0=t_start,
+                    virtual=getattr(self, "_pp_virtual", 1),
+                    total_ticks=self._pp_total_ticks)
             _tm.mark_phase("fused_loop_host", dt, t0=t_start)
             nb = raw[0][0].shape[0] if raw[0] and getattr(
                 raw[0][0], "ndim", 0) else None

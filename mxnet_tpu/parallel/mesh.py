@@ -15,11 +15,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec", "P",
            "current_mesh", "set_mesh", "use_mesh", "local_mesh",
-           "hybrid_mesh", "axis_size", "has_axis"]
+           "hybrid_mesh", "axis_size", "has_axis", "manual_axes",
+           "current_manual_axes"]
 
 P = PartitionSpec
 
 _CURRENT: Optional[Mesh] = None
+
+#: axes the enclosing shard_map already split by hand ({logical role ->
+#: mesh axis name}, e.g. {"tp": "tp"}). Inside such a region GSPMD
+#: annotations are meaningless: every array is a per-shard view, so
+#: sharding_constraint must no-op and the TP layers switch to explicit
+#: local-matmul + psum collectives. Trace-time only — shard_map re-runs
+#: the Python forward per trace, so a `with manual_axes(...)` around the
+#: staged body is seen by every layer it calls.
+_MANUAL_AXES: dict = {}
+
+
+class manual_axes:
+    """Scoped marker: `with manual_axes({"tp": "tp"}): ...` declares
+    that the named logical axes are ALREADY handled manually by an
+    enclosing shard_map (FusedTrainStep's pipeline body). TP layers
+    consult :func:`current_manual_axes` and replace their GSPMD
+    sharding hints with explicit collectives over the given axis."""
+
+    def __init__(self, axes: dict):
+        self.axes = dict(axes)
+        self._prev = None
+
+    def __enter__(self):
+        global _MANUAL_AXES
+        self._prev = _MANUAL_AXES
+        _MANUAL_AXES = {**self._prev, **self.axes}
+        return _MANUAL_AXES
+
+    def __exit__(self, *exc):
+        global _MANUAL_AXES
+        _MANUAL_AXES = self._prev
+        return False
+
+
+def current_manual_axes() -> dict:
+    """{logical role -> mesh axis name} for the active manual region
+    (empty outside one)."""
+    return _MANUAL_AXES
 
 
 def set_mesh(mesh: Optional[Mesh]):

@@ -23,6 +23,7 @@ from .. import nd
 from ..ndarray import NDArray
 from ..gluon.block import HybridBlock
 from ..gluon.parameter import Parameter
+from .mesh import current_manual_axes
 from .tensor_parallel import sharding_constraint
 
 __all__ = ["MoEMLP"]
@@ -88,6 +89,40 @@ class MoEMLP(HybridBlock):
         aux = E * jnp.sum(fe * me)
         return dispatch, combine, aux, C
 
+    def _ffn(self, exp_in):
+        """Per-expert FFN over whatever expert rows are bound — the
+        full (E, C, H) dispatch under GSPMD, or this rank's local
+        (E/N, N*C, H) slice inside a manual-ep region."""
+        ein = jnp.einsum
+        wu = self.w_up.data()._data
+        bu = self.b_up.data()._data
+        wd = self.w_down.data()._data
+        bd = self.b_down.data()._data
+        h = ein("ech,eih->eci", exp_in, wu) + bu[:, None, :]
+        h = nd.Activation(NDArray(h), act_type=self._act)._data
+        return ein("eci,ehi->ech", h, wd) + bd[:, None, :]
+
+    def _exchange_manual(self, exp_in, ax):
+        """Manual-ep token exchange: routing ran locally against the
+        FULL (replicated) gate, so `exp_in` is (E, C, H) built from
+        this rank's tokens. all_gather every rank's dispatch, run the
+        local experts over all ranks' tokens, all_gather the outputs
+        back and slice this rank's rows — two all_gathers standing in
+        for the GSPMD all-to-all pair, with the same totals."""
+        E, C, H = exp_in.shape
+        nsh = jax.lax.psum(1, ax)
+        El = E // nsh
+        r = jax.lax.axis_index(ax)
+        g = jax.lax.all_gather(exp_in, ax)          # (N, E, C, H)
+        mine = jax.lax.dynamic_slice_in_dim(g, r * El, El, axis=1)
+        mine = jnp.swapaxes(mine, 0, 1)             # (El, N, C, H)
+        out_l = self._ffn(mine.reshape(El, nsh * C, H))
+        out_l = jnp.swapaxes(out_l.reshape(El, nsh, C, H), 0, 1)
+        g2 = jax.lax.all_gather(out_l, ax)          # (N_src, N_tok, El, C, H)
+        back = jax.lax.dynamic_index_in_dim(g2, r, axis=1,
+                                            keepdims=False)
+        return back.reshape(E, C, H)                # owner-major == id order
+
     def forward(self, x):
         raw = x._data if isinstance(x, NDArray) else x
         B, T, H = raw.shape
@@ -96,15 +131,13 @@ class MoEMLP(HybridBlock):
 
         ein = jnp.einsum  # dispatch: (S,E,C) ⊗ (S,H) → (E,C,H)
         exp_in = ein("sec,sh->ech", dispatch.astype(raw.dtype), flat)
-        exp_in = sharding_constraint(exp_in, self._ep, None, None)
-        wu = self.w_up.data()._data
-        bu = self.b_up.data()._data
-        wd = self.w_down.data()._data
-        bd = self.b_down.data()._data
-        h = ein("ech,eih->eci", exp_in, wu) + bu[:, None, :]
-        h = nd.Activation(NDArray(h), act_type=self._act)._data
-        out_e = ein("eci,ehi->ech", h, wd) + bd[:, None, :]
-        out_e = sharding_constraint(out_e, self._ep, None, None)
+        ax = current_manual_axes().get("ep")
+        if ax is not None:
+            out_e = self._exchange_manual(exp_in, ax)
+        else:
+            exp_in = sharding_constraint(exp_in, self._ep, None, None)
+            out_e = self._ffn(exp_in)
+            out_e = sharding_constraint(out_e, self._ep, None, None)
         out = ein("sec,ech->sh", combine.astype(raw.dtype), out_e)
         out = out.reshape(B, T, H)
         res = NDArray(out) if isinstance(x, NDArray) else out

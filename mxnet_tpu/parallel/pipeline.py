@@ -37,7 +37,8 @@ from .mesh import current_mesh
 
 __all__ = ["stack_stage_params", "gpipe", "sequential_apply",
            "one_f_one_b", "pipeline_stages", "StagedPipeline",
-           "bubble_ratio", "stash_slots"]
+           "bubble_ratio", "stash_slots", "InterleavedSchedule",
+           "interleaved_schedule", "interleaved_bubble_ratio"]
 
 
 def bubble_ratio(num_stages: int, num_microbatches: int) -> float:
@@ -47,11 +48,260 @@ def bubble_ratio(num_stages: int, num_microbatches: int) -> float:
     return (n - 1) / (M + n - 1) if M + n - 1 > 0 else 0.0
 
 
+def interleaved_bubble_ratio(total_ticks: int, num_microbatches: int,
+                             virtual: int) -> float:
+    """MEASURED bubble fraction of an interleaved schedule: the fill+
+    drain half-ticks as a fraction of the schedule's actual length.
+    Each rank owes 2*M*v half-ticks of work (M*v forward chunk-ops and
+    M*v backward chunk-ops); everything beyond that in `total_ticks`
+    is bubble. At the Megatron-LM optimum total_ticks = 2*M*v + 2(n-1),
+    giving (n-1)/(M*v + n-1) — the classic ratio shrunk ~1/v."""
+    T, M, v = int(total_ticks), int(num_microbatches), int(virtual)
+    return (T - 2 * M * v) / T if T > 0 else 0.0
+
+
 def stash_slots(num_stages: int) -> int:
     """Activation-stash slots per stage under the 1F1B schedule:
     2n-1, bounded by the STAGE count — independent of the microbatch
     count M (GPipe under plain AD stashes all M)."""
     return 2 * int(num_stages) - 1
+
+
+class InterleavedSchedule:
+    """Host-precomputed tick tables for the interleaved virtual-stage
+    1F1B schedule (Megatron-LM arXiv:2104.04473 §2.2).
+
+    Virtual stage s = c*n + r places model chunk c on pp rank r = s % n,
+    so activations walk rank 0..n-1 for chunk 0, wrap around the ring,
+    walk it again for chunk 1, and so on. One schedule tick is ONE
+    chunk-op per rank (a forward OR a backward half — half the
+    granularity of the non-interleaved machine's fused fwd+bwd tick),
+    which is what lets a rank slot another chunk's forward into what
+    would otherwise be a fill/drain bubble.
+
+    The per-rank op order is Megatron's constructive schedule
+    (num_warmup = min(2*(n-r-1) + (v-1)*n, M*v) warmup forwards, then
+    strict 1F1B alternation, then drain backwards); tick placement
+    comes from an event-driven simulation with a 1-tick wire latency:
+    fwd(m, s) needs fwd(m, s-1) at a strictly earlier tick, bwd(m, s)
+    needs bwd(m, s+1) (or, for the last virtual stage, its own forward)
+    strictly earlier. The resulting `total_ticks` is the MEASURED
+    schedule length that feeds `interleaved_bubble_ratio` — no
+    analytic formula is trusted.
+
+    The emitted tables drive `_1f1b_interleaved_local`, one int32 row
+    per (tick, rank):
+
+      op_kind (0 idle / 1 fwd / 2 bwd), op_m, op_c  — what runs;
+      feed                 — fwd input comes from the microbatch feed
+                             (virtual stage 0) instead of the queue;
+      fq_r / fq_w          — forward-activation FIFO slot to read for
+                             this tick's fwd / to write this tick's
+                             up-ring arrival into (-1 = discard);
+      bq_r / bq_w          — same for the cotangent FIFO on the down
+                             ring;
+      stash_w / stash_r    — recompute-stash slot for the fwd's INPUT
+                             and the bwd's readback;
+      loss_op / dout_w     — this fwd is the last virtual stage:
+                             compute the loss and park its cotangent;
+      use_dout / dout_r    — this bwd seeds from the parked loss
+                             cotangent instead of the down ring.
+
+    Slot indices are allocated host-side with exact lifetimes, so
+    `fq_size`/`bq_size`/`stash_size`/`dout_size` are the true peak
+    buffer occupancies (SPMD: maxed over ranks).
+    """
+
+    #: table column layout (see class docstring)
+    FIELDS = ("op_kind", "op_m", "op_c", "feed", "fq_r", "fq_w",
+              "bq_r", "bq_w", "stash_w", "stash_r", "loss_op",
+              "use_dout", "dout_w", "dout_r")
+
+    def __init__(self, num_stages: int, virtual: int,
+                 num_microbatches: int):
+        n, v, M = int(num_stages), int(virtual), int(num_microbatches)
+        if n < 2 or v < 1 or M < 1:
+            raise ValueError(
+                f"InterleavedSchedule: need pp >= 2, virtual >= 1, "
+                f"microbatches >= 1 (got pp={n}, virtual={v}, M={M})")
+        if M % n != 0:
+            raise ValueError(
+                f"InterleavedSchedule: the interleaved 1F1B order "
+                f"needs num_microbatches divisible by pp (got M={M}, "
+                f"pp={n}) — pad or regroup the microbatches")
+        self.n, self.v, self.M = n, v, M
+        L = n * v  # virtual stages
+
+        def _mc(k, back):
+            c = (k // n) % v
+            if back:
+                c = v - 1 - c
+            return n * (k // (n * v)) + (k % n), c
+
+        # Megatron per-rank op order: warmup fwds, 1F1B, drain bwds
+        ops = []
+        for r in range(n):
+            warm = min((n - r - 1) * 2 + (v - 1) * n, M * v)
+            seq, fi, bi = [], 0, 0
+            for _ in range(warm):
+                m, c = _mc(fi, False)
+                seq.append(("f", m, c))
+                fi += 1
+            while fi < M * v:
+                m, c = _mc(fi, False)
+                seq.append(("f", m, c))
+                fi += 1
+                m, c = _mc(bi, True)
+                seq.append(("b", m, c))
+                bi += 1
+            while bi < M * v:
+                m, c = _mc(bi, True)
+                seq.append(("b", m, c))
+                bi += 1
+            ops.append(seq)
+
+        # event-driven tick placement (1-tick wire latency)
+        done = {}
+        ptr = [0] * n
+        rows = []
+        limit = 4 * M * v + 4 * n + 16
+        while any(ptr[r] < len(ops[r]) for r in range(n)):
+            t = len(rows)
+            if t > limit:
+                raise RuntimeError(
+                    f"InterleavedSchedule: no valid placement within "
+                    f"{limit} ticks for pp={n}, virtual={v}, M={M} — "
+                    "the per-rank op order deadlocked")
+            row = [None] * n
+            for r in range(n):
+                if ptr[r] >= len(ops[r]):
+                    continue
+                kind, m, c = ops[r][ptr[r]]
+                s = c * n + r
+                if kind == "f":
+                    ok = s == 0 or done.get(("f", m, s - 1), t) < t
+                elif s == L - 1:
+                    ok = done.get(("f", m, s), t) < t
+                else:
+                    ok = done.get(("b", m, s + 1), t) < t
+                if ok:
+                    row[r] = (kind, m, c, s)
+            if all(e is None for e in row):
+                raise RuntimeError(
+                    f"InterleavedSchedule: schedule stalled at tick "
+                    f"{t} for pp={n}, virtual={v}, M={M}")
+            for r, e in enumerate(row):
+                if e is not None:
+                    done[(e[0], e[1], e[3])] = t
+                    ptr[r] += 1
+            rows.append(row)
+        T = len(rows)
+        assert len(done) == 2 * M * L, (len(done), 2 * M * L)
+        self.total_ticks = T
+
+        # slot bookkeeping: exact-lifetime allocators per rank
+        def _alloc(pool):
+            if pool["free"]:
+                return pool["free"].pop(0)
+            slot = pool["next"]
+            pool["next"] = slot + 1
+            return slot
+
+        fpool = [{"free": [], "next": 0} for _ in range(n)]
+        bpool = [{"free": [], "next": 0} for _ in range(n)]
+        spool = [{"free": [], "next": 0} for _ in range(n)]
+        dpool = [{"free": [], "next": 0} for _ in range(n)]
+        freed = {"f": {}, "b": {}, "s": {}, "d": {}}
+        pend_f, pend_b, pend_s, pend_d = {}, {}, {}, {}
+
+        tab = _np.zeros((T, n, len(self.FIELDS)), _np.int32)
+        tab[:, :, 5] = -1  # fq_w: default = discard the arrival
+        tab[:, :, 7] = -1  # bq_w
+        col = {f: i for i, f in enumerate(self.FIELDS)}
+
+        for t in range(T):
+            for key, pools in (("f", fpool), ("b", bpool),
+                               ("s", spool), ("d", dpool)):
+                for r, slot in freed[key].pop(t, ()):
+                    pools[r]["free"].append(slot)
+            # arrivals: payloads shifted at the END of tick t-1 land
+            # now, BEFORE this tick's reads (write-then-read order in
+            # the traced tick)
+            if t >= 1:
+                for r, e in enumerate(rows[t - 1]):
+                    if e is None:
+                        continue
+                    kind, m, _c, s = e
+                    if kind == "f" and s < L - 1:
+                        r2 = (r + 1) % n
+                        slot = _alloc(fpool[r2])
+                        tab[t, r2, col["fq_w"]] = slot
+                        pend_f[(m, s + 1)] = slot
+                    elif kind == "b" and s > 0:
+                        r2 = (r - 1) % n
+                        slot = _alloc(bpool[r2])
+                        tab[t, r2, col["bq_w"]] = slot
+                        pend_b[(m, s - 1)] = slot
+            for r, e in enumerate(rows[t]):
+                if e is None:
+                    continue
+                kind, m, c, s = e
+                tab[t, r, col["op_kind"]] = 1 if kind == "f" else 2
+                tab[t, r, col["op_m"]] = m
+                tab[t, r, col["op_c"]] = c
+                if kind == "f":
+                    if s == 0:
+                        tab[t, r, col["feed"]] = 1
+                    else:
+                        slot = pend_f.pop((m, s))
+                        tab[t, r, col["fq_r"]] = slot
+                        freed["f"].setdefault(t + 1, []).append((r, slot))
+                    slot = _alloc(spool[r])
+                    tab[t, r, col["stash_w"]] = slot
+                    pend_s[(m, s)] = slot
+                    if s == L - 1:
+                        tab[t, r, col["loss_op"]] = 1
+                        slot = _alloc(dpool[r])
+                        tab[t, r, col["dout_w"]] = slot
+                        pend_d[m] = slot
+                else:
+                    slot = pend_s.pop((m, s))
+                    tab[t, r, col["stash_r"]] = slot
+                    freed["s"].setdefault(t + 1, []).append((r, slot))
+                    if s == L - 1:
+                        tab[t, r, col["use_dout"]] = 1
+                        slot = pend_d.pop(m)
+                        tab[t, r, col["dout_r"]] = slot
+                        freed["d"].setdefault(t + 1, []).append((r, slot))
+                    else:
+                        slot = pend_b.pop((m, s))
+                        tab[t, r, col["bq_r"]] = slot
+                        freed["b"].setdefault(t + 1, []).append((r, slot))
+        assert not pend_f and not pend_b and not pend_s and not pend_d
+        self.table = tab
+        self.fq_size = max(1, max(p["next"] for p in fpool))
+        self.bq_size = max(1, max(p["next"] for p in bpool))
+        self.stash_size = max(1, max(p["next"] for p in spool))
+        self.dout_size = max(1, max(p["next"] for p in dpool))
+
+    def bubble_ratio(self) -> float:
+        return interleaved_bubble_ratio(self.total_ticks, self.M,
+                                        self.v)
+
+
+def interleaved_schedule(num_stages: int, virtual: int,
+                         num_microbatches: int) -> InterleavedSchedule:
+    """Build (and cache) the interleaved 1F1B tick tables for
+    pp=num_stages ranks running `virtual` model chunks each over
+    `num_microbatches` microbatches."""
+    key = (int(num_stages), int(virtual), int(num_microbatches))
+    hit = _SCHED_CACHE.get(key)
+    if hit is None:
+        hit = _SCHED_CACHE[key] = InterleavedSchedule(*key)
+    return hit
+
+
+_SCHED_CACHE: dict = {}
 
 
 def stack_stage_params(params_list):
@@ -357,8 +607,155 @@ def _1f1b_local(params, mbatches, ybatches, stage_fn, loss_fn,
     return loss_acc, grads
 
 
+def _1f1b_interleaved_local(params, mbatches, ybatches, stage_fn,
+                            loss_fn, axis_name, sched,
+                            loss_dtype=None, wire=None):
+    """Per-device interleaved 1F1B body (runs inside shard_map).
+
+    `params` is this rank's full chunk set (leaves lead with the
+    virtual dim); `stage_fn(params, c, h)` runs chunk `c` — the chunk
+    index stays TRACED (it arrives from the tick table), so the whole
+    interleaved schedule is ONE scan body and one executable per plan
+    signature, never a per-chunk recompile.
+
+    One tick = ONE op per rank (idle / fwd / bwd), driven by the
+    host-precomputed `sched` tables (see InterleavedSchedule). Both
+    rings permute every tick — forward activations up the full ring
+    [(i, (i+1)%n)] (the wraparound hop IS the chunk transition),
+    cotangents down the reversed ring — and receivers file arrivals
+    into FIFO queues at table-assigned slots (-1 = discard: the last
+    virtual stage's output and virtual stage 0's input cotangent).
+    Backward recomputes from the stashed stage INPUT (recompute-vjp)
+    exactly like the non-interleaved machine; the vjp runs against the
+    FULL chunk set, yielding zeros outside chunk c, so gradients
+    accumulate in microbatch order per chunk — bit-identical to the
+    non-interleaved accumulation per (chunk, leaf).
+
+    Returns (loss_sum, grads): loss summed over microbatches on the
+    rank owning the last virtual stage (zeros elsewhere).
+    """
+    n = sched.n
+    assert sched.M == mbatches.shape[0], \
+        f"schedule built for M={sched.M}, got {mbatches.shape[0]}"
+    rank = jax.lax.axis_index(axis_name)
+    M = mbatches.shape[0]
+    mb_shape = mbatches.shape[1:]
+    act_dtype = mbatches.dtype
+    if loss_dtype is None:
+        loss_dtype = jax.eval_shape(
+            loss_fn, jax.ShapeDtypeStruct(mb_shape, act_dtype),
+            jax.ShapeDtypeStruct(ybatches.shape[1:],
+                                 ybatches.dtype)).dtype
+    perm_up = [(i, (i + 1) % n) for i in range(n)]
+    perm_down = [((i + 1) % n, i) for i in range(n)]
+    shift = _shift_fn(axis_name, wire)
+
+    def _z(shape):
+        return _vary(jnp.zeros(shape, act_dtype), axis_name)
+
+    fq0 = _z((sched.fq_size,) + mb_shape)
+    bq0 = _z((sched.bq_size,) + mb_shape)
+    stash0 = _z((sched.stash_size,) + mb_shape)
+    dout0 = _z((sched.dout_size,) + mb_shape)
+    grad0 = jax.tree_util.tree_map(
+        lambda p: _vary(jnp.zeros_like(p), axis_name), params)
+    col = {f: i for i, f in enumerate(InterleavedSchedule.FIELDS)}
+    rows = jnp.asarray(sched.table)  # (T, n, F)
+
+    def tick(carry, row):
+        fq, bq, stash, dout_st, grads, loss_acc, up_in, down_in = carry
+        tr = row[rank]  # this rank's (F,) table row, traced
+
+        # 1. file the ring arrivals shifted at the end of last tick
+        fq_upd = jax.lax.dynamic_update_index_in_dim(
+            fq, up_in, jnp.clip(tr[col["fq_w"]], 0, sched.fq_size - 1),
+            0)
+        fq = jnp.where(tr[col["fq_w"]] >= 0, fq_upd, fq)
+        bq_upd = jax.lax.dynamic_update_index_in_dim(
+            bq, down_in,
+            jnp.clip(tr[col["bq_w"]], 0, sched.bq_size - 1), 0)
+        bq = jnp.where(tr[col["bq_w"]] >= 0, bq_upd, bq)
+
+        m_c = jnp.clip(tr[col["op_m"]], 0, M - 1)
+        c_op = tr[col["op_c"]]
+
+        # 2. forward op (or the free zero branch)
+        feed = jax.lax.dynamic_index_in_dim(mbatches, m_c, 0,
+                                            keepdims=False)
+        q_in = jax.lax.dynamic_index_in_dim(fq, tr[col["fq_r"]], 0,
+                                            keepdims=False)
+        inp = jnp.where(tr[col["feed"]] == 1, feed, q_in)
+        y_f = jax.lax.dynamic_index_in_dim(ybatches, m_c, 0,
+                                           keepdims=False)
+        is_loss = tr[col["loss_op"]] == 1
+
+        def fwd_op(operand):
+            i_, y_, c_ = operand
+            out = stage_fn(params, c_, i_)
+
+            def loss_half(oy):
+                lval, dval = jax.value_and_grad(loss_fn)(oy[0], oy[1])
+                return lval.astype(loss_dtype), dval.astype(act_dtype)
+
+            lval, dval = jax.lax.cond(
+                is_loss, loss_half,
+                lambda oy: (jnp.zeros((), loss_dtype),
+                            jnp.zeros_like(oy[0])), (out, y_))
+            return out, lval, dval
+
+        out, lval, dout_val = jax.lax.cond(
+            tr[col["op_kind"]] == 1, fwd_op,
+            lambda o: (jnp.zeros(mb_shape, act_dtype),
+                       jnp.zeros((), loss_dtype),
+                       jnp.zeros(mb_shape, act_dtype)), (inp, y_f, c_op))
+        loss_acc = loss_acc + lval
+        st_upd = jax.lax.dynamic_update_index_in_dim(
+            stash, inp, tr[col["stash_w"]], 0)
+        stash = jnp.where(tr[col["op_kind"]] == 1, st_upd, stash)
+        d_upd = jax.lax.dynamic_update_index_in_dim(
+            dout_st, dout_val, tr[col["dout_w"]], 0)
+        dout_st = jnp.where(is_loss, d_upd, dout_st)
+
+        # 3. backward op: recompute-vjp against the FULL chunk set
+        inp_b = jax.lax.dynamic_index_in_dim(
+            stash, tr[col["stash_r"]], 0, keepdims=False)
+        cot_q = jax.lax.dynamic_index_in_dim(bq, tr[col["bq_r"]], 0,
+                                             keepdims=False)
+        cot_d = jax.lax.dynamic_index_in_dim(
+            dout_st, tr[col["dout_r"]], 0, keepdims=False)
+        cot = jnp.where(tr[col["use_dout"]] == 1, cot_d, cot_q)
+
+        def bwd_op(operand):
+            i_, ct_, c_ = operand
+            _, vjp = jax.vjp(lambda pr, h: stage_fn(pr, c_, h),
+                             params, i_)
+            return vjp(ct_)
+
+        dparams, dinp = jax.lax.cond(
+            tr[col["op_kind"]] == 2, bwd_op,
+            lambda o: (jax.tree_util.tree_map(jnp.zeros_like, params),
+                       jnp.zeros_like(o[0])), (inp_b, cot, c_op))
+        grads = jax.tree_util.tree_map(lambda g, d: g + d, grads,
+                                       dparams)
+
+        # 4. both rings shift every tick (quantized under wire
+        # compression — every pp hop rides the compressed transport)
+        up_out = shift(out, perm_up)
+        down_out = shift(dinp, perm_down)
+        return (fq, bq, stash, dout_st, grads, loss_acc, up_out,
+                down_out), ()
+
+    init = (fq0, bq0, stash0, dout0, grad0,
+            _vary(jnp.zeros((), loss_dtype), axis_name),
+            _z(mb_shape), _z(mb_shape))
+    (_, _, _, _, grads, loss_acc, _, _), _ = jax.lax.scan(
+        tick, init, rows)
+    return loss_acc, grads
+
+
 def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
-                num_microbatches, mesh=None, pp_axis="pp", wire=None):
+                num_microbatches, mesh=None, pp_axis="pp", wire=None,
+                virtual=1):
     """1F1B pipeline schedule: fused forward+backward with interleaved
     microbatch backprop and an O(num_stages) activation stash.
 
@@ -386,6 +783,12 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     activation/cotangent hops block-scale-quantized over the wire —
     ~3.9x fewer inter-stage bytes at block=128. Ignored by the
     sequential fallback (nothing crosses a wire there).
+
+    `virtual=v` (v > 1) switches to the interleaved virtual-stage
+    schedule: `stacked_params` leaves lead with (pp, v, ...) — chunk c
+    of rank r is virtual stage c*pp + r — and `stage_fn` takes
+    (rank_params, c, h) with a TRACED chunk index. Requires
+    num_microbatches % pp == 0.
     """
     mesh = mesh if mesh is not None else current_mesh()
     B = x.shape[0]
@@ -396,12 +799,23 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     loss_dtype = jax.eval_shape(
         loss_fn, jax.ShapeDtypeStruct(mbatches.shape[1:], mbatches.dtype),
         jax.ShapeDtypeStruct(ybatches.shape[1:], ybatches.dtype)).dtype
+    virtual = int(virtual)
 
     if mesh is None or pp_axis not in mesh.axis_names:
+        n_st = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
         def total(params):
             def body(acc, mby):
                 mbx, mby_ = mby
-                out = sequential_apply(stage_fn, params, mbx)
+                if virtual > 1:
+                    h = mbx
+                    for s in range(n_st * virtual):
+                        p_r = jax.tree_util.tree_map(
+                            lambda a: a[s % n_st], params)
+                        h = stage_fn(p_r, s // n_st, h)
+                    out = h
+                else:
+                    out = sequential_apply(stage_fn, params, mbx)
                 return acc + loss_fn(out, mby_), ()
             acc, _ = jax.lax.scan(body, jnp.zeros((), loss_dtype),
                                   (mbatches, ybatches))
@@ -413,15 +827,22 @@ def one_f_one_b(stage_fn, stacked_params, x, y, loss_fn,
     leaves = jax.tree_util.tree_leaves(stacked_params)
     assert leaves[0].shape[0] == n, \
         f"{leaves[0].shape[0]} stages vs pp={n} shards"
+    sched = interleaved_schedule(n, virtual, num_microbatches) \
+        if virtual > 1 else None
 
     param_specs = jax.tree_util.tree_map(
         lambda a: P(pp_axis, *([None] * (a.ndim - 1))), stacked_params)
 
     def body(params, mbs, ybs):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
-        loss_sum, grads = _1f1b_local(params, mbs, ybs, stage_fn,
-                                      loss_fn, pp_axis,
-                                      loss_dtype=loss_dtype, wire=wire)
+        if sched is not None:
+            loss_sum, grads = _1f1b_interleaved_local(
+                params, mbs, ybs, stage_fn, loss_fn, pp_axis, sched,
+                loss_dtype=loss_dtype, wire=wire)
+        else:
+            loss_sum, grads = _1f1b_local(
+                params, mbs, ybs, stage_fn, loss_fn, pp_axis,
+                loss_dtype=loss_dtype, wire=wire)
         # loss lives on the last stage only; share it with every shard
         loss_sum = jax.lax.psum(loss_sum, pp_axis)
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
@@ -530,26 +951,37 @@ class StagedPipeline:
     """
 
     def __init__(self, net, blocks, assignment, entry, param_names,
-                 block_params, costs, sample_aval):
+                 block_params, costs, sample_aval, virtual=1):
         self.net = net
         self.blocks = blocks
         self.assignment = assignment
-        self.num_stages = len(assignment)
+        self.virtual = int(virtual)
+        # runs are in MODEL order: virtual stage s = c*pp + r lives in
+        # assignment[s]; with virtual == 1 this is the plain stage list
+        self.num_stages = len(assignment) // self.virtual
         self.num_slots = max(len(a) for a in assignment)
         self._entry = entry
         self.param_names = list(param_names)
         self._block_params = block_params  # per block: {name: Parameter}
         self.costs = list(costs)
         self.sample_aval = sample_aval
-        # (stage, slot) -> block index for REAL slots
+        # (virtual stage, slot) -> block index for REAL slots
         self.slot_map = {}
         for i, run in enumerate(assignment):
             for j, b in enumerate(run):
                 self.slot_map[(i, j)] = b
-        self.mask = jnp.asarray(
-            [[1.0 if (i, j) in self.slot_map else 0.0
-              for j in range(self.num_slots)]
-             for i in range(self.num_stages)], jnp.float32)
+        if self.virtual == 1:
+            self.mask = jnp.asarray(
+                [[1.0 if (i, j) in self.slot_map else 0.0
+                  for j in range(self.num_slots)]
+                 for i in range(self.num_stages)], jnp.float32)
+        else:
+            pp = self.num_stages
+            self.mask = jnp.asarray(
+                [[[1.0 if (c * pp + r, j) in self.slot_map else 0.0
+                   for j in range(self.num_slots)]
+                  for c in range(self.virtual)]
+                 for r in range(pp)], jnp.float32)
         self.params = self.restack()
 
     # -- param shuttling ---------------------------------------------------
@@ -562,37 +994,75 @@ class StagedPipeline:
 
     def restack(self):
         """(Re-)read the net's Parameters into the stacked pytree
-        (leading dims [pp, num_slots]) including the `__mask__` leaf."""
+        (leading dims [pp, num_slots] — or [pp, virtual, num_slots]
+        under interleaving) including the `__mask__` leaf."""
         stacked = {}
+        pp, v = self.num_stages, self.virtual
         for k in self.param_names:
-            stacked[k] = jnp.stack([
-                jnp.stack([
-                    self._block_params[self._slot_block(i, j)][k]
-                    .data()._data
-                    for j in range(self.num_slots)], axis=0)
-                for i in range(self.num_stages)], axis=0)
+            if v == 1:
+                stacked[k] = jnp.stack([
+                    jnp.stack([
+                        self._block_params[self._slot_block(i, j)][k]
+                        .data()._data
+                        for j in range(self.num_slots)], axis=0)
+                    for i in range(pp)], axis=0)
+            else:
+                stacked[k] = jnp.stack([
+                    jnp.stack([
+                        jnp.stack([
+                            self._block_params[
+                                self._slot_block(c * pp + r, j)][k]
+                            .data()._data
+                            for j in range(self.num_slots)], axis=0)
+                        for c in range(v)], axis=0)
+                    for r in range(pp)], axis=0)
         stacked["__mask__"] = self.mask
         return stacked
 
     def unstack_into_net(self, stacked):
         """Write stacked weights back into the net's Parameters (only
         real slots; padded copies are dropped)."""
+        pp = self.num_stages
         for (i, j), b in self.slot_map.items():
             for k in self.param_names:
-                self._block_params[b][k].data()._data = \
-                    jnp.asarray(stacked[k])[i, j]
+                arr = jnp.asarray(stacked[k])
+                if self.virtual == 1:
+                    self._block_params[b][k].data()._data = arr[i, j]
+                else:
+                    self._block_params[b][k].data()._data = \
+                        arr[i % pp, i // pp, j]
 
     # -- the stage function ------------------------------------------------
     def make_stage_fn(self, key=None):
         """stage_fn(stage_params, h) running this stage's block slots in
         order through block 0's traced form; `key` seeds per-slot
         dropout (folded by slot index). Padded slots run but their
-        output is discarded by the `__mask__` select."""
+        output is discarded by the `__mask__` select.
+
+        Under interleaving (virtual > 1) the signature becomes
+        stage_fn(rank_params, c, h): `rank_params` leaves lead with the
+        virtual dim and `c` is the (possibly TRACED) chunk index —
+        selected with dynamic_index_in_dim so one traced body serves
+        every chunk (one executable, no per-chunk recompiles)."""
         entry = self._entry
         names = self.param_names
         s = self.num_slots
         if key is None:
             key = jax.random.PRNGKey(0)
+
+        if self.virtual > 1:
+            def stage_fn(p, c, h):
+                m = jax.lax.dynamic_index_in_dim(p["__mask__"], c, 0,
+                                                 keepdims=False)
+                kc = jax.random.fold_in(key, c)
+                for j in range(s):
+                    pj = {k: jax.lax.dynamic_index_in_dim(
+                        p[k], c, 0, keepdims=False)[j] for k in names}
+                    flat, _ = entry.raw_fn(
+                        pj, {}, jax.random.fold_in(kc, j), h)
+                    h = jnp.where(m[j] != 0, flat[0], h)
+                return h
+            return stage_fn
 
         def stage_fn(p, h):
             m = p["__mask__"]
@@ -613,9 +1083,15 @@ class StagedPipeline:
                    for k, v in self.params.items() if k != "__mask__")
 
 
-def pipeline_stages(net, pp: int, sample=None, cost_model: str = "flops"):
+def pipeline_stages(net, pp: int, sample=None, cost_model: str = "flops",
+                    virtual: int = 1):
     """Cut a HybridSequential of shape-preserving blocks into `pp`
     balanced stages and return a StagedPipeline.
+
+    `virtual=v` (v > 1) cuts pp*v balanced runs instead and assigns
+    rank r the NON-CONTIGUOUS chunks {c*pp + r : c < v} — Megatron's
+    interleaved placement, which the interleaved 1F1B schedule walks
+    to shrink the pipeline bubble ~1/v (see interleaved_schedule).
 
     Balancing uses a per-block cost model: `cost_model="flops"` traces
     block 0 and reads XLA's FLOPs estimate (all stackable blocks share
@@ -640,10 +1116,15 @@ def pipeline_stages(net, pp: int, sample=None, cost_model: str = "flops"):
     else:
         blocks = list(net)
     L = len(blocks)
-    if pp < 1 or L < pp:
+    virtual = int(virtual)
+    if virtual < 1:
+        raise ValueError(f"pipeline_stages: virtual={virtual} must "
+                         "be >= 1")
+    if pp < 1 or L < pp * virtual:
         raise ValueError(
-            f"pipeline_stages: need at least pp={pp} blocks to cut "
-            f"into {pp} stages; the net has {L}")
+            f"pipeline_stages: need at least pp*virtual="
+            f"{pp * virtual} blocks to cut into {pp} stages x "
+            f"{virtual} virtual chunks; the net has {L}")
     if sample is None:
         raise ValueError(
             "pipeline_stages needs a sample input batch to trace the "
@@ -729,10 +1210,11 @@ def pipeline_stages(net, pp: int, sample=None, cost_model: str = "flops"):
             "constraint, satisfied by transformer blocks")
 
     costs = _block_costs(blocks, block_params, entry, raw, cost_model)
-    assignment = _balanced_partition(costs, pp)
+    assignment = _balanced_partition(costs, pp * virtual)
     return StagedPipeline(net, blocks, assignment, entry, names0,
                           block_params, costs,
-                          jax.ShapeDtypeStruct(raw.shape, raw.dtype))
+                          jax.ShapeDtypeStruct(raw.shape, raw.dtype),
+                          virtual=virtual)
 
 
 def _block_costs(blocks, block_params, entry, raw, cost_model):

@@ -20,6 +20,8 @@ the propagation pass picks a bad one.
 """
 from __future__ import annotations
 
+from functools import partial as _partial
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -28,7 +30,7 @@ from .. import nd
 from ..ndarray import NDArray
 from ..gluon.block import HybridBlock
 from ..gluon.nn import Dense, Embedding
-from .mesh import current_mesh
+from .mesh import current_mesh, current_manual_axes
 from .ring_attention import full_attention
 
 __all__ = ["ColumnParallelDense", "RowParallelDense",
@@ -39,9 +41,14 @@ __all__ = ["ColumnParallelDense", "RowParallelDense",
 def sharding_constraint(x, *spec):
     """Pin an activation's PartitionSpec inside a traced/jitted region.
 
-    No-op when no mesh is active (eager single-chip). Accepts NDArray or
-    raw jax.Array; returns the same type.
+    No-op when no mesh is active (eager single-chip) or inside a
+    `manual_axes` region (shard_map already split the axes by hand —
+    every array is a per-shard view, so GSPMD hints are meaningless
+    and the TP layers issue explicit collectives instead). Accepts
+    NDArray or raw jax.Array; returns the same type.
     """
+    if current_manual_axes():
+        return x
     mesh = current_mesh()
     if mesh is None:
         return x
@@ -54,6 +61,75 @@ def sharding_constraint(x, *spec):
         return x
     out = jax.lax.with_sharding_constraint(raw, NamedSharding(mesh, spec))
     return NDArray(out) if isinstance(x, NDArray) else out
+
+
+# -- manual-region collectives with Megatron transpose semantics -----------
+#
+# Inside a `manual_axes` region every array is a per-shard view and JAX
+# does not track which values are replicated across tp. The raw
+# `lax.psum` transpose re-psums the cotangent, which double-counts when
+# the cotangent is replicated (it is, after a loss computed identically
+# on every tp rank) — each RowParallel boundary would scale upstream
+# grads by another factor of tp. The fix is the Megatron f/g pair: the
+# activation entering a column-parallel matmul is `copy_to` (identity
+# forward, psum backward — it turns the per-shard partial input-grads
+# back into the full replicated cotangent), and the row-parallel output
+# is `reduce_from` (psum forward, identity backward). Grad convention
+# for the region: replicated tensors carry full-valued replicated
+# grads, tp-sharded tensors carry their local shard's grad — which is
+# exactly what the plan update path consumes (no tp grad reduce).
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _copy_to_shards(ax, x):
+    return x
+
+
+def _copy_to_fwd(ax, x):
+    return x, None
+
+
+def _copy_to_bwd(ax, _res, g):
+    return (jax.lax.psum(g, ax),)
+
+
+_copy_to_shards.defvjp(_copy_to_fwd, _copy_to_bwd)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _reduce_from_shards(ax, x):
+    return jax.lax.psum(x, ax)
+
+
+def _reduce_from_fwd(ax, x):
+    return jax.lax.psum(x, ax), None
+
+
+def _reduce_from_bwd(ax, _res, g):
+    return (g,)
+
+
+_reduce_from_shards.defvjp(_reduce_from_fwd, _reduce_from_bwd)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gather_from_shards(ax, x):
+    return jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_from_fwd(ax, x):
+    return jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True), \
+        x.shape[-1]
+
+
+def _gather_from_bwd(ax, nloc, g):
+    # replicated cotangent: each shard keeps its own slice (the raw
+    # all_gather transpose would psum_scatter, double-counting it)
+    r = jax.lax.axis_index(ax)
+    return (jax.lax.dynamic_slice_in_dim(g, r * nloc, nloc,
+                                         axis=g.ndim - 1),)
+
+
+_gather_from_shards.defvjp(_gather_from_fwd, _gather_from_bwd)
 
 
 class ColumnParallelDense(Dense):
@@ -76,6 +152,18 @@ class ColumnParallelDense(Dense):
             self.bias.sharding = P(tp_axis)
 
     def forward(self, x):
+        ax = current_manual_axes().get("tp")
+        if ax is not None:
+            # manual region: the bound weight/bias are already this
+            # shard's rows, so a plain local matmul computes the local
+            # output slice. The replicated input crosses into the
+            # sharded region through copy_to (its backward psums the
+            # per-shard partial input-grads back together).
+            raw_in = x._data if isinstance(x, NDArray) else x
+            out = super().forward(NDArray(_copy_to_shards(ax, raw_in)))
+            if self._gather_output:
+                out = NDArray(_gather_from_shards(ax, out._data))
+            return out
         out = super().forward(x)
         if self._gather_output:
             out = sharding_constraint(out, *([None] * out.ndim))
@@ -102,6 +190,21 @@ class RowParallelDense(Dense):
         # bias stays replicated (P()) — added once, post-reduction.
 
     def forward(self, x):
+        ax = current_manual_axes().get("tp")
+        if ax is not None:
+            # manual region: partial matmul on this shard's columns
+            # WITHOUT the bias, explicit psum over tp, then the
+            # replicated bias exactly once
+            partial = nd.FullyConnected(
+                x, self.weight.data(), None, num_hidden=self._units,
+                no_bias=True, flatten=self._flatten)
+            raw = _reduce_from_shards(ax, partial._data)
+            if self.bias is not None:
+                raw = raw + self.bias.data()._data
+            out = NDArray(raw)
+            if self._activation:
+                out = nd.Activation(out, act_type=self._activation)
+            return out
         spec = [None] * x.ndim
         spec[-1] = self._tp_axis
         x = sharding_constraint(x, *spec)
@@ -121,6 +224,18 @@ class VocabParallelEmbedding(Embedding):
         super().__init__(input_dim, output_dim, *args, **kwargs)
         self._tp_axis = tp_axis
         self.weight.sharding = P(tp_axis, None)
+
+    def forward(self, x):
+        if current_manual_axes().get("tp") is not None:
+            # the masked-gather + psum rewrite is not wired into the
+            # manual pp x tp region yet — fail loudly rather than
+            # gather garbage rows from a local vocab shard
+            raise NotImplementedError(
+                "VocabParallelEmbedding is not supported inside the "
+                "manual pp x tp region (ParallelPlan(pp>1, tp>1)); "
+                "keep the embedding out of the pipelined net or use "
+                "a plain Embedding")
+        return super().forward(x)
 
 
 class TPMLP(HybridBlock):
@@ -171,7 +286,11 @@ class TPSelfAttention(HybridBlock):
     def forward(self, x):
         B, T, _ = x.shape
         qkv = self.qkv(x)  # (B, T, 3H) feature-sharded
-        raw = qkv._data.reshape(B, T, 3, self._nh, self._hd)
+        # head count from the actual qkv width: inside a manual-tp
+        # region the array is this shard's local slice (nh/tp heads),
+        # under GSPMD it is the global shape (nh heads)
+        nh = qkv.shape[-1] // (3 * self._hd)
+        raw = qkv._data.reshape(B, T, 3, nh, self._hd)
         # heads dim carries the tp spec — all per-head work stays local
         raw = sharding_constraint(
             raw, None, None, None, self._tp_axis, None)
@@ -179,6 +298,6 @@ class TPSelfAttention(HybridBlock):
         k = jnp.swapaxes(raw[:, :, 1], 1, 2)
         v = jnp.swapaxes(raw[:, :, 2], 1, 2)
         ctx = full_attention(q, k, v, self._causal, None)
-        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, self._h)
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, T, nh * self._hd)
         ctx = sharding_constraint(ctx, None, None, self._tp_axis)
         return self.out(NDArray(ctx))

@@ -425,32 +425,46 @@ def phase(name: str, device: bool = False):
 
 
 def record_pipeline_step(num_stages: int, num_microbatches: int,
-                         seconds: float, t0: Optional[float] = None):
+                         seconds: float, t0: Optional[float] = None,
+                         virtual: int = 1,
+                         total_ticks: Optional[int] = None):
     """Resolve one pipeline-parallel step into the timeline: splits the
     measured fused-step span into `pipeline_fill` / `pipeline_steady` /
     `pipeline_drain` phases proportionally to the 1F1B tick counts
     (fill = drain = n-1 ticks of M + 2(n-1) total) and sets the
     `pipeline_bubble_ratio` gauge to the schedule's (n-1)/(M+n-1)
     inefficiency — the number a microbatch-count sweep should drive
-    down. XLA fuses the real phases into one executable, so the
+    down. With interleaved virtual stages (`virtual` >= 2 and the
+    schedule's measured `total_ticks`), the bubble is the schedule's
+    own (T - 2·M·v)/T — the interleaving win shows up directly in the
+    same gauge. XLA fuses the real phases into one executable, so the
     proportional split is the honest host-side attribution."""
     if not _ENABLED:
         return
-    n, M = int(num_stages), int(num_microbatches)
-    total = M + 2 * (n - 1)
+    n, M, v = int(num_stages), int(num_microbatches), int(virtual)
+    if v >= 2 and total_ticks:
+        T = int(total_ticks)
+        work = 2 * M * v
+        bubble = max(0.0, (T - work) / T) if T > 0 else 0.0
+        total = T
+        fill_ticks = (T - work) / 2.0
+    else:
+        total = M + 2 * (n - 1)
+        bubble = (n - 1) / (M + n - 1) if M + n - 1 > 0 else 0.0
+        fill_ticks = float(n - 1)
     if total <= 0 or seconds <= 0:
         return
-    fill = seconds * (n - 1) / total
-    steady = seconds * M / total
+    fill = seconds * fill_ticks / total
+    steady = seconds - 2 * fill
     base = t0 if t0 is not None else time.perf_counter() - seconds
     mark_phase("pipeline_fill", fill, t0=base, device=True)
     mark_phase("pipeline_steady", steady, t0=base + fill, device=True)
     mark_phase("pipeline_drain", fill, t0=base + fill + steady,
                device=True)
-    set_gauge("pipeline_bubble_ratio",
-              (n - 1) / (M + n - 1) if M + n - 1 > 0 else 0.0)
+    set_gauge("pipeline_bubble_ratio", bubble)
     set_gauge("pipeline_num_stages", n)
     set_gauge("pipeline_num_microbatches", M)
+    set_gauge("pipeline_virtual_stages", v)
 
 
 def step_done(samples: Optional[int] = None, steps: int = 1):

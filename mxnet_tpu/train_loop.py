@@ -19,6 +19,7 @@ See docs/compiled_loop.md for when K helps and the degrade matrix.
 """
 from __future__ import annotations
 
+import inspect
 import math
 import time
 import warnings
@@ -119,15 +120,32 @@ class TrainLoop:
         if not isinstance(data, DevicePrefetcher):
             data = DevicePrefetcher(data, depth=self.prefetch_depth)
         last_saved = step._step_count
+        # duck-typed steps may predate the next_batches staging kwarg
         try:
-            for window in window_iter(iter(data), self.k):
+            _ps = inspect.signature(step.run_steps).parameters
+            stage_next = ("next_batches" in _ps or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in _ps.values()))
+        except (TypeError, ValueError):
+            stage_next = True
+        try:
+            # one-window lookahead: hand run_steps the NEXT window so
+            # it stages the device-resident double buffer while the
+            # current dispatch runs (see FusedTrainStep.run_steps)
+            win_it = window_iter(iter(data), self.k)
+            window = next(win_it, None)
+            while window is not None:
+                nxt = next(win_it, None)
                 if max_steps is not None:
                     left = max_steps - step._step_count
                     if left <= 0:
                         break
                     window = window[:left]
                 t_win = time.perf_counter()
-                losses = step.run_steps(window)
+                if stage_next:
+                    losses = step.run_steps(window, next_batches=nxt)
+                else:
+                    losses = step.run_steps(window)
                 if _tm._ENABLED and window:
                     # the K boundary is the only place the host sees the
                     # clock: per-step time (window / K) feeds the
@@ -154,6 +172,7 @@ class TrainLoop:
                 if max_steps is not None \
                         and step._step_count >= max_steps:
                     break
+                window = nxt
         except BaseException as e:
             if _fl._ENABLED:
                 _fl.record("exception", "train_loop",

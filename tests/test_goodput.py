@@ -434,6 +434,32 @@ def test_check_metrics_directions():
     assert v["ok"] and v["compared"] == 0
 
 
+def test_check_metrics_interleaved_bench_directions():
+    """The interleaved-pipeline bench gauges must be sentinel-correct:
+    the headline contains 'speedup' (higher-better) and the bubble
+    keys end in '_ratio' (lower-better), so a regression in either
+    direction gates `goodput check` over BENCH_*.json history."""
+    v = goodput.check_metrics(
+        {"pipeline_interleaved_bubble_speedup": 1.0},
+        {"pipeline_interleaved_bubble_speedup": [1.7]})
+    assert not v["ok"]
+    assert v["regressions"][0]["direction"] == "higher_is_better"
+    v = goodput.check_metrics(
+        {"interleaved_bubble_ratio": 0.27, "baseline_bubble_ratio": 0.27},
+        {"interleaved_bubble_ratio": [0.158],
+         "baseline_bubble_ratio": [0.273]})
+    assert not v["ok"] and len(v["regressions"]) == 1
+    assert v["regressions"][0]["metric"] == "interleaved_bubble_ratio"
+    assert v["regressions"][0]["direction"] == "lower_is_better"
+    # at-history values pass both directions
+    v = goodput.check_metrics(
+        {"pipeline_interleaved_bubble_speedup": 1.72,
+         "interleaved_bubble_ratio": 0.158},
+        {"pipeline_interleaved_bubble_speedup": [1.7],
+         "interleaved_bubble_ratio": [0.158]})
+    assert v["ok"] and v["compared"] == 2
+
+
 def _bench_record(n, metric, value):
     return {"n": n, "cmd": "python bench.py", "rc": 0,
             "tail": "", "parsed": {"metric": metric, "value": value,

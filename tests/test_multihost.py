@@ -266,3 +266,24 @@ def test_dryrun_two_process_telemetry_leg():
     # environmental skip is tolerated (loaded CI host); a worker
     # failure raises out of the leg and fails this test
     assert status == "ok" or status.startswith("skipped:"), status
+
+
+@pytest.mark.slow
+def test_dryrun_two_process_pp_leg():
+    """The promoted leg (9): a pp=2 ParallelPlan over a 2-process gloo
+    mesh with ONE device per process, so every 1F1B ppermute hop
+    crosses the wire between processes. Workers self-verify 5-step
+    loss parity against a local single-device unpipelined reference."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+
+    status = ge._two_process_pp_leg(timeout_s=200)
+    # environmental skip is tolerated (loaded CI host); a worker
+    # failure raises out of the leg and fails this test
+    assert status == "ok" or status.startswith("skipped:"), status

@@ -344,7 +344,8 @@ def test_pipeline_stages_clear_errors():
     x = NDArray(jnp.zeros((4, 8), jnp.float32))
 
     net = _dense_chain(2)
-    with pytest.raises(ValueError, match="at least pp=4 blocks"):
+    with pytest.raises(ValueError,
+                       match=r"at least pp\*virtual=4 blocks"):
         pipeline_stages(net, 4, sample=x)
     with pytest.raises(ValueError, match="sample"):
         pipeline_stages(_dense_chain(4), 2)
@@ -548,3 +549,60 @@ def test_trainer_pipeline_passthrough():
     with pytest.raises(ValueError, match="positive microbatch"):
         Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
                 pipeline=0)
+
+
+# -- interleaved virtual-stage schedule (Megatron arXiv:2104.04473) ---------
+
+def test_interleaved_schedule_tables_are_consistent():
+    """Every (m, virtual stage) runs exactly one fwd and one bwd, in
+    dependency order with the 1-tick wire latency, and the measured
+    length beats the non-interleaved schedule's tick count."""
+    from mxnet_tpu.parallel.pipeline import interleaved_schedule
+    n, v, M = 4, 2, 8
+    sch = interleaved_schedule(n, v, M)
+    L = n * v
+    col = {f: i for i, f in enumerate(sch.FIELDS)}
+    done = {}
+    for t in range(sch.total_ticks):
+        for r in range(n):
+            row = sch.table[t, r]
+            kind = int(row[col["op_kind"]])
+            if kind == 0:
+                continue
+            m, c = int(row[col["op_m"]]), int(row[col["op_c"]])
+            s = c * n + r
+            key = ("f" if kind == 1 else "b", m, s)
+            assert key not in done, key       # each op exactly once
+            done[key] = t
+            if kind == 1 and s > 0:
+                assert done[("f", m, s - 1)] < t
+            if kind == 2:
+                if s == L - 1:
+                    assert done[("f", m, s)] < t
+                else:
+                    assert done[("b", m, s + 1)] < t
+    assert len(done) == 2 * M * L
+    # measured bubble below the classic (n-1)/(M+n-1) floor
+    assert sch.bubble_ratio() < bubble_ratio(n, M)
+    assert sch.total_ticks == 2 * M * v + 2 * (n - 1)  # Megatron optimum
+
+
+def test_interleaved_schedule_rejects_uneven_microbatches():
+    from mxnet_tpu.parallel.pipeline import InterleavedSchedule
+    with pytest.raises(ValueError, match="divisible by pp"):
+        InterleavedSchedule(4, 2, 6)
+    with pytest.raises(ValueError, match="pp >= 2"):
+        InterleavedSchedule(1, 2, 8)
+
+
+def test_interleaved_bubble_ratio_formula():
+    from mxnet_tpu.parallel.pipeline import interleaved_bubble_ratio
+    # at the optimum T = 2Mv + 2(n-1) the ratio is (n-1)/(Mv + n-1)
+    n, v, M = 4, 2, 8
+    T = 2 * M * v + 2 * (n - 1)
+    assert interleaved_bubble_ratio(T, M, v) == pytest.approx(
+        (n - 1) / (M * v + n - 1))
+    # v=1 at T = 2M + 2(n-1) reduces to the classic ratio
+    T1 = 2 * M + 2 * (n - 1)
+    assert interleaved_bubble_ratio(T1, M, 1) == pytest.approx(
+        bubble_ratio(n, M))

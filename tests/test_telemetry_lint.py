@@ -251,6 +251,17 @@ def test_kv_tier_module_is_scanned_and_clean():
     assert _violations(path) == []
 
 
+def test_plan_module_is_scanned_and_clean():
+    """ParallelPlan.lower labels the goodput ledger with the plan axes
+    (set_plan_axes) — the module must be inside the lint's walk and
+    free of ungated telemetry sites (axis labels are registry state,
+    not per-step hot-path publishes, but any gauge/counter call it
+    grows later must ride the cost contract)."""
+    path = os.path.join(PKG, "parallel", "plan.py")
+    assert path in _module_files(), "plan.py missing from lint walk"
+    assert _violations(path) == []
+
+
 def test_speculative_module_is_scanned_and_clean():
     """Draft proposers run on the host inside the decode tick; the
     module must stay telemetry-free (accept-rate accounting lives in

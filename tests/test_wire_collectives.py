@@ -247,6 +247,53 @@ def test_pipeline_activation_wire_parity():
     assert sp_ref._wire_permuted[0] == sp_ref._wire_permuted[1]
 
 
+def test_trainer_pipeline_forwards_activation_compression():
+    """Trainer(pipeline=M) used to drop compression={"activations":...}
+    before the pipeline builder ever saw it (the no-pipeline degrade
+    fired on the forwarded config). The request now rides through the
+    Trainer into the fused step: no degrade warning, wire accounting
+    shows the int8 cut on BOTH requested axes, and the lowered HLO moves
+    8-bit payloads on each one (collective_permute for the activation
+    hops, all_gather for the ZeRO weight gathers)."""
+    net = _dense_chain(8)
+    mesh = hybrid_mesh(dp=2, pp=4)
+    net.initialize()
+    tr = mx.gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, kvstore="device",
+        compression_params={"activations": "int8", "weights": "int8"},
+        zero=1, pipeline=8)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step = FusedTrainStep(net, L2Loss(), tr, mesh=mesh)
+    assert not any("activation" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+    rs = np.random.RandomState(42)
+    x = NDArray(jnp.asarray(rs.rand(32, 128), jnp.float32))
+    y = NDArray(jnp.asarray(rs.rand(32, 128), jnp.float32))
+    float(step(x, y))
+    plg, pwr = step._wire_permuted
+    assert plg / pwr >= 3.5, (plg, pwr)
+    glg, gwr = step._wire_gathered
+    assert glg / gwr >= 3.5, (glg, gwr)
+    hyper = {"lr": jnp.asarray(0.1, jnp.float32),
+             "wd": jnp.asarray(0.0, jnp.float32),
+             "t": jnp.asarray(1, jnp.int32),
+             "rescale": jnp.asarray(1.0, jnp.float32)}
+    key = jax.random.PRNGKey(0)
+    txt = step._compiled.lower(step._tr, step._pp_mask, step._states,
+                               hyper, key, x._data, y._data).as_text()
+    lines = txt.splitlines()
+    assert any("collective-permute" in ln and ("u8" in ln or "s8" in ln)
+               for ln in lines) or \
+        any("collective_permute" in ln and "i8" in ln for ln in lines), \
+        "no 8-bit activation hop in the lowered step"
+    assert any(("all-gather" in ln or "all_gather" in ln)
+               and ("u8" in ln or "s8" in ln or "i8" in ln)
+               for ln in lines), \
+        "no 8-bit weight gather in the lowered step"
+
+
 def test_wire_dtypes_in_lowered_collectives():
     """The lowered StableHLO moves 1-byte payloads: collective_permute
     carries f8E4M3FN, all_gather carries i8 — proof the compression is
