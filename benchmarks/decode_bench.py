@@ -39,6 +39,17 @@ disaggregation (1 prefill + 1 decode replica streaming blocks over
 the router's kv channel, token-identical with zero extra decode
 compiles). `tier_pass` ANDs the three.
 
+--tenants runs the adversarial multi-tenant QoS leg: a batch-class
+flooder is shed by priority class at its per-tenant queue bound while
+the interactive victim must hold its TTFT/TPOT SLO end to end
+(`tenant_pass`, headline `bench_tenant_victim_ttft_p95_ms`).
+
+--lora runs the batched multi-LoRA leg: the same workload on a
+base-only server and as a 3-way base/adapter mix through one rank-8
+adapter table inside the SAME decode executable — headline
+`bench_lora_mix_vs_base_ratio` gated >= 0.8x with zero compiles added
+after the adapters hot-load.
+
 One JSON line, rc 0, BudgetGuard — same contract as every bench here.
 """
 import argparse
@@ -1232,6 +1243,195 @@ def tiering_phase(on_tpu, guard, seed=0):
     guard.emit()
 
 
+def _bench_factors(net, rank, seed, targets=("wq", "wv")):
+    """Strong random (A, B) LoRA factors sized off the live params —
+    the bench measures the gather/matmul cost of a REAL adapter mix,
+    not the training quality of the factors."""
+    rng = np.random.RandomState(seed)
+    name_map = {"wq": "q_proj", "wv": "v_proj"}
+    params = net.collect_params()
+    factors = []
+    for li in range(net.model.cfg.num_layers):
+        lf = {}
+        for t in targets:
+            W = params[f"model.layers.{li}.self_attn."
+                       f"{name_map[t]}.weight"]
+            dout, din = np.asarray(W.data()._data).shape
+            lf[t] = (rng.normal(0, 0.05, (din, rank)).astype(np.float32),
+                     rng.normal(0, 0.05, (rank, dout)).astype(np.float32))
+        factors.append(lf)
+    return factors
+
+
+def tenants_phase(on_tpu, guard, num_requests=24, seed=0):
+    """--tenants: the adversarial multi-tenant QoS leg. A batch-class
+    flooder hammers the server far past its per-tenant queue bound
+    while an interactive victim trickles requests under a real
+    TTFT/TPOT SLO. Pass = the flood is shed by priority class
+    (serve_shed_total{class="batch"} matches), the victim is NEVER
+    shed, and every victim request lands inside its SLO — weighted-
+    fair scheduling is what keeps the victim's tokens flowing while
+    the flooder's queue slots churn."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import InferenceServer
+
+    cfg, net = _build_net(on_tpu, serve=True)
+    if on_tpu:
+        slots, max_len, block, mpl, new = 8, 256, 16, 32, 32
+        ttft_slo, tpot_slo = 1.0, 0.05
+    else:
+        slots, max_len, block, mpl, new = 4, 64, 8, 16, 12
+        ttft_slo, tpot_slo = 5.0, 0.5
+
+    telemetry.enable()
+    server = InferenceServer(
+        net, batch_slots=slots, max_len=max_len, block_size=block,
+        max_prompt_len=mpl,
+        tenants={"victim": {"weight": 4.0, "priority": "interactive",
+                            "ttft_slo_s": ttft_slo,
+                            "tpot_slo_s": tpot_slo},
+                 "flood": {"weight": 1.0, "priority": "batch",
+                           "max_queued": slots}})
+    rs = np.random.RandomState(seed)
+    server.submit(rs.randint(0, cfg.vocab_size, 8).astype(np.int32), 2,
+                  tenant="victim")
+    server.run()                         # warm: both executables built
+
+    flood, victim = [], []
+    rounds = max(4, num_requests // 4)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        # the flooder bursts 4x the victim's rate every round; its
+        # per-tenant bound sheds the excess at admission
+        for _ in range(4):
+            p = rs.randint(0, cfg.vocab_size, 8).astype(np.int32)
+            flood.append(server.submit(p, max_new_tokens=new,
+                                       tenant="flood"))
+        p = rs.randint(0, cfg.vocab_size, 8).astype(np.int32)
+        victim.append(server.submit(p, max_new_tokens=new,
+                                    tenant="victim"))
+        for _ in range(3):
+            server.step()
+    server.run()
+    wall = time.perf_counter() - t0
+
+    v_ok = [r for r in victim if r.status == "ok"]
+    v_ttft = np.array([r.ttft for r in v_ok]) if v_ok else np.zeros(1)
+    v_tpot = np.array([(r.t_finish - r.t_first_token)
+                       / max(1, len(r.output_tokens) - 1)
+                       for r in v_ok]) if v_ok else np.zeros(1)
+    flood_shed = sum(1 for r in flood if r.status == "rejected")
+    flood_ok = sum(1 for r in flood if r.status == "ok")
+    victim_shed = sum(1 for r in victim if r.status == "rejected")
+    slo_ok = sum(1 for tt, tp in zip(v_ttft, v_tpot)
+                 if tt <= ttft_slo and tp <= tpot_slo)
+    attainment = (slo_ok / len(victim)) if victim else 0.0
+    fam = telemetry._REGISTRY.get("serve_shed_total")
+    by_class = {dict(k).get("class"): c.value
+                for k, c in (fam.children.items() if fam else ())
+                if k}
+    class_ordered = (by_class.get("batch", 0) == flood_shed
+                     and "interactive" not in by_class)
+    tenant_pass = bool(flood_shed > 0 and victim_shed == 0
+                       and attainment == 1.0 and class_ordered
+                       and flood_ok > 0)
+    telemetry.set_gauge("bench_tenant_victim_ttft_p95_ms",
+                        float(np.percentile(v_ttft, 95)) * 1e3)
+    telemetry.set_gauge("bench_tenant_flood_shed_total", flood_shed)
+    telemetry.unregister_health_source(server)
+    telemetry.disable()
+    telemetry.reset()
+
+    guard.best.update({
+        "value": round(float(np.percentile(v_ttft, 95)) * 1e3, 2),
+        "phase": "tenants",
+        "tenant_pass": tenant_pass,
+        "bench_tenant_victim_ttft_p95_ms":
+            round(float(np.percentile(v_ttft, 95)) * 1e3, 2),
+        "bench_tenant_victim_tpot_p95_ms":
+            round(float(np.percentile(v_tpot, 95)) * 1e3, 2),
+        "bench_tenant_victim_slo_attainment": round(attainment, 4),
+        "bench_tenant_flood_shed_total": flood_shed,
+        "bench_tenant_victim_shed_total": victim_shed,
+        "shed_by_class": {k: int(v) for k, v in by_class.items()},
+        "flood_served": flood_ok,
+        "victim_requests": len(victim),
+        "wall_s": round(wall, 3),
+        **{k: v for k, v in server.compile_stats().items()},
+    })
+    guard.emit()
+
+
+def lora_phase(on_tpu, guard, num_requests=16, seed=0):
+    """--lora: batched multi-LoRA throughput leg. The identical
+    closed-loop workload runs on a base-only server and again as a
+    3-way base/adapter-1/adapter-2 mix through one rank-8 adapter
+    table (per-slot indices traced into the SAME decode executable).
+    Headline bench_lora_mix_vs_base_ratio = mixed tokens/sec / base
+    tokens/sec — the gate is >= 0.8x at rank <= 8 with ZERO compiles
+    added after the adapters hot-load."""
+    import jax
+
+    from mxnet_tpu.serving import InferenceServer
+
+    cfg, net = _build_net(on_tpu, serve=True)
+    if on_tpu:
+        slots, max_len, block, mpl, new = 8, 256, 16, 32, 64
+    else:
+        slots, max_len, block, mpl, new = 4, 64, 8, 16, 16
+    rank = 8
+    rs = np.random.RandomState(seed)
+    workload = [rs.randint(0, cfg.vocab_size,
+                           int(rs.randint(4, mpl + 1))).astype(np.int32)
+                for _ in range(num_requests)]
+    total_new = num_requests * new
+
+    def timed_run(server, adapters):
+        for i, p in enumerate(workload):
+            server.submit(p, max_new_tokens=new,
+                          adapter=adapters[i % len(adapters)])
+        t0 = time.perf_counter()
+        server.run()
+        return time.perf_counter() - t0
+
+    base = InferenceServer(net, batch_slots=slots, max_len=max_len,
+                           block_size=block, max_prompt_len=mpl)
+    base.submit(workload[0], max_new_tokens=2)
+    base.run()                                  # warm
+    base_tps = total_new / timed_run(base, [None])
+
+    lsrv = InferenceServer(net, batch_slots=slots, max_len=max_len,
+                           block_size=block, max_prompt_len=mpl,
+                           lora={"capacity": 4, "rank": rank})
+    lsrv.submit(workload[0], max_new_tokens=2)
+    lsrv.run()                                  # warm BEFORE hot-load
+    cs0 = dict(lsrv.compile_stats())
+    lsrv.load_adapter("a1", _bench_factors(net, rank, seed + 1))
+    lsrv.load_adapter("a2", _bench_factors(net, rank, seed + 2))
+    mix_tps = total_new / timed_run(lsrv, [None, "a1", "a2"])
+    cs1 = dict(lsrv.compile_stats())
+    extra = sum(cs1[k] - cs0.get(k, 0) for k in cs1
+                if k.endswith("_compiles"))
+
+    chips = max(1, jax.local_device_count())
+    ratio = mix_tps / base_tps if base_tps else 0.0
+    guard.best.update({
+        "value": round(ratio, 4),
+        "phase": "lora",
+        "lora_pass": bool(ratio >= 0.8 and extra == 0),
+        "bench_lora_mix_vs_base_ratio": round(ratio, 4),
+        "bench_lora_base_tokens_per_sec": round(base_tps, 2),
+        "bench_lora_mix_tokens_per_sec": round(mix_tps, 2),
+        "bench_lora_mix_tokens_per_sec_per_chip":
+            round(mix_tps / chips, 2),
+        "bench_lora_extra_compiles": int(extra),
+        "lora_rank": rank,
+        "adapters_loaded": lsrv.stats()["adapters"]["loaded"],
+        "requests": num_requests,
+    })
+    guard.emit()
+
+
 def main():
     global _guard
     ap = argparse.ArgumentParser()
@@ -1260,6 +1460,16 @@ def main():
                          "warm-restart leg (persistent prefix store, "
                          "TTFT ratio vs cold), and a disaggregated "
                          "prefill->decode streaming leg")
+    ap.add_argument("--tenants", action="store_true",
+                    help="adversarial multi-tenant QoS bench: a "
+                         "batch-class flooder is shed by priority "
+                         "class while the interactive victim must "
+                         "hold its TTFT/TPOT SLO")
+    ap.add_argument("--lora", action="store_true",
+                    help="batched multi-LoRA bench: a 3-way "
+                         "base/adapter mix through one rank-8 adapter "
+                         "table vs the base-only server (>=0.8x "
+                         "tokens/sec gate, zero extra compiles)")
     ap.add_argument("--slo", action="store_true",
                     help="with --fleet: add SLO legs — a clean leg "
                          "where the burn-rate alert must stay silent "
@@ -1273,6 +1483,10 @@ def main():
 
     if args.paged_kernel:
         metric, unit = "paged_decode_bytes_ratio", "x"
+    elif args.tenants:
+        metric, unit = "bench_tenant_victim_ttft_p95_ms", "ms"
+    elif args.lora:
+        metric, unit = "bench_lora_mix_vs_base_ratio", "x"
     elif args.oom_forecast:
         metric, unit = "oom_forecast_preemptions_avoided", "count"
     elif args.tiering:
@@ -1296,6 +1510,12 @@ def main():
     guard.emit()
     if args.paged_kernel:
         paged_kernel_phase(on_tpu, guard)
+    elif args.tenants:
+        tenants_phase(on_tpu, guard, num_requests=args.requests,
+                      seed=args.seed)
+    elif args.lora:
+        lora_phase(on_tpu, guard, num_requests=args.requests,
+                   seed=args.seed)
     elif args.oom_forecast:
         oom_forecast_phase(on_tpu, guard, seed=args.seed)
     elif args.tiering:

@@ -506,6 +506,15 @@ def main_telemetry_overhead():
             getattr(_kvt.KVTierManager, _hook)
         hook_noops[(_kvt.KVTierManager, _hook)] = \
             lambda self, *a, **k: None
+    # the multi-LoRA tenancy funnels (shed/TTFT/TPOT/finish/token/
+    # gauge publishes in serving/lora.py) are module-level hooks on
+    # the same contract — no-op them on the B side too
+    from mxnet_tpu.serving import lora as _lsrv
+    for _hook in ("_note_adapter", "_note_shed", "_note_ttft",
+                  "_note_tpot", "_note_finish", "_note_tokens",
+                  "_note_tenant_gauges"):
+        saved_hooks[(_lsrv, _hook)] = getattr(_lsrv, _hook)
+        hook_noops[(_lsrv, _hook)] = lambda *a, **k: None
 
     a_ms, b_ms = [], []
     for _ in range(rounds):

@@ -679,8 +679,23 @@ _LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_bytes", "_ratio",
 _HIGHER_BETTER_MARKERS = ("per_s", "per_sec", "throughput", "speedup",
                           "tok_s", "tokens_s", "mfu", "hfu", "goodput")
 
+#: explicit per-metric direction pins (checked before the heuristics)
+#: for bench metrics whose names the suffix rules would misread.
+#: True = lower is better. bench_lora_mix_vs_base_ratio is a
+#: THROUGHPUT ratio (mixed-adapter tokens/sec over base — the `_ratio`
+#: suffix would flip it); the tenant-QoS leg's SLO attainment and shed
+#: counters carry no latency suffix at all.
+_DIRECTION_OVERRIDES = {
+    "bench_lora_mix_vs_base_ratio": False,        # higher is better
+    "bench_lora_extra_compiles": True,            # 0 is the contract
+    "bench_tenant_victim_slo_attainment": False,  # fraction inside SLO
+    "bench_tenant_victim_shed_total": True,       # victim sheds = harm
+}
+
 
 def _lower_is_better(metric: str) -> bool:
+    if metric in _DIRECTION_OVERRIDES:
+        return _DIRECTION_OVERRIDES[metric]
     m = metric.lower()
     if any(k in m for k in _HIGHER_BETTER_MARKERS):
         return False

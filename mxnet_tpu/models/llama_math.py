@@ -25,7 +25,24 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["rms", "rope_at", "layer_qkv", "swiglu", "layer_finish",
-           "decoder_layer", "final_logits"]
+           "decoder_layer", "final_logits", "lora_delta"]
+
+
+def lora_delta(h, ab):
+    """Low-rank LoRA residual ``(h @ A) @ B`` for one target matmul.
+
+    ``ab = (A, B)`` with A ``(din, r)`` / B ``(r, dout)`` — one adapter
+    shared by the whole batch (training) — or A ``(B, din, r)`` /
+    B ``(B, r, dout)`` — per-row factors gathered from a stacked
+    adapter table (serving: every batch row can run a different
+    adapter inside ONE executable). The all-zero identity adapter
+    contributes an exact 0.0, so ``y + lora_delta`` is bit-identical
+    to the base matmul for rows without an adapter."""
+    a, b = ab
+    if a.ndim == 2:
+        return (h @ a) @ b
+    return jnp.einsum("btr,bro->bto",
+                      jnp.einsum("btd,bdr->btr", h, a), b)
 
 
 def rms(x, g, eps):
@@ -57,36 +74,48 @@ def rope_at(x, positions, base):
                            axis=-1).astype(x.dtype)
 
 
-def layer_qkv(lp, x, positions, eps, base, H, K, d):
+def layer_qkv(lp, x, positions, eps, base, H, K, d, lora=None):
     """Pre-attention half of a layer: RMSNorm → q/k/v projections →
     RoPE. lp holds {ln1, wq, wk, wv} (Dense convention: y = x @ W.T).
-    Returns (q (B,T,H,d), k (B,T,K,d), v (B,T,K,d)) — k/v post-RoPE,
-    ready for the cache."""
+    `lora` (optional) maps a target name among {"wq","wk","wv"} to its
+    (A, B) factors — see :func:`lora_delta`. Returns (q (B,T,H,d),
+    k (B,T,K,d), v (B,T,K,d)) — k/v post-RoPE, ready for the cache."""
     B, T, _ = x.shape
     h = rms(x, lp["ln1"], eps)
-    q = (h @ lp["wq"].T).reshape(B, T, H, d)
-    k = (h @ lp["wk"].T).reshape(B, T, K, d)
-    v = (h @ lp["wv"].T).reshape(B, T, K, d)
-    q = rope_at(q, positions, base)
-    k = rope_at(k, positions, base)
-    return q, k, v
+    q = h @ lp["wq"].T
+    k = h @ lp["wk"].T
+    v = h @ lp["wv"].T
+    if lora:
+        if "wq" in lora:
+            q = q + lora_delta(h, lora["wq"])
+        if "wk" in lora:
+            k = k + lora_delta(h, lora["wk"])
+        if "wv" in lora:
+            v = v + lora_delta(h, lora["wv"])
+    q = rope_at(q.reshape(B, T, H, d), positions, base)
+    k = rope_at(k.reshape(B, T, K, d), positions, base)
+    return q, k, v.reshape(B, T, K, d)
 
 
 def swiglu(h, w_gate, w_up, w_down):
     return (jax.nn.silu(h @ w_gate.T) * (h @ w_up.T)) @ w_down.T
 
 
-def layer_finish(lp, x, att, eps):
+def layer_finish(lp, x, att, eps, lora=None):
     """Post-attention half: o-projection residual, RMSNorm, SwiGLU
-    residual. att: (B, T, H, d)."""
+    residual. att: (B, T, H, d). `lora` may carry "wo" factors."""
     B, T, _ = x.shape
-    x = x + att.reshape(B, T, -1) @ lp["wo"].T
+    a2 = att.reshape(B, T, -1)
+    proj = a2 @ lp["wo"].T
+    if lora and "wo" in lora:
+        proj = proj + lora_delta(a2, lora["wo"])
+    x = x + proj
     h2 = rms(x, lp["ln2"], eps)
     return x + swiglu(h2, lp["gate"], lp["up"], lp["down"])
 
 
 def decoder_layer(lp, x, positions, eps, base, H, K, d, lengths=None,
-                  use_flash=True, return_kv=False):
+                  use_flash=True, return_kv=False, lora=None):
     """One full decoder layer on (B, T, D): the training forward and
     the prefill forward are THIS function (prefill passes ragged
     `lengths` and return_kv=True to harvest the cache rows).
@@ -94,11 +123,12 @@ def decoder_layer(lp, x, positions, eps, base, H, K, d, lengths=None,
     everything else (kernels/flash_attention.py)."""
     from ..kernels.flash_attention import flash_attention_raw
 
-    q, k, v = layer_qkv(lp, x, positions, eps, base, H, K, d)
+    q, k, v = layer_qkv(lp, x, positions, eps, base, H, K, d,
+                        lora=lora)
     att = flash_attention_raw(q, k, v, causal=True,
                               scale=1.0 / math.sqrt(d),
                               use_flash=use_flash, lengths=lengths)
-    out = layer_finish(lp, x, att, eps)
+    out = layer_finish(lp, x, att, eps, lora=lora)
     return (out, k, v) if return_kv else out
 
 

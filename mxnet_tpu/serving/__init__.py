@@ -34,6 +34,14 @@ request scheduler instead of one-shot `generate()` calls.
   for speculative decoding (`InferenceServer(speculative=k)` verifies
   k drafts per tick in one dispatch; chunked prefill rides
   `prefill_chunk_tokens=C` — both tail-latency levers in one tick).
+- `lora.AdapterPool` / `lora.WeightedFairScheduler` /
+  `lora.TenantSpec` — batched multi-LoRA serving + tenant QoS: a
+  device-resident stacked adapter table whose per-slot indices are
+  traced executable operands (any adapter mix, hot-load, or eviction
+  at ZERO extra compiles), weighted-fair admission / prefill-budget /
+  decode accounting across tenants, priority-class shedding, and
+  per-tenant SLO objectives (`InferenceServer(lora=..., tenants=...)`,
+  `submit(tenant=..., adapter=...)`).
 
 See docs/serving.md for the architecture and the block-table math.
 """
@@ -42,10 +50,13 @@ from . import kv_tier
 from . import sampling
 from . import executables
 from . import speculative
+from . import lora
 from . import server
 from . import router
 from .kv_cache import PagedKVCache
 from .kv_tier import KVTierManager, PrefixStore
+from .lora import (AdapterPool, WeightedFairScheduler, TenantSpec,
+                   TenantObjective)
 from .server import InferenceServer, Request, ServerStalledError
 from .speculative import NgramProposer
 from .router import (FleetRouter, FleetRequest, LocalReplica,
@@ -55,8 +66,10 @@ from .router import (FleetRouter, FleetRequest, LocalReplica,
 __all__ = ["PagedKVCache", "KVTierManager", "PrefixStore",
            "InferenceServer", "Request",
            "ServerStalledError", "NgramProposer",
+           "AdapterPool", "WeightedFairScheduler", "TenantSpec",
+           "TenantObjective",
            "FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
            "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
            "run_fleet_worker",
            "kv_cache", "kv_tier", "sampling", "executables", "server",
-           "router", "speculative"]
+           "router", "speculative", "lora"]

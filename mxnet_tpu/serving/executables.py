@@ -206,7 +206,8 @@ def _quant_rows(rows):
 def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
                    block_size: int, max_prompt_len: int,
                    kv_cache_dtype: str = "model",
-                   prefill_chunk: int = 0, spec_k: int = 0):
+                   prefill_chunk: int = 0, spec_k: int = 0,
+                   lora=None):
     """Serving executables over a paged pool:
 
     prefill(params, pages, bt_row, ids, valid_len, shared_len)
@@ -261,10 +262,21 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         logits[:, 0]); the scheduler discards rejected-suffix writes
         by not advancing pos (stale rows are masked by valid lengths
         and overwritten later).
+
+    ``lora`` (an AdapterPool ``signature()`` tuple — capacity, rank,
+    targets — or None) appends two traced operands to prefill /
+    prefill_chunk (``adapters, aid (1,)``) and decode / verify
+    (``adapters, aids (B,)``): the stacked per-layer factor tables and
+    the per-row table indices. The factors are GATHERED inside the
+    executable and applied as low-rank residuals on the target
+    matmuls, so every adapter mix, hot-load, and eviction shares the
+    same compiled program — only the table SHAPE (the signature) is
+    static. Index 0 is the identity adapter (exact +0.0).
     """
     st = program_store(net)
     key = ("paged", batch_slots, max_blocks_per_seq, block_size,
-           max_prompt_len, kv_cache_dtype, prefill_chunk, spec_k)
+           max_prompt_len, kv_cache_dtype, prefill_chunk, spec_k,
+           lora)
     ent = st.get(key)
     if ent is not None:
         return ent
@@ -292,6 +304,22 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         return flash_decode_paged_window(q, npg["k"], npg["v"],
                                          block_tables, vl)
 
+    n_layers = cfg.num_layers
+
+    def gather_lora(lo):
+        """Per-layer, per-target gather of each row's (A, B) factors
+        from the stacked adapter tables. `lo` is the optional trailing
+        (adapters, aids) operand pair — aids is a traced int32 row
+        vector, so every adapter mix shares the executable. Returns a
+        per-layer list of llama_math `lora` dicts (all None when LoRA
+        is off: the traced graph is then IDENTICAL to a LoRA-less
+        build)."""
+        if not lo:
+            return [None] * n_layers
+        adapters, aids = lo
+        return [{t: (tab["a"][aids], tab["b"][aids])
+                 for t, tab in layer.items()} for layer in adapters]
+
     def write_rows(pg, blk_ids, offs, k_rows, v_rows):
         """Scatter per-token rows into the pool. blk_ids/offs (T,),
         rows (T, K, d). Advanced indices around the K slice put the
@@ -306,8 +334,10 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         return {"k": pg["k"].at[blk_ids, :, offs, :].set(k_rows),
                 "v": pg["v"].at[blk_ids, :, offs, :].set(v_rows)}
 
-    def prefill(params, pages, bt_row, ids, valid_len, shared_len):
+    def prefill(params, pages, bt_row, ids, valid_len, shared_len,
+                *lo):
         B, T = ids.shape                       # B == 1
+        la = gather_lora(lo)
         x = params["embed"][ids]
         positions = jnp.arange(T)
         t = jnp.arange(T)
@@ -319,10 +349,10 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
                         bt_row[t // bs], 0)
         offs = t % bs
         new_pages = []
-        for lp, pg in zip(params["layers"], pages):
+        for li, (lp, pg) in enumerate(zip(params["layers"], pages)):
             x, k, v = llama_math.decoder_layer(
                 lp, x, positions, cfg.rms_eps, cfg.rope_base, H, K, d,
-                lengths=valid_len, return_kv=True)
+                lengths=valid_len, return_kv=True, lora=la[li])
             new_pages.append(write_rows(pg, blk, offs, k[0], v[0]))
         x = llama_math.rms(x, params["norm"], cfg.rms_eps)
         idx = jnp.maximum(valid_len - 1, 0)
@@ -330,7 +360,8 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         return new_pages, last @ params["head"].T
 
     def decode(params, pages, block_tables, pos, last_logits, keys,
-               temps, top_ks, top_ps, active):
+               temps, top_ks, top_ps, active, *lo):
+        la = gather_lora(lo)
         split = jax.vmap(partial(jax.random.split, num=2))(keys)
         keys_sample, keys_next = split[:, 0], split[:, 1]
         tok = sample_tokens(last_logits, keys_sample, temps, top_ks,
@@ -341,10 +372,10 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
         vl = jnp.where(active, pos + 1, 1)
         x = params["embed"][tok][:, None, :]
         new_pages = []
-        for lp, pg in zip(params["layers"], pages):
+        for li, (lp, pg) in enumerate(zip(params["layers"], pages)):
             q, k, v = llama_math.layer_qkv(lp, x, pos[:, None],
                                            cfg.rms_eps, cfg.rope_base,
-                                           H, K, d)
+                                           H, K, d, lora=la[li])
             npg = write_rows(pg, blk, offs, k[:, 0], v[:, 0])
             if q8:
                 att = flash_decode_paged_quantized(
@@ -353,14 +384,16 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
             else:
                 att = flash_decode_paged(q[:, 0], npg["k"], npg["v"],
                                          block_tables, vl)[:, None]
-            x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+            x = llama_math.layer_finish(lp, x, att, cfg.rms_eps,
+                                        lora=la[li])
             new_pages.append(npg)
         logits = llama_math.final_logits(params, x, cfg.rms_eps)[:, 0]
         return new_pages, tok, logits, keys_next
 
     def make_prefill_chunk(C):
         def prefill_chunk_fn(params, pages, bt_row, ids, chunk_start,
-                             chunk_len):
+                             chunk_len, *lo):
+            la = gather_lora(lo)
             t = jnp.arange(C)
             gpos = chunk_start[0] + t                    # global pos
             valid = t < chunk_len[0]
@@ -374,13 +407,15 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
             positions = gpos[None, :]
             bt2 = bt_row[None, :]
             new_pages = []
-            for lp, pg in zip(params["layers"], pages):
+            for li, (lp, pg) in enumerate(zip(params["layers"],
+                                              pages)):
                 qh, k, v = llama_math.layer_qkv(
                     lp, x, positions, cfg.rms_eps, cfg.rope_base,
-                    H, K, d)
+                    H, K, d, lora=la[li])
                 npg = write_rows(pg, blk, offs, k[0], v[0])
                 att = window_attention(qh, npg, bt2, vl)
-                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps,
+                                            lora=la[li])
                 new_pages.append(npg)
             x = llama_math.rms(x, params["norm"], cfg.rms_eps)
             idx = jnp.maximum(chunk_len - 1, 0)
@@ -393,7 +428,8 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
     def make_verify(W):
         def verify(params, pages, block_tables, pos, last_logits,
                    keys, temps, top_ks, top_ps, active, draft,
-                   draft_len):
+                   draft_len, *lo):
+            la = gather_lora(lo)
             # token 0: the SAME split + sample as decode, so sampled
             # rows' PRNG streams are tick-for-tick identical
             split = jax.vmap(partial(jax.random.split, num=2))(keys)
@@ -415,13 +451,16 @@ def paged_programs(net, *, batch_slots: int, max_blocks_per_seq: int,
             x = params["embed"][w]                         # (B, W, D)
             fb, fo = blk.reshape(-1), offs.reshape(-1)
             new_pages = []
-            for lp, pg in zip(params["layers"], pages):
+            for li, (lp, pg) in enumerate(zip(params["layers"],
+                                              pages)):
                 qh, k, v = llama_math.layer_qkv(
-                    lp, x, P, cfg.rms_eps, cfg.rope_base, H, K, d)
+                    lp, x, P, cfg.rms_eps, cfg.rope_base, H, K, d,
+                    lora=la[li])
                 npg = write_rows(pg, fb, fo, k.reshape(-1, K, d),
                                  v.reshape(-1, K, d))
                 att = window_attention(qh, npg, block_tables, vl)
-                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps)
+                x = llama_math.layer_finish(lp, x, att, cfg.rms_eps,
+                                            lora=la[li])
                 new_pages.append(npg)
             logits = llama_math.final_logits(params, x, cfg.rms_eps)
             # greedy accept: candidate j survives iff every candidate

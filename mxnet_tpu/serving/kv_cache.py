@@ -355,7 +355,7 @@ class PagedKVCache:
 
     # -- prefix-cache sharing -----------------------------------------------
 
-    def match_prefix(self, tokens) -> tuple:
+    def match_prefix(self, tokens, root=None) -> tuple:
         """Admit-time longest-common-prefix match of `tokens` against
         registered resident content (running AND finished-but-not-yet-
         reused slots). Returns (blocks, shared_len): the physical
@@ -363,13 +363,19 @@ class PagedKVCache:
         full-chunk matches plus at most one tail block where one
         side's tokens are a prefix of the other's. Never shares on
         genuine mid-block divergence (that would require overwriting
-        shared content at admit time)."""
+        shared content at admit time).
+
+        `root` namespaces the chain: None is the base-model namespace;
+        a LoRA request passes its adapter sentinel (the server's
+        ``("__lora__", name)``) so KV content computed under adapter X
+        is NEVER matched by adapter Y or the base model — same tokens,
+        different weights, different cache rows."""
         if not self.prefix_cache or len(tokens) == 0:
             return [], 0
         bs = self.block_size
         toks = tuple(int(t) for t in tokens)
         blocks: List[int] = []
-        parent = None
+        parent = root
         i = 0
         limit = min(len(toks), self.max_blocks_per_seq * bs)
         while i + bs <= limit:
@@ -396,7 +402,8 @@ class PagedKVCache:
                 shared_len += best[1]
         return blocks, shared_len
 
-    def alloc_shared(self, slot: int, tokens) -> Optional[dict]:
+    def alloc_shared(self, slot: int, tokens,
+                     root=None) -> Optional[dict]:
         """Allocate `slot` for prompt `tokens`, adopting matched
         prefix blocks (refcount + 1) instead of writing them again.
         Returns None (nothing allocated) if the pool cannot cover the
@@ -418,7 +425,7 @@ class PagedKVCache:
                 f"sequence of {T} tokens needs {need} blocks "
                 f"> max_blocks_per_seq={self.max_blocks_per_seq}")
         bs = self.block_size
-        shared, shared_len = self.match_prefix(tokens)
+        shared, shared_len = self.match_prefix(tokens, root=root)
         cow_src = None
         claim_tail = False
         if T > shared_len and shared_len % bs != 0:
@@ -496,17 +503,18 @@ class PagedKVCache:
             self._purge(blk)
         return None
 
-    def register_prefix(self, slot: int, tokens):
+    def register_prefix(self, slot: int, tokens, root=None):
         """Publish `slot`'s prefilled content into the prefix index
         (call AFTER the prefill that wrote it). Chunks chain onto the
         canonical path: if identical content is already registered
         under another block, the existing entry wins and our block
-        stays unregistered (dedup prefers the older copy)."""
+        stays unregistered (dedup prefers the older copy). `root`
+        namespaces the chain per adapter — see :meth:`match_prefix`."""
         if not self.prefix_cache:
             return
         bs = self.block_size
         toks = tuple(int(t) for t in tokens)
-        parent = None
+        parent = root
         for idx, blk in enumerate(self._slot_blocks[slot]):
             chunk = toks[idx * bs:(idx + 1) * bs]
             if not chunk:

@@ -68,9 +68,17 @@ __all__ = ["KVTierManager", "PrefixStore", "TierBlock"]
 
 def _flatten_key(key) -> tuple:
     """Expand an allocator chain key (parent_key, chunk) into the flat
-    token tuple of the WHOLE prefix it certifies."""
+    token tuple of the WHOLE prefix it certifies. Adapter-namespaced
+    chains (rooted at a non-chain sentinel like ``("__lora__", name)``
+    instead of None) return () — their content is only valid under
+    that adapter's weights, so the tier never spills, persists, or
+    streams it (the on_register/on_purge hooks no-op on empty
+    tokens)."""
     parts = []
     while key is not None:
+        if not (isinstance(key, tuple) and len(key) == 2
+                and isinstance(key[1], tuple)):
+            return ()
         parts.append(key[1])
         key = key[0]
     out: List[int] = []

@@ -99,6 +99,7 @@ import numpy as np
 from .. import faults as _ft
 from .. import flight as _fl
 from .. import telemetry
+from .lora import priority_rank
 from .server import InferenceServer
 
 __all__ = ["FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
@@ -279,15 +280,20 @@ class FleetRequest:
 
     def __init__(self, prompt, max_new_tokens: int, temperature=0.0,
                  top_k=0, top_p=0.0, eos_id=None, seed=0,
-                 deadline_s=None):
+                 deadline_s=None, tenant=None, priority=None,
+                 adapter=None):
         self.id = FleetRequest._next_id
         FleetRequest._next_id += 1
         self.token = f"q{self.id}-{uuid.uuid4().hex[:8]}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
+        # tenant/priority/adapter ride params so LocalReplica and the
+        # ProcReplica wire protocol ship them without a second channel
         self.params = {"temperature": float(temperature),
                        "top_k": int(top_k), "top_p": float(top_p),
-                       "eos_id": eos_id, "seed": int(seed)}
+                       "eos_id": eos_id, "seed": int(seed),
+                       "tenant": tenant, "priority": priority,
+                       "adapter": adapter}
         self.state = "queued"           # queued | inflight | finished
         #: terminal: "ok" | "rejected" | "failed" | "timed_out" |
         #: "cancelled"; None while live
@@ -402,7 +408,10 @@ class LocalReplica:
             temperature=fr.params["temperature"],
             top_k=fr.params["top_k"], top_p=fr.params["top_p"],
             eos_id=fr.params["eos_id"], seed=fr.params["seed"],
-            deadline_s=deadline_s, trace_ctx=attempt_key)
+            deadline_s=deadline_s, trace_ctx=attempt_key,
+            tenant=fr.params.get("tenant"),
+            priority=fr.params.get("priority"),
+            adapter=fr.params.get("adapter"))
         return req
 
     def prefill_export(self, fr: FleetRequest, key: str):
@@ -697,6 +706,7 @@ class FleetRouter:
         # python-side counters mirroring the telemetry ones, so
         # stats() answers even with telemetry disabled
         self.n_shed = 0
+        self.n_adapter_misses = 0
         self.n_retries = 0
         self.n_failovers = 0
         self.n_hedges = 0
@@ -712,30 +722,63 @@ class FleetRouter:
 
     # -- intake --------------------------------------------------------------
 
+    def _shed(self, fr: FleetRequest):
+        """Terminate one request as shed (status ``rejected``, reason
+        ``shed``) — class-labeled so dashboards see WHO overload is
+        costing."""
+        fr.state = "finished"
+        fr.status = _REJECTED
+        fr.finish_reason = "shed"
+        fr.t_finish = time.time()
+        self.finished.append(fr)
+        self.n_shed += 1
+        if telemetry._ENABLED:
+            telemetry.inc("serve_shed_total")
+            telemetry.inc(
+                "serve_shed_total",
+                **{"class": fr.params.get("priority") or "standard"})
+        if _fl._ENABLED:
+            _fl.record("route", "router.shed", token=fr.token,
+                       queued=len(self._queue),
+                       priority=fr.params.get("priority"))
+
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, eos_id: Optional[int] = None,
                seed: int = 0,
-               deadline_s: Optional[float] = None) -> FleetRequest:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None,
+               adapter: Optional[str] = None) -> FleetRequest:
         """Enqueue one request on the fleet. Under saturation (the
-        bounded fleet queue is full) the request is returned already
-        terminal with status ``rejected`` — shedding never raises, so
-        drivers can count rejections like any other outcome."""
+        bounded fleet queue is full) shedding is by PRIORITY CLASS,
+        not FIFO: if some queued request ranks below the newcomer, the
+        lowest-ranked most-recently-queued one is shed to make room;
+        otherwise the newcomer itself is shed. Either way the shed
+        request is returned/left already terminal with status
+        ``rejected`` — shedding never raises, so drivers can count
+        rejections like any other outcome. ``tenant`` / ``priority`` /
+        ``adapter`` forward to the serving replica (tenant QoS +
+        batched LoRA); the adapter must be hot-loaded on the replicas
+        that will serve it."""
         fr = FleetRequest(prompt_ids, max_new_tokens, temperature,
-                          top_k, top_p, eos_id, seed, deadline_s)
+                          top_k, top_p, eos_id, seed, deadline_s,
+                          tenant=tenant, priority=priority,
+                          adapter=adapter)
         if len(self._queue) >= self.max_fleet_queue:
-            fr.state = "finished"
-            fr.status = _REJECTED
-            fr.finish_reason = "shed"
-            fr.t_finish = time.time()
-            self.finished.append(fr)
-            self.n_shed += 1
-            if telemetry._ENABLED:
-                telemetry.inc("serve_shed_total")
-            if _fl._ENABLED:
-                _fl.record("route", "router.shed", token=fr.token,
-                           queued=len(self._queue))
-            return fr
+            rank = priority_rank(priority)
+            victim = None
+            for i in range(len(self._queue) - 1, -1, -1):
+                q = self._queue[i]
+                qr = priority_rank(q.params.get("priority"))
+                if qr < rank and (victim is None or qr < victim[1]):
+                    victim = (i, qr)
+            if victim is None:
+                self._shed(fr)
+                return fr
+            shed_fr = self._queue[victim[0]]
+            del self._queue[victim[0]]
+            self._shed(shed_fr)
         self._queue.append(fr)
         return fr
 
@@ -890,10 +933,16 @@ class FleetRouter:
 
     # -- dispatch ------------------------------------------------------------
 
-    def _affinity_key(self, prompt) -> Optional[int]:
+    def _affinity_key(self, prompt, adapter=None,
+                      tenant=None) -> Optional[int]:
         """Hash of the prompt's leading block-sized chunks — exactly
         the prefix cache's chain keys, so equal keys mean shareable
-        blocks on whichever replica served the key last."""
+        blocks on whichever replica served the key last. The adapter
+        name and tenant join the hash: adapter KV is namespaced in the
+        replica's prefix cache (same tokens under adapter X share
+        nothing with adapter Y), and same-tenant traffic tends to
+        repeat the same system prompts, so splitting affinity by
+        tenant keeps each tenant's working set hot on its replica."""
         if self.affinity_blocks <= 0:
             return None
         bs = self.block_size
@@ -904,7 +953,8 @@ class FleetRouter:
         n = (min(len(prompt), self.affinity_blocks * bs) // bs) * bs
         if n == 0:
             return None
-        return hash(tuple(int(t) for t in prompt[:n]))
+        return hash((adapter, tenant)
+                    + tuple(int(t) for t in prompt[:n]))
 
     def _eligible(self, rep: _Rep, now: float) -> bool:
         if rep.state in (DEAD, DRAINING) or rep.detail is None:
@@ -979,7 +1029,25 @@ class FleetRouter:
                 if len(safe) < len(elig) and telemetry._ENABLED:
                     telemetry.inc("router_exhaust_diverted_total")
                 elig = safe
-        key = self._affinity_key(fr.prompt)
+        adapter = fr.params.get("adapter")
+        if adapter is not None:
+            # adapter-residency routing: prefer replicas that already
+            # hold the adapter in their device table (loading is a
+            # host->device table write, not a recompile, but the
+            # factors still have to ship). No resident replica is a
+            # MISS — counted, then served least-loaded anyway:
+            # availability over affinity.
+            resident = [rep for rep in elig
+                        if adapter in ((rep.detail or {})
+                                       .get("adapters") or ())]
+            if resident:
+                elig = resident
+            else:
+                self.n_adapter_misses += 1
+                if telemetry._ENABLED:
+                    telemetry.inc("serve_adapter_misses_total")
+        key = self._affinity_key(fr.prompt, adapter,
+                                 fr.params.get("tenant"))
         if key is not None:
             tgt = self._affinity.get(key)
             if tgt is not None and tgt in elig:
@@ -1440,7 +1508,9 @@ class FleetRouter:
                 "inflight": len(self._inflight),
                 "finished": len(self.finished),
                 "status_counts": by_status,
-                "shed": self.n_shed, "retries": self.n_retries,
+                "shed": self.n_shed,
+                "adapter_misses": self.n_adapter_misses,
+                "retries": self.n_retries,
                 "failovers": self.n_failovers, "hedges": self.n_hedges,
                 "duplicates": self.n_duplicates,
                 "prefill_exports": self.n_prefill_exports,
@@ -1795,7 +1865,10 @@ def run_fleet_worker(channel, name: str,
                             eos_id=cmd.get("eos_id"),
                             seed=cmd.get("seed", 0),
                             deadline_s=cmd.get("deadline_s"),
-                            trace_ctx=tok)
+                            trace_ctx=tok,
+                            tenant=cmd.get("tenant"),
+                            priority=cmd.get("priority"),
+                            adapter=cmd.get("adapter"))
                     except Exception as e:
                         res = json.dumps(
                             {"status": "rejected", "tokens": [],

@@ -72,6 +72,22 @@ Tail-latency machinery (chunked prefill + speculative decoding):
   Greedy output is token-identical to the plain tick; sampled rows
   never ride drafts.
 
+Multi-LoRA + tenant QoS (serving/lora.py):
+
+- ``lora=`` attaches an :class:`~mxnet_tpu.serving.lora.AdapterPool`:
+  requests name a hot-loaded adapter and the slot's table INDEX rides
+  into prefill/decode/verify as a traced operand — arbitrary adapter
+  mixes, hot-loads, and evictions share the base 1 prefill + 1 decode
+  (+1 verify) compiles. Adapter KV is prefix-cache-namespaced by
+  adapter name (never shared with the base model or other adapters)
+  and never tiers.
+- ``tenants=`` / ``submit(tenant=...)`` engage a stride weighted-fair
+  scheduler over admission order, the chunked-prefill token budget,
+  and decode-token accounting; per-tenant ``max_queued`` sheds (status
+  ``rejected``, reason ``shed``) instead of raising, and TenantSpec
+  SLO thresholds become tenant-scoped Objectives over the bounded
+  ``tenant=``-labeled ttft/tpot histograms.
+
 Robustness (fault tolerance PR): per-request deadlines (expired
 requests finish with status ``timed_out``), a preemption retry cap
 (``preempted``), a watchdog that raises after `watchdog_ticks`
@@ -103,6 +119,7 @@ from .. import telemetry
 from ..ndarray import NDArray
 from .kv_cache import PagedKVCache
 from . import executables
+from . import lora as _lora
 
 __all__ = ["Request", "InferenceServer", "ServerStalledError"]
 
@@ -125,9 +142,18 @@ class Request:
     _next_id = 0
 
     def __init__(self, prompt, max_new_tokens, temperature, top_k,
-                 top_p, eos_id, seed, deadline_s=None, trace_ctx=None):
+                 top_p, eos_id, seed, deadline_s=None, trace_ctx=None,
+                 tenant=None, priority=None, adapter=None):
         self.id = Request._next_id
         Request._next_id += 1
+        #: tenant QoS: owning tenant name (None = untenanted), priority
+        #: class (shed ordering), LoRA adapter name + its table row
+        #: (0 = the identity adapter — base-model rows)
+        self.tenant = None if tenant is None else str(tenant)
+        self.priority = None if priority is None else str(priority)
+        self.adapter = None if adapter is None else str(adapter)
+        self.adapter_idx = 0
+        self._adapter_held = False
         #: distributed trace context: the fleet router's idempotency
         #: token for the attempt that carried this request (None for
         #: direct submits); stitched back into the fleet timeline
@@ -242,7 +268,8 @@ class InferenceServer:
                  tier_spill_exhaust_s: Optional[float] = 3.0,
                  tier_spill_batch: int = 4,
                  tier_prefetch_timeout_s: Optional[float] = None,
-                 prefix_store_dir: Optional[str] = None):
+                 prefix_store_dir: Optional[str] = None,
+                 lora=None, tenants=None):
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         cfg = net.model.cfg
@@ -267,6 +294,27 @@ class InferenceServer:
         self.prefill_chunk_tokens = prefill_chunk_tokens
         from .speculative import as_proposer
         self._spec = as_proposer(speculative)
+        # batched multi-LoRA: a fixed-capacity device-resident adapter
+        # table; per-slot table INDICES are traced executable operands,
+        # so every adapter mix / hot-load / eviction shares the one
+        # compiled prefill/decode(/verify). `lora` is an AdapterPool,
+        # True (defaults), or a kwargs dict for AdapterPool(net, ...).
+        if lora is not None and not isinstance(lora, _lora.AdapterPool):
+            kw = {} if lora is True else dict(lora)
+            lora = _lora.AdapterPool(net, **kw)
+        self.lora = lora
+        # tenant QoS: specs + lazily-engaged weighted-fair scheduler —
+        # without tenants the admission path stays plain FIFO
+        self._tenants = {}
+        self._wfs = None
+        self.tenant_objectives = {}
+        #: bounded `tenant=` telemetry label space: past the cap every
+        #: new tenant reports as "other" (cardinality contract)
+        self._tenant_label_cap = 16
+        self._tenant_labels = set()
+        if tenants:
+            for name, spec in tenants.items():
+                self.register_tenant(name, spec)
         max_blocks = max_len // block_size
         if num_blocks is None:
             num_blocks = batch_slots * max_blocks + 1
@@ -283,7 +331,9 @@ class InferenceServer:
             block_size=block_size, max_prompt_len=self.max_prompt_len,
             kv_cache_dtype=kv_cache_dtype,
             prefill_chunk=prefill_chunk_tokens or 0,
-            spec_k=self._spec.k if self._spec is not None else 0)
+            spec_k=self._spec.k if self._spec is not None else 0,
+            lora=self.lora.signature() if self.lora is not None
+            else None)
 
         # KV-block memory hierarchy (serving/kv_tier.py): host-RAM
         # spill tier + optional disk-backed persistent prefix store.
@@ -340,6 +390,10 @@ class InferenceServer:
         self._temps = np.zeros(B, np.float32)
         self._top_ks = np.zeros(B, np.int32)
         self._top_ps = np.zeros(B, np.float32)
+        # per-slot LoRA table row (0 = identity): a traced decode/
+        # verify operand like temps/top_ks, so adapter mixes never
+        # re-key the executables
+        self._adapter_ids = np.zeros(B, np.int32)
         self._slot_req: List[Optional[Request]] = [None] * B
         self._admit_seq = 0                 # admission order stamp
         self._slot_admit = np.zeros(B, np.int64)
@@ -411,19 +465,105 @@ class InferenceServer:
         from ..models.llama_infer import _params_tree
         self._params = _params_tree(self.net)
 
+    # -- tenants + adapters -------------------------------------------------
+
+    def register_tenant(self, name: str, spec=None) -> "_lora.TenantSpec":
+        """Register (or update) a tenant's QoS contract. `spec` is a
+        :class:`~mxnet_tpu.serving.lora.TenantSpec`, a kwargs dict, or
+        None (defaults). The first registration engages the weighted-
+        fair scheduler for admission / prefill-budget / decode-token
+        accounting; unknown tenants submitting later auto-register with
+        default QoS."""
+        name = str(name)
+        spec = _lora.TenantSpec() if spec is None \
+            else _lora.TenantSpec.coerce(spec)
+        self._tenants[name] = spec
+        if self._wfs is None:
+            self._wfs = _lora.WeightedFairScheduler()
+        self._wfs.set_weight(name, spec.weight)
+        objs = spec.objectives(name)
+        if objs:
+            self.tenant_objectives[name] = objs
+        return spec
+
+    def _tenant_label(self, name: str) -> str:
+        """Bounded telemetry label for a tenant name: first
+        `_tenant_label_cap` distinct tenants keep their name, the rest
+        collapse into "other" so label cardinality stays fixed."""
+        if name in self._tenant_labels:
+            return name
+        if len(self._tenant_labels) < self._tenant_label_cap:
+            self._tenant_labels.add(name)
+            return name
+        return "other"
+
+    def load_adapter(self, name: str, adapter, scale=None) -> int:
+        """Hot-load (or update) a LoRA adapter into the device table —
+        safe under live traffic, ZERO recompiles (the table swap is
+        functional; only its shape is an executable build key). Returns
+        the table row."""
+        if self.lora is None:
+            raise RuntimeError(
+                "LoRA serving is off — construct the server with "
+                "lora=AdapterPool(net, ...) (or lora=True)")
+        return self.lora.load(name, adapter, scale=scale)
+
+    def evict_adapter(self, name: str):
+        """Drop a loaded adapter (refuses while live requests hold
+        it)."""
+        if self.lora is None:
+            raise RuntimeError("LoRA serving is off")
+        self.lora.evict(name)
+
+    def _lora_args(self, aids) -> tuple:
+        """The trailing (adapters, aids) executable operands — empty
+        when LoRA is off, so the dispatch signature exactly matches a
+        LoRA-less build."""
+        if self.lora is None:
+            return ()
+        return (self.lora.tables, jnp.asarray(aids, jnp.int32))
+
+    def _prefix_root(self, req: "Request"):
+        """Prefix-cache chain root for a request: adapter requests get
+        an adapter-namespaced sentinel root, so KV computed under
+        adapter X is NEVER shared with adapter Y or the base model
+        (same tokens, different weights => different cache content)."""
+        if req.adapter is None:
+            return None
+        return ("__lora__", req.adapter)
+
+    def _charge(self, req: "Request", amount: int):
+        """Weighted-fair accounting: `amount` tokens of service
+        (prefill or decode) against the request's tenant."""
+        if self._wfs is not None and amount > 0:
+            self._wfs.charge(req.tenant or "", amount)
+
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, eos_id: Optional[int] = None,
                seed: int = 0,
                deadline_s: Optional[float] = None,
-               trace_ctx: Optional[str] = None) -> Request:
+               trace_ctx: Optional[str] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None,
+               adapter: Optional[str] = None) -> Request:
         """Enqueue one request. prompt_ids: 1-D (or (1, T)) ints.
         ``deadline_s`` bounds the request's total wall-clock lifetime
         (queue wait included); past it the request finishes with
         status ``timed_out``. ``trace_ctx`` stamps a distributed trace
         context (the fleet router's per-attempt idempotency token) onto
         the request so its span timeline can be correlated across
-        processes."""
+        processes.
+
+        ``tenant`` attributes the request to a tenant's weighted-fair
+        share + telemetry/SLO scope (unknown tenants auto-register
+        with default QoS); ``priority`` overrides the tenant's shed
+        class; ``adapter`` names a loaded LoRA adapter to serve the
+        request through (ValueError when unknown — hot-load first).
+        Past a tenant's ``max_queued`` the request is SHED: returned
+        already-terminal (status ``rejected``, reason ``shed``), never
+        raised, so a flooding tenant sees backpressure while others
+        keep their share."""
         if self._shutdown or self._draining:
             if telemetry._ENABLED:
                 telemetry.inc("serving_requests_total", status=_REJECTED)
@@ -455,16 +595,51 @@ class InferenceServer:
                 f"(prompt {prompt.size} + {max_new_tokens} new tokens, "
                 f"block_size={self.block_size}) but the pool only has "
                 f"{capacity} — raise num_blocks or shrink the request")
+        spec = None
+        if tenant is not None:
+            tenant = str(tenant)
+            spec = self._tenants.get(tenant)
+            if spec is None:
+                spec = self.register_tenant(tenant)
+        if adapter is not None:
+            if self.lora is None:
+                raise ValueError(
+                    "request names adapter "
+                    f"{adapter!r} but LoRA serving is off — construct "
+                    "the server with lora=...")
+            if adapter not in self.lora._idx:
+                raise ValueError(
+                    f"adapter {adapter!r} is not loaded "
+                    f"(loaded: {self.lora.loaded()}) — "
+                    "load_adapter() first")
+        if priority is None and spec is not None:
+            priority = spec.priority
         req = Request(prompt, max_new_tokens, temperature, top_k,
                       top_p, eos_id, seed, deadline_s=deadline_s,
-                      trace_ctx=trace_ctx)
+                      trace_ctx=trace_ctx, tenant=tenant,
+                      priority=priority, adapter=adapter)
         req._trace_seq = self._submit_seq
         self._submit_seq += 1
         if self._trace_on:
             req._trace = []
             req._decode_windows = []
             req._tev("queued", t=req.t_submit)
+        # per-tenant queue bound: past it the request is shed, not
+        # raised — terminal status "rejected", reason "shed", exactly
+        # the FleetRouter overflow contract
+        if spec is not None and spec.max_queued is not None:
+            queued = sum(1 for r in self.queue if r.tenant == tenant)
+            if queued >= spec.max_queued:
+                _lora._note_shed(self._tenant_label(tenant),
+                                 req.priority)
+                self._terminate(req, "shed", _REJECTED)
+                return req
+        if req.adapter is not None:
+            req.adapter_idx = self.lora.acquire(req.adapter)
+            req._adapter_held = True
         self.queue.append(req)
+        if self._wfs is not None and tenant is not None:
+            self._wfs.activate(tenant)
         if telemetry._ENABLED:
             telemetry.inc("serving_requests_total")
         return req
@@ -503,6 +678,7 @@ class InferenceServer:
         self._temps[slot] = req.temperature
         self._top_ks[slot] = req.top_k
         self._top_ps[slot] = req.top_p
+        self._adapter_ids[slot] = req.adapter_idx
 
     def _admit_one(self, slot: int, req: Request,
                    shared_len: int = 0, cow=None):
@@ -564,38 +740,62 @@ class InferenceServer:
             self.cache.pages, last = self.programs["prefill"](
                 self._params, self.cache.pages, bt_row,
                 jnp.asarray(ids), jnp.asarray([T], jnp.int32),
-                jnp.asarray([shared_len], jnp.int32))
+                jnp.asarray([shared_len], jnp.int32),
+                *self._lora_args([req.adapter_idx]))
+        self._charge(req, T - shared_len)
         req._tev("prefill", t=t_pf,
                  dur_s=time.perf_counter() - t_pf, tokens=T)
         req._open_decode_window()
         if self.prefix_cache:
-            self.cache.register_prefix(slot, req.prompt)
+            self.cache.register_prefix(slot, req.prompt,
+                                       root=self._prefix_root(req))
             self._note_prefix_hit(req, shared_len)
         self._last_logits = self._last_logits.at[slot].set(
             last[0].astype(self._last_logits.dtype))
         self._pos[slot] = T
         self._seed_slot(slot, req)
 
+    def _next_queued(self) -> int:
+        """Queue index of the next request to admit: plain FIFO
+        without tenants; with tenants, the weighted-fair pick over
+        each tenant's FIFO head (untenanted requests compete as the
+        "" tenant at default weight)."""
+        if self._wfs is None or len(self.queue) <= 1:
+            return 0
+        heads = {}
+        for i, r in enumerate(self.queue):
+            t = r.tenant or ""
+            if t not in heads:
+                heads[t] = i
+        if len(heads) == 1:
+            return 0
+        return heads[self._wfs.pick(heads)]
+
     def _admit(self):
         admitted = 0
         free = self._free_slots()
         while self.queue and free:
-            req = self.queue[0]
+            qi = self._next_queued()
+            req = self.queue[qi]
+            root = self._prefix_root(req)
             # the prompt's blocks now; the first decode block comes
             # lazily via ensure()
             if self.prefix_cache:
-                if self.tier is not None:
+                if self.tier is not None and root is None:
                     # prefetch-on-LCP-match: restore host/disk-tier
                     # blocks extending the device prefix into PARKED
                     # blocks, so alloc_shared below adopts them (a
-                    # copy instead of a recompute)
+                    # copy instead of a recompute). Adapter-rooted
+                    # chains never tier — their content is only valid
+                    # under that adapter's weights.
                     self.tier.prefetch(req.prompt)
                 # alloc_shared is its own feasibility check: a prefix
                 # hit can admit where a cold can_alloc would refuse
-                plan = self.cache.alloc_shared(free[0], req.prompt)
+                plan = self.cache.alloc_shared(free[0], req.prompt,
+                                               root=root)
                 if plan is None:
                     break
-                self.queue.popleft()
+                del self.queue[qi]
                 slot = free.pop(0)
                 self._admit_one(slot, req,
                                 shared_len=plan["shared_len"],
@@ -603,7 +803,7 @@ class InferenceServer:
             else:
                 if not self.cache.can_alloc(len(req.prompt)):
                     break
-                self.queue.popleft()
+                del self.queue[qi]
                 slot = free.pop(0)
                 self.cache.alloc(slot, len(req.prompt))
                 self._admit_one(slot, req)
@@ -696,12 +896,32 @@ class InferenceServer:
         prefilled (watchdog progress units)."""
         C = self.prefill_chunk_tokens
         budget = C
-        order = sorted((i for i in range(self.batch_slots)
-                        if self._prefilling[i]),
-                       key=lambda i: self._slot_admit[i])
         any_work = False
-        for slot in order:
-            while budget > 0 and self._prefilling[slot]:
+        if self._wfs is None:
+            order = sorted((i for i in range(self.batch_slots)
+                            if self._prefilling[i]),
+                           key=lambda i: self._slot_admit[i])
+            for slot in order:
+                while budget > 0 and self._prefilling[slot]:
+                    budget -= self._prefill_chunk(slot, budget)
+                    any_work = True
+        else:
+            # weighted-fair chunk budget: each chunk goes to the
+            # minimum-pass tenant among in-prefill slots (admission-
+            # order tiebreak within a tenant), and _prefill_chunk
+            # charges the tokens — a long prompt from a flooding
+            # tenant cannot monopolize the per-tick budget
+            while budget > 0:
+                heads = {}
+                for slot in sorted(
+                        (i for i in range(self.batch_slots)
+                         if self._prefilling[i]),
+                        key=lambda i: self._slot_admit[i]):
+                    heads.setdefault(
+                        self._slot_req[slot].tenant or "", slot)
+                if not heads:
+                    break
+                slot = heads[self._wfs.pick(heads)]
                 budget -= self._prefill_chunk(slot, budget)
                 any_work = True
         used = C - budget
@@ -728,7 +948,9 @@ class InferenceServer:
             self.cache.pages, last = self.programs["prefill_chunk"](
                 self._params, self.cache.pages, bt_row,
                 jnp.asarray(ids), jnp.asarray([start], jnp.int32),
-                jnp.asarray([n], jnp.int32))
+                jnp.asarray([n], jnp.int32),
+                *self._lora_args([req.adapter_idx]))
+        self._charge(req, n)
         req._tev("prefill_chunk", t=t_pf,
                  dur_s=time.perf_counter() - t_pf, tokens=n,
                  start=start)
@@ -740,7 +962,8 @@ class InferenceServer:
         if start + n >= T:
             self._prefilling[slot] = False
             if self.prefix_cache:
-                self.cache.register_prefix(slot, req.prompt)
+                self.cache.register_prefix(slot, req.prompt,
+                                           root=self._prefix_root(req))
             self._last_logits = self._last_logits.at[slot].set(
                 last[0].astype(self._last_logits.dtype))
             self._pos[slot] = T
@@ -823,6 +1046,7 @@ class InferenceServer:
         self._prefilling[slot] = False
         self._prefill_pos[slot] = 0
         self._warm[slot] = False
+        self._adapter_ids[slot] = 0
         self._slot_req[slot] = None
 
     def _finish(self, slot: int, reason: str, status: str = _OK):
@@ -833,6 +1057,11 @@ class InferenceServer:
     def _terminate(self, req: Request, reason: str, status: str):
         """Terminal transition shared by running (post-evict) and
         still-queued requests."""
+        if req._adapter_held:
+            # refcount released here (not at evict): a preempted
+            # request still holds its adapter through the requeue
+            self.lora.release(req.adapter)
+            req._adapter_held = False
         req.state = _FINISHED
         req.finish_reason = reason
         req.status = status
@@ -845,10 +1074,21 @@ class InferenceServer:
             n = len(req.output_tokens)
             if req.t_first_token is not None \
                     and req.t_last_token is not None and n > 1:
-                telemetry.observe(
-                    "serving_tpot_seconds",
-                    (req.t_last_token - req.t_first_token) / (n - 1),
-                    spec="on" if self._spec is not None else "off")
+                tpot = (req.t_last_token - req.t_first_token) / (n - 1)
+                spec = "on" if self._spec is not None else "off"
+                if req.tenant is not None:
+                    # tenant-labeled INSTEAD of unlabeled (a global
+                    # Objective sums every child, so double-counting
+                    # would skew fleet-level SLO arithmetic)
+                    _lora._note_tpot(self._tenant_label(req.tenant),
+                                     tpot, spec)
+                else:
+                    telemetry.observe("serving_tpot_seconds", tpot,
+                                      spec=spec)
+            if req.tenant is not None:
+                lbl = self._tenant_label(req.tenant)
+                _lora._note_finish(lbl, status)
+                _lora._note_tokens(lbl, len(req.output_tokens))
         if _fl._ENABLED:
             _fl.record("sched", "serving.finish", request=req.id,
                        reason=reason, status=status)
@@ -935,7 +1175,8 @@ class InferenceServer:
                     jnp.asarray(self._top_ks),
                     jnp.asarray(self._top_ps),
                     jnp.asarray(self._active), jnp.asarray(drafts),
-                    jnp.asarray(dlens))
+                    jnp.asarray(dlens),
+                    *self._lora_args(self._adapter_ids))
                 wtok_np = np.asarray(wtok)   # (B, k+1) host sync
                 n_acc_np = np.asarray(n_acc)
             else:
@@ -947,13 +1188,15 @@ class InferenceServer:
                     self._keys, jnp.asarray(self._temps),
                     jnp.asarray(self._top_ks),
                     jnp.asarray(self._top_ps),
-                    jnp.asarray(self._active))
+                    jnp.asarray(self._active),
+                    *self._lora_args(self._adapter_ids))
                 # host sync = honest tick time
                 wtok_np = np.asarray(tok).reshape(-1, 1)
                 n_acc_np = np.zeros(self.batch_slots, np.int32)
         now = time.perf_counter()
         emitted = 0
         net_new = 0
+        tenant_tokens = {} if self._wfs is not None else None
         for slot in range(self.batch_slots):
             if not self._active[slot]:
                 continue
@@ -972,6 +1215,9 @@ class InferenceServer:
                     continue
                 req.output_tokens.append(t)
                 emitted += 1
+                if tenant_tokens is not None:
+                    tt = req.tenant or ""
+                    tenant_tokens[tt] = tenant_tokens.get(tt, 0) + 1
                 # tokens regenerated after a preemption were already
                 # counted before the preemption — only net-new tokens
                 # feed the throughput counters and tokens/sec window
@@ -984,7 +1230,10 @@ class InferenceServer:
                     req.t_last_token = now
                 if req.t_first_token is None:
                     req.t_first_token = now
-                    if telemetry._ENABLED and req.ttft is not None:
+                    if req.tenant is not None:
+                        _lora._note_ttft(
+                            self._tenant_label(req.tenant), req.ttft)
+                    elif telemetry._ENABLED and req.ttft is not None:
                         telemetry.observe("serving_ttft_seconds",
                                           req.ttft)
                 if req.eos_id >= 0 and t == req.eos_id:
@@ -1021,6 +1270,11 @@ class InferenceServer:
                 self._keys = self._keys.at[slot].set(
                     jnp.asarray(jax.random.PRNGKey(req.seed),
                                 jnp.uint32))
+        if tenant_tokens:
+            # decode tokens are weighted-fair service too: a tenant
+            # hogging slots pays in admission priority next round
+            for tt, n in tenant_tokens.items():
+                self._wfs.charge(tt, n)
         self.ticks += 1
         self.tokens_generated += net_new
         self._tok_window.append((now, net_new))
@@ -1099,6 +1353,20 @@ class InferenceServer:
                                 self.tier.host_blocks())
             for t, v in self.tier.hit_rates().items():
                 telemetry.set_gauge("serving_tier_hit_rate", v, tier=t)
+        if self._wfs is not None:
+            counts = {}
+            for r in self.queue:
+                if r.tenant:
+                    lbl = self._tenant_label(r.tenant)
+                    q, a = counts.get(lbl, (0, 0))
+                    counts[lbl] = (q + 1, a)
+            for r in self._slot_req:
+                if r is not None and r.tenant:
+                    lbl = self._tenant_label(r.tenant)
+                    q, a = counts.get(lbl, (0, 0))
+                    counts[lbl] = (q, a + 1)
+            if counts:
+                _lora._note_tenant_gauges(counts)
         if self._spec is not None and self._spec_window:
             prop = sum(p for _, p in self._spec_window)
             if prop:
@@ -1318,6 +1586,11 @@ class InferenceServer:
                "tiering": self.tier is not None}
         if self.tier is not None:
             out["tier_host_blocks"] = self.tier.host_blocks()
+        if self.lora is not None:
+            # adapter residency: the fleet router routes adapter
+            # traffic toward replicas that already hold the adapter
+            out["adapters"] = self.lora.loaded()
+            out["adapter_free_rows"] = self.lora.free_rows()
         return out
 
     def _assemble_trace(self, req: Request) -> dict:
@@ -1424,7 +1697,13 @@ class InferenceServer:
         age_p50 = float(np.percentile(ages, 50)) if ages else 0.0
         age_p95 = float(np.percentile(ages, 95)) if ages else 0.0
         spec_prop = self.spec_tokens_accepted + self.spec_tokens_rejected
+        extra = {}
+        if self.lora is not None:
+            extra["adapters"] = self.lora.stats()
+        if self._wfs is not None:
+            extra["tenant_passes"] = self._wfs.snapshot()
         return {"ticks": self.ticks,
+                **extra,
                 "queue_age_p50_s": age_p50,
                 "queue_age_p95_s": age_p95,
                 "tokens_generated": self.tokens_generated,

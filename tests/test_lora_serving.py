@@ -1,0 +1,527 @@
+"""Batched multi-LoRA serving + tenant QoS: adapter-table lifecycle,
+weighted-fair scheduling (proportionality + starvation-freedom),
+zero-recompile adapter mixes, greedy token parity vs merged-weights
+generate(), adapter-namespaced prefix isolation, and priority-class
+shedding."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.models.llama_infer import generate
+from mxnet_tpu.serving import (AdapterPool, InferenceServer,
+                               TenantSpec, WeightedFairScheduler)
+from mxnet_tpu.serving import lora as lora_mod
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    n = mx.models.get_model("llama_tiny")
+    n.initialize()
+    n(mx.nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize
+    return n
+
+
+def _random_factors(net, rank=4, targets=("wq", "wv"), seed=1,
+                    scale=0.3):
+    """Strong random (A, B) factors — large enough that greedy output
+    actually diverges from the base model."""
+    rng = np.random.default_rng(seed)
+    name_map = {"wq": "q_proj", "wk": "k_proj", "wv": "v_proj",
+                "wo": "o_proj"}
+    params = net.collect_params()
+    n_layers = net.model.cfg.num_layers
+    factors = []
+    for li in range(n_layers):
+        lf = {}
+        for t in targets:
+            W = params[f"model.layers.{li}.self_attn."
+                       f"{name_map[t]}.weight"]
+            dout, din = W.data()._data.shape
+            lf[t] = (rng.normal(0, scale, (din, rank)).astype(np.float32),
+                     rng.normal(0, scale, (rank, dout)).astype(np.float32))
+        factors.append(lf)
+    return factors
+
+
+# -- WeightedFairScheduler ---------------------------------------------------
+
+def test_wfs_weight_proportionality():
+    """Over a contended interval, picks (equal charge each) split in
+    proportion to the weights — the stride-scheduling invariant."""
+    wfs = WeightedFairScheduler({"heavy": 2.0, "light": 1.0})
+    served = {"heavy": 0, "light": 0}
+    for _ in range(300):
+        t = wfs.pick(["heavy", "light"])
+        served[t] += 1
+        wfs.charge(t, 1)
+    assert served["heavy"] == 200
+    assert served["light"] == 100
+
+
+def test_wfs_starvation_freedom():
+    """A tenant outweighed 100:1 is still picked within a bounded
+    number of rounds — passes only grow, so min-pass must rotate."""
+    wfs = WeightedFairScheduler({"flood": 100.0, "tiny": 1.0})
+    gap = 0
+    worst = 0
+    for _ in range(2000):
+        t = wfs.pick(["flood", "tiny"])
+        wfs.charge(t, 1)
+        if t == "tiny":
+            worst = max(worst, gap)
+            gap = 0
+        else:
+            gap += 1
+    assert worst <= 101      # bounded by the weight ratio, not infinity
+
+
+def test_wfs_idle_tenant_earns_no_credit():
+    """activate() snaps an idle tenant's pass to the virtual clock —
+    it cannot bank idle time into a monopolizing burst."""
+    wfs = WeightedFairScheduler()
+    wfs.set_weight("a", 1.0)
+    wfs.set_weight("b", 1.0)
+    # a is registered but idle; b runs alone through pick/charge, which
+    # advances the virtual clock along b's pass
+    for _ in range(50):
+        assert wfs.pick(["b"]) == "b"
+        wfs.charge("b", 1)
+    wfs.activate("a")        # a re-enters with pending work
+    assert wfs.pass_of("a") >= 49.0     # snapped forward, not 0
+    served = {"a": 0, "b": 0}
+    for _ in range(20):
+        t = wfs.pick(["a", "b"])
+        served[t] += 1
+        wfs.charge(t, 1)
+    # near-equal from here on: no 50-token repayment burst for a
+    assert abs(served["a"] - served["b"]) <= 2
+
+
+def test_wfs_fifo_tiebreak_and_validation():
+    wfs = WeightedFairScheduler()
+    assert wfs.pick(["first", "second"]) == "first"
+    with pytest.raises(ValueError):
+        wfs.pick([])
+    with pytest.raises(ValueError):
+        wfs.set_weight("x", 0.0)
+
+
+# -- AdapterPool lifecycle ---------------------------------------------------
+
+def test_adapter_pool_load_evict_refcounts(net):
+    pool = AdapterPool(net, capacity=3, rank=4)
+    f1 = _random_factors(net, seed=1)
+    f2 = _random_factors(net, seed=2)
+    i1 = pool.load("one", f1)
+    i2 = pool.load("two", f2)
+    assert i1 != i2 and 0 not in (i1, i2)   # row 0 is identity
+    assert pool.loaded() == ["one", "two"]
+    assert pool.free_rows() == 0
+    # refcount blocks eviction
+    assert pool.acquire("one") == i1
+    with pytest.raises(RuntimeError):
+        pool.evict("one")
+    pool.release("one")
+    pool.evict("one")
+    assert pool.loaded() == ["two"]
+    # update-in-place keeps the row
+    assert pool.load("two", f1) == i2
+    with pytest.raises(KeyError):
+        pool.index("one")
+
+
+def test_adapter_pool_lru_eviction_and_full_table(net):
+    pool = AdapterPool(net, capacity=3, rank=4)
+    pool.load("a", _random_factors(net, seed=1))
+    pool.load("b", _random_factors(net, seed=2))
+    # full: loading c evicts the least-recently-loaded refcount-0 (a)
+    pool.load("c", _random_factors(net, seed=3))
+    assert pool.loaded() == ["b", "c"]
+    # pin both, table full -> load refuses
+    pool.acquire("b")
+    pool.acquire("c")
+    with pytest.raises(RuntimeError):
+        pool.load("d", _random_factors(net, seed=4))
+
+
+def test_adapter_pool_validation(net):
+    with pytest.raises(ValueError):
+        AdapterPool(net, capacity=1)
+    with pytest.raises(ValueError):
+        AdapterPool(net, targets=("nope",))
+    pool = AdapterPool(net, capacity=3, rank=4)
+    bad = _random_factors(net, rank=5)      # wrong rank
+    with pytest.raises(ValueError):
+        pool.load("bad", bad)
+    with pytest.raises(ValueError):
+        pool.load("bad", _random_factors(net, targets=("wq",)))
+
+
+# -- serving parity + compile discipline -------------------------------------
+
+def test_lora_rows_match_merged_weights_and_base_rows_unchanged(net):
+    """The tentpole acceptance: mixed base/adapter rows in ONE batch —
+    adapter rows token-identical (greedy) to offline merged-weights
+    generate(), base rows bit-identical to a LoRA-less server, at the
+    base compile budget."""
+    factors = _random_factors(net, seed=7)
+    server = InferenceServer(net, batch_slots=4, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             lora={"capacity": 4, "rank": 4})
+    cs0 = server.compile_stats()
+    server.load_adapter("ad", factors)
+    rs = np.random.RandomState(11)
+    p1 = rs.randint(0, 256, 8).astype(np.int32)
+    p2 = rs.randint(0, 256, 6).astype(np.int32)
+    r_ad = server.submit(p1, max_new_tokens=6, adapter="ad")
+    r_base = server.submit(p2, max_new_tokens=6)
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] - cs0["prefill_compiles"] <= 1, cs
+    assert cs["decode_compiles"] - cs0["decode_compiles"] <= 1, cs
+    with lora_mod.merged_weights(net, factors):
+        ref = generate(net, p1[None, :], max_new_tokens=6, max_len=32)
+    np.testing.assert_array_equal(np.asarray(r_ad.output_tokens),
+                                  ref[0, len(p1):])
+    base_ref = generate(net, p2[None, :], max_new_tokens=6, max_len=32)
+    np.testing.assert_array_equal(np.asarray(r_base.output_tokens),
+                                  base_ref[0, len(p2):])
+    # the adapter actually did something
+    ad_off = generate(net, p1[None, :], max_new_tokens=6, max_len=32)
+    assert list(r_ad.output_tokens) != list(ad_off[0, len(p1):])
+
+
+def test_hot_load_mid_run_adds_zero_compiles(net):
+    """Adapters loaded/evicted between (and effectively during) runs
+    never re-key the executables: the table swap is functional and
+    only its SHAPE is a build key."""
+    server = InferenceServer(net, batch_slots=3, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             lora={"capacity": 4, "rank": 4})
+    rs = np.random.RandomState(5)
+    p = rs.randint(0, 256, 7).astype(np.int32)
+    server.submit(p, max_new_tokens=4)
+    server.run()
+    cs0 = server.compile_stats()
+    # hot-load two adapters and serve a mix — zero new compiles
+    server.load_adapter("x", _random_factors(net, seed=21))
+    server.load_adapter("y", _random_factors(net, seed=22))
+    rx = server.submit(p, max_new_tokens=4, adapter="x")
+    ry = server.submit(p, max_new_tokens=4, adapter="y")
+    rb = server.submit(p, max_new_tokens=4)
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] == cs0["prefill_compiles"], cs
+    assert cs["decode_compiles"] == cs0["decode_compiles"], cs
+    assert rx.output_tokens != ry.output_tokens
+    # evict + reload under no traffic: still zero compiles
+    server.evict_adapter("x")
+    server.load_adapter("z", _random_factors(net, seed=23))
+    rz = server.submit(p, max_new_tokens=4, adapter="z")
+    server.run()
+    assert server.compile_stats()["decode_compiles"] \
+        == cs0["decode_compiles"]
+    assert rz.status == "ok" and rb.status == "ok"
+
+
+def test_unknown_adapter_and_lora_off_raise(net):
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=4, max_prompt_len=8,
+                             lora={"capacity": 4, "rank": 4})
+    with pytest.raises(ValueError):
+        server.submit([1, 2, 3], 4, adapter="ghost")
+    plain = InferenceServer(net, batch_slots=2, max_len=32,
+                            block_size=4, max_prompt_len=8)
+    with pytest.raises(ValueError):
+        plain.submit([1, 2, 3], 4, adapter="ghost")
+    with pytest.raises(RuntimeError):
+        plain.load_adapter("a", [])
+
+
+@pytest.mark.parametrize("chunk,spec,prefix", [
+    (None, None, False),         # plain
+    (4, None, True),             # chunked x prefix sharing
+    (None, 3, False),            # speculation
+    (4, 2, True),                # everything at once
+])
+def test_lora_tenant_fuzz_grid(net, chunk, spec, prefix):
+    """Mixed adapter/tenant rows across chunked prefill x speculation
+    x prefix sharing: every row token-identical to its own reference
+    (merged weights for adapter rows, plain generate for base rows) at
+    <= 1 compile delta per executable."""
+    f1 = _random_factors(net, seed=31)
+    f2 = _random_factors(net, seed=32)
+    server = InferenceServer(net, batch_slots=3, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             prefix_cache=prefix,
+                             prefill_chunk_tokens=chunk,
+                             speculative=spec,
+                             lora={"capacity": 4, "rank": 4},
+                             tenants={"t0": {"weight": 2.0},
+                                      "t1": {"weight": 1.0}})
+    cs0 = server.compile_stats()
+    server.load_adapter("a1", f1)
+    server.load_adapter("a2", f2)
+    rs = np.random.RandomState(17 + (chunk or 0) + (spec or 0))
+    base = rs.randint(0, 256, 12).astype(np.int32)
+    reqs = []
+    for i in range(9):
+        T = int(rs.randint(3, 13))
+        p = base[:T].copy() if (prefix and i % 2 == 0) \
+            else rs.randint(0, 256, T).astype(np.int32)
+        new = int(rs.randint(2, 7))
+        adapter = [None, "a1", "a2"][i % 3]
+        tenant = ["t0", "t1", None][rs.randint(3)]
+        reqs.append((p, new, adapter,
+                     server.submit(p, max_new_tokens=new,
+                                   adapter=adapter, tenant=tenant)))
+    server.run()
+    cs = server.compile_stats()
+    assert cs["prefill_compiles"] - cs0["prefill_compiles"] <= 1, cs
+    assert cs["decode_compiles"] - cs0["decode_compiles"] <= 1, cs
+    assert cs.get("verify_compiles", 0) \
+        - cs0.get("verify_compiles", 0) <= 1, cs
+    refs = {None: None, "a1": f1, "a2": f2}
+    for p, new, adapter, r in reqs:
+        assert r.state == "finished" and r.status == "ok", r
+        if adapter is None:
+            one = generate(net, p[None, :], max_new_tokens=new,
+                           max_len=32)
+        else:
+            with lora_mod.merged_weights(net, refs[adapter]):
+                one = generate(net, p[None, :], max_new_tokens=new,
+                               max_len=32)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), one[0, len(p):],
+            err_msg=f"request {r.id} (adapter={adapter}) diverged "
+                    f"(chunk={chunk} spec={spec} prefix={prefix})")
+    assert server.cache.num_used_blocks == 0
+    server.cache.check()
+
+
+# -- prefix isolation --------------------------------------------------------
+
+def test_prefix_cache_never_shares_across_adapters(net):
+    """Regression: KV computed under adapter X must NEVER serve the
+    same tokens under adapter Y or the base model — the chain root is
+    namespaced by adapter name. Same-prompt requests under different
+    weights each stay parity-correct, and cross-adapter sharing is
+    zero while same-adapter sharing still works."""
+    f1 = _random_factors(net, seed=41)
+    f2 = _random_factors(net, seed=42)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             prefix_cache=True,
+                             lora={"capacity": 4, "rank": 4})
+    server.load_adapter("a1", f1)
+    server.load_adapter("a2", f2)
+    p = np.arange(1, 9, dtype=np.int32)     # 8 tokens = 2 full blocks
+    # base first: registers the base-rooted chain
+    r0 = server.submit(p, max_new_tokens=5)
+    server.run()
+    # adapter X on the SAME tokens: a (wrong) base-chain hit would
+    # reuse base KV and corrupt the output
+    r1 = server.submit(p, max_new_tokens=5, adapter="a1")
+    server.run()
+    assert r1.prefix_tokens_shared == 0     # nothing crossed the root
+    r2 = server.submit(p, max_new_tokens=5, adapter="a2")
+    server.run()
+    assert r2.prefix_tokens_shared == 0
+    # same-adapter resubmit DOES share (the namespace works both ways)
+    r1b = server.submit(p, max_new_tokens=5, adapter="a1")
+    server.run()
+    assert r1b.prefix_tokens_shared >= 4
+    base_ref = generate(net, p[None, :], max_new_tokens=5, max_len=32)
+    np.testing.assert_array_equal(np.asarray(r0.output_tokens),
+                                  base_ref[0, len(p):])
+    for r, f in ((r1, f1), (r2, f2), (r1b, f1)):
+        with lora_mod.merged_weights(net, f):
+            ref = generate(net, p[None, :], max_new_tokens=5,
+                           max_len=32)
+        np.testing.assert_array_equal(
+            np.asarray(r.output_tokens), ref[0, len(p):],
+            err_msg="adapter KV leaked across the prefix namespace")
+    assert r1.output_tokens != r0.output_tokens
+    assert r2.output_tokens != r1.output_tokens
+
+
+def test_adapter_chains_never_reach_the_tier(net, tmp_path):
+    """Adapter-rooted chain keys flatten to () in the tier manager, so
+    they are never spilled, persisted, or streamed (their content is
+    only valid under that adapter's weights)."""
+    from mxnet_tpu.serving.kv_tier import _flatten_key
+    base_key = (((None, (1, 2, 3, 4)), (5, 6, 7, 8)))
+    assert _flatten_key(base_key) == (1, 2, 3, 4, 5, 6, 7, 8)
+    lora_key = ((("__lora__", "ad"), (1, 2, 3, 4)))
+    assert _flatten_key(lora_key) == ()
+    f1 = _random_factors(net, seed=51)
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=4, max_prompt_len=12,
+                             kv_tiering=True,
+                             prefix_store_dir=str(tmp_path / "store"),
+                             lora={"capacity": 4, "rank": 4})
+    server.load_adapter("ad", f1)
+    p = np.arange(1, 9, dtype=np.int32)
+    server.submit(p, max_new_tokens=4, adapter="ad")
+    server.submit(p[::-1].copy(), max_new_tokens=4)
+    server.run()
+    assert server.persist_prefixes() >= 0    # must not raise/loop
+    # nothing adapter-rooted landed in the host tier or the store
+    for key in list(server.tier._host):
+        assert key and all(isinstance(t, (int, np.integer))
+                           for t in key)
+
+
+# -- tenant QoS --------------------------------------------------------------
+
+def test_tenant_shed_and_priority_resolution(net):
+    server = InferenceServer(
+        net, batch_slots=1, max_len=32, block_size=4, max_prompt_len=8,
+        tenants={"bulk": {"weight": 1.0, "priority": "batch",
+                          "max_queued": 2}})
+    reqs = [server.submit([1, 2, 3], 4, tenant="bulk")
+            for _ in range(4)]
+    shed = [r for r in reqs if r.status == "rejected"]
+    live = [r for r in reqs if r.status != "rejected"]
+    # slot 0 admits nothing yet (no step); all 4 queue-or-shed: 2 kept
+    assert len(shed) == 2
+    for r in shed:
+        assert r.finish_reason == "shed"
+        assert r.priority == "batch"        # inherited from the spec
+    server.run()
+    for r in live:
+        assert r.status == "ok"
+
+
+def test_weighted_fair_admission_and_no_starvation(net):
+    """A flooding tenant cannot starve the light tenant: with 2x the
+    weight, the victim's requests all finish, and the flooder's
+    virtual pass ends ahead (it consumed more service per weight)."""
+    server = InferenceServer(
+        net, batch_slots=2, max_len=32, block_size=4, max_prompt_len=8,
+        tenants={"victim": {"weight": 2.0},
+                 "flood": {"weight": 1.0}})
+    rs = np.random.RandomState(3)
+    flood = [server.submit(rs.randint(0, 256, 6).astype(np.int32), 4,
+                           tenant="flood") for _ in range(8)]
+    vict = [server.submit(rs.randint(0, 256, 6).astype(np.int32), 4,
+                          tenant="victim") for _ in range(3)]
+    # victims submitted LAST but must not wait for all 8 flooders:
+    # track finish order
+    server.run()
+    assert all(r.status == "ok" for r in vict + flood)
+    order = [r.tenant for r in server.finished]
+    # at least one victim finished before the last flooder
+    assert order.index("victim") < len(order) - 1 - \
+        order[::-1].index("flood")
+    passes = server.stats()["tenant_passes"]
+    assert passes["flood"] >= passes["victim"]
+
+
+def test_tenant_objective_scopes_to_one_tenant(net):
+    """TenantObjective samples ONLY its tenant's labeled children, so
+    one tenant's latency burn cannot hide in another's traffic."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        server = InferenceServer(
+            net, batch_slots=2, max_len=32, block_size=4,
+            max_prompt_len=8,
+            tenants={"fast": {"ttft_slo_s": 60.0},
+                     "slow": {"ttft_slo_s": 1e-9}})
+        server.submit([1, 2, 3], 3, tenant="fast")
+        server.submit([4, 5, 6], 3, tenant="slow")
+        server.run()
+        reg = telemetry._REGISTRY
+        fast_obj = server.tenant_objectives["fast"][0]
+        slow_obj = server.tenant_objectives["slow"][0]
+        fg, ft = fast_obj.sample(reg)
+        sg, st = slow_obj.sample(reg)
+        assert ft == 1.0 and st == 1.0      # one TTFT observation each
+        assert fg == 1.0                    # 60 s threshold: good
+        assert sg == 0.0                    # 1 ns threshold: bad
+    finally:
+        telemetry.reset()
+
+
+def test_tenant_telemetry_labels_and_shed_class(net):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        server = InferenceServer(
+            net, batch_slots=1, max_len=32, block_size=4,
+            max_prompt_len=8,
+            tenants={"bulk": {"priority": "batch", "max_queued": 1}})
+        server.submit([1, 2, 3], 3, tenant="bulk")
+        shed = server.submit([1, 2, 3], 3, tenant="bulk")
+        assert shed.status == "rejected"
+        server.run()
+        fam = telemetry._REGISTRY["serve_shed_total"]
+        assert fam.children[()].value >= 1       # unlabeled total
+        assert any(dict(k).get("class") == "batch"
+                   for k in fam.children)
+        fam = telemetry._REGISTRY["serving_tenant_requests_total"]
+        assert any(dict(k).get("tenant") == "bulk"
+                   for k in fam.children)
+    finally:
+        telemetry.reset()
+
+
+def test_tenant_label_cap_overflows_to_other(net):
+    server = InferenceServer(net, batch_slots=1, max_len=32,
+                             block_size=4, max_prompt_len=8)
+    server._tenant_label_cap = 2
+    assert server._tenant_label("a") == "a"
+    assert server._tenant_label("b") == "b"
+    assert server._tenant_label("c") == "other"
+    assert server._tenant_label("a") == "a"     # sticky
+
+
+# -- fleet routing -----------------------------------------------------------
+
+def test_fleet_adapter_residency_routing_and_misses(net):
+    from mxnet_tpu.serving import FleetRouter, LocalReplica
+    f1 = _random_factors(net, seed=61)
+    mk = dict(batch_slots=2, max_len=32, block_size=4,
+              max_prompt_len=8, lora={"capacity": 4, "rank": 4})
+    s0 = InferenceServer(net, **mk)
+    s1 = InferenceServer(net, **mk)
+    s1.load_adapter("ad", f1)
+    router = FleetRouter([LocalReplica(s0, name="r0"),
+                          LocalReplica(s1, name="r1")],
+                         max_fleet_queue=8)
+    frs = [router.submit([1, 2, 3, 4], 3, adapter="ad")
+           for _ in range(3)]
+    router.run(timeout_s=60)
+    for fr in frs:
+        assert fr.status == "ok"
+        assert fr.replica == "r1"           # resident replica won
+    assert router.n_adapter_misses == 0
+    # adapter nowhere resident: served anyway, miss counted
+    s1.evict_adapter("ad")
+    s0.load_adapter("ad", f1)               # move it to r0
+    fr = router.submit([1, 2, 3, 4], 3, adapter="ad")
+    router.run(timeout_s=60)
+    assert fr.status == "ok" and fr.replica == "r0"
+
+
+def test_fleet_shed_by_priority_class(net):
+    from mxnet_tpu.serving import FleetRouter, LocalReplica
+    server = InferenceServer(net, batch_slots=1, max_len=32,
+                             block_size=4, max_prompt_len=8)
+    router = FleetRouter([LocalReplica(server, name="r0")],
+                         max_fleet_queue=2)
+    a = router.submit([1, 2], 3, priority="batch")
+    b = router.submit([1, 2], 3, priority="standard")
+    c = router.submit([1, 2], 3, priority="realtime")
+    # newcomer outranks: the lowest-class queued request (a) is shed
+    assert a.status == "rejected" and a.finish_reason == "shed"
+    assert b.status is None and c.status is None
+    d = router.submit([1, 2], 3, priority="batch")
+    # no lower-rank victim: the batch newcomer itself is shed
+    assert d.status == "rejected"
+    assert router.n_shed == 2
+    router.run(timeout_s=60)
+    assert b.status == "ok" and c.status == "ok"

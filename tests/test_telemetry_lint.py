@@ -270,3 +270,14 @@ def test_speculative_module_is_scanned_and_clean():
     assert path in _module_files(), \
         "speculative.py missing from lint walk"
     assert _violations(path) == []
+
+
+def test_lora_module_is_scanned_and_clean():
+    """Multi-LoRA tenancy funnels every shed/TTFT/TPOT/finish/token/
+    gauge publish through module-level `_note_*` hooks gated on
+    `_tm._ENABLED` (they double as the --telemetry-overhead B-side
+    no-op targets). The module must be inside the lint's walk and
+    free of ungated sites."""
+    path = os.path.join(PKG, "serving", "lora.py")
+    assert path in _module_files(), "lora.py missing from lint walk"
+    assert _violations(path) == []
