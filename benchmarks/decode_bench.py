@@ -784,6 +784,184 @@ def fleet_phase(on_tpu, guard, fleet_n=2, num_requests=16,
     telemetry.reset()
 
 
+def canary_phase(on_tpu, guard, seed=0):
+    """--canary: the canary-gated rolling-restart acceptance. Two legs
+    over the same up-front workload through 2 subprocess replicas on
+    the FileKV channel (worker telemetry + flight shipped via
+    heartbeats, an AnomalyEngine attached to the router):
+
+    - degrade leg: `replica.degrade:ms=300` armed in w0's env — alive,
+      heartbeating, ~30x slower between decode ticks. The canaried
+      restart re-admits w0 at 0.5 routing weight; the analysis catches
+      its inter-token latency drifting whole log2 buckets past the
+      fleet peer, rolls it back out of rotation
+      (router_canary_rollbacks_total >= 1) and collects
+      flight-bundle-canary_fail with evidence from >= 2 processes —
+      while every request still completes and the victim traffic on
+      the healthy peer holds its TPOT SLO.
+    - clean leg: no fault. The identical restart must promote the
+      canary with ZERO rollbacks and ZERO anomaly alerts (the engine
+      forgets the restarted replica's compile/clock anchors, so the
+      rebuild's recompiles don't read as a storm)."""
+    import tempfile
+
+    from mxnet_tpu import flight as _flight
+    from mxnet_tpu import telemetry as _telemetry
+    from mxnet_tpu.anomaly import CanarySpec
+    from mxnet_tpu.serving.router import FileKV, FleetRouter, ProcReplica
+
+    cfg_kw = dict(vocab_size=2048, hidden_size=256,
+                  intermediate_size=1024, num_layers=4, num_heads=8,
+                  num_kv_heads=4, max_seq_len=128, dtype="float32")
+    cfg_json = json.dumps(cfg_kw)
+
+    def leg(degrade):
+        d = tempfile.mkdtemp(prefix="fleet_canary_")
+        kv = FileKV(d)
+        extra_env = {"MXNET_TPU_TELEMETRY": "1",
+                     "MXNET_TPU_FLIGHT": "1",
+                     "MXNET_TPU_FLIGHT_DIR": d}
+        procs = [_fleet_spawn(
+            d, f"w{i}", cfg_json,
+            fault="replica.degrade:ms=300" if degrade and i == 0
+            else None,
+            extra_env=extra_env) for i in range(2)]
+        engine = None
+        try:
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 240:
+                if all(kv.get(f"fleet/w{i}/hb") is not None
+                       for i in range(2)):
+                    break
+                for i, p in enumerate(procs):
+                    if p.poll() is not None:
+                        raise RuntimeError(
+                            f"canary worker w{i} died during warmup "
+                            f"(rc={p.returncode}), see {d}/w{i}.log")
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(
+                    "canary workers never became healthy")
+
+            _telemetry.enable()
+            _flight.enable()
+            _flight.clear()
+            fleet = FleetRouter(
+                [ProcReplica(kv, f"w{i}") for i in range(2)],
+                affinity_blocks=0, backoff_base_s=0.01,
+                heartbeat_timeout_s=5.0, hedge_after_s=30.0)
+            # rate detectors stay off for this phase: the restart
+            # deliberately reshapes fleet throughput (drain halves
+            # it, promotion doubles it) and any z-score worth having
+            # would flag exactly that
+            engine = fleet.attach_anomaly(bundle_dir=d,
+                                          rate_metrics=())
+            # enough queued work to outlast drain + restart + canary
+            # window: the analysis needs live traffic through BOTH
+            # the canary and the peer after the restart
+            rs = np.random.RandomState(seed)
+            frs = [fleet.submit(
+                rs.randint(1, cfg_kw["vocab_size"], 6).astype(np.int32),
+                6) for _ in range(80)]
+            res = fleet.rolling_restart(
+                drain_timeout_s=90.0, restart_timeout_s=90.0,
+                replicas=["w0"],
+                canary=CanarySpec(weight=0.5, min_samples=4,
+                                  window_s=60.0, drift_buckets=2,
+                                  metrics=("serving_tpot_seconds",)),
+                canary_timeout_s=120.0, bundle_dir=d)
+            # snapshot at the verdict: the acceptance window is the
+            # restart itself, not the tail drain after it
+            alerts = engine.alerts_total
+            rollbacks = fleet.n_canary_rollbacks
+            promotions = fleet.n_canary_promotions
+            n_sources = 0
+            man = os.path.join(d, "flight-bundle-canary_fail",
+                               "manifest.json")
+            if os.path.exists(man):
+                with open(man) as f:
+                    n_sources = len(json.load(f)["sources"])
+            fleet.run(timeout_s=240)
+            ok = sum(1 for fr in frs if fr.status == "ok")
+            # victim traffic = requests the healthy peers served; TPOT
+            # strips the router queue wait, so its p95 shows whether
+            # the degradation leaked past the canary's weighted slice
+            tpots = [(fr.t_finish - fr.t_submit - fr.ttft_s)
+                     / max(len(fr.output_tokens) - 1, 1)
+                     for fr in frs
+                     if fr.status == "ok" and fr.replica != "w0"
+                     and fr.ttft_s is not None
+                     and fr.t_finish is not None
+                     and len(fr.output_tokens) > 1]
+            victim_p95 = float(np.percentile(tpots, 95)) if tpots \
+                else 0.0
+            fleet.stop_fleet(timeout_ms=30_000)
+            return {"verdict": res[0]["canary"],
+                    "reason": str((res[0]["report"] or {})
+                                  .get("reason", "")),
+                    "rollbacks": rollbacks, "promotions": promotions,
+                    "alerts": alerts, "bundle_sources": n_sources,
+                    "ok": ok, "n": len(frs),
+                    "victim_tpot_p95_ms": victim_p95 * 1e3}
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+            if engine is not None:
+                _telemetry.unregister_health_source(engine)
+            _telemetry.set_fleet_metrics_provider(None)
+            _flight.disable()
+            _flight.clear()
+            _telemetry.disable()
+            _telemetry.reset()
+
+    bad = leg(degrade=True)
+    clean = leg(degrade=False)
+
+    victim_slo_ms = 250.0   # the fault inflates canary TPOT to 300ms+
+    canary_pass = bool(
+        bad["verdict"] == "rolled_back" and bad["rollbacks"] >= 1
+        and bad["bundle_sources"] >= 2 and bad["ok"] == bad["n"]
+        and bad["victim_tpot_p95_ms"] <= victim_slo_ms
+        and clean["verdict"] == "promoted"
+        and clean["rollbacks"] == 0 and clean["alerts"] == 0
+        and clean["ok"] == clean["n"])
+    guard.best.update({
+        "value": 1.0 if canary_pass else 0.0,
+        "phase": "canary",
+        "workers_backend": "cpu",
+        "canary_pass": canary_pass,
+        "canary_degrade_verdict": bad["verdict"],
+        "canary_degrade_reason": bad["reason"][:120],
+        "canary_rollbacks": bad["rollbacks"],
+        "canary_bundle_sources": bad["bundle_sources"],
+        "canary_victim_tpot_p95_ms":
+            round(bad["victim_tpot_p95_ms"], 2),
+        "canary_victim_tpot_slo_ms": victim_slo_ms,
+        "canary_degrade_completed": f'{bad["ok"]}/{bad["n"]}',
+        "canary_clean_verdict": clean["verdict"],
+        "canary_clean_alerts": clean["alerts"],
+        "canary_clean_rollbacks": clean["rollbacks"],
+        "canary_clean_promotions": clean["promotions"],
+        "canary_clean_completed": f'{clean["ok"]}/{clean["n"]}',
+    })
+    _telemetry.enable()
+    for k, v in (("bench_canary_pass", canary_pass),
+                 ("bench_canary_rollbacks", bad["rollbacks"]),
+                 ("bench_canary_bundle_sources",
+                  bad["bundle_sources"]),
+                 ("bench_canary_victim_tpot_p95_ms",
+                  bad["victim_tpot_p95_ms"]),
+                 ("bench_canary_clean_alerts", clean["alerts"]),
+                 ("bench_canary_clean_rollbacks",
+                  clean["rollbacks"])):
+        _telemetry.set_gauge(k, float(v), bench="decode_canary")
+    guard.emit()
+    _telemetry.disable()
+    _telemetry.reset()
+
+
 def paged_kernel_phase(on_tpu, guard):
     """--paged-kernel: decode HBM bytes for the three decode-tick
     attention variants — contiguous flash-decode (the floor), the
@@ -1470,6 +1648,12 @@ def main():
                          "base/adapter mix through one rank-8 adapter "
                          "table vs the base-only server (>=0.8x "
                          "tokens/sec gate, zero extra compiles)")
+    ap.add_argument("--canary", action="store_true",
+                    help="canary-gated rolling-restart bench: a "
+                         "replica.degrade restart must auto-roll-back "
+                         "with a cross-process evidence bundle; a "
+                         "clean restart must promote with zero "
+                         "anomaly alerts and zero rollbacks")
     ap.add_argument("--slo", action="store_true",
                     help="with --fleet: add SLO legs — a clean leg "
                          "where the burn-rate alert must stay silent "
@@ -1483,6 +1667,8 @@ def main():
 
     if args.paged_kernel:
         metric, unit = "paged_decode_bytes_ratio", "x"
+    elif args.canary:
+        metric, unit = "bench_canary_pass", "bool"
     elif args.tenants:
         metric, unit = "bench_tenant_victim_ttft_p95_ms", "ms"
     elif args.lora:
@@ -1510,6 +1696,8 @@ def main():
     guard.emit()
     if args.paged_kernel:
         paged_kernel_phase(on_tpu, guard)
+    elif args.canary:
+        canary_phase(on_tpu, guard, seed=args.seed)
     elif args.tenants:
         tenants_phase(on_tpu, guard, num_requests=args.requests,
                       seed=args.seed)

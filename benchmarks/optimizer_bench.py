@@ -393,7 +393,7 @@ _FL_HOT = ("record", "dump")
 #: flight consumption hooks goodput.enable() would install) so the gap
 #: also covers the goodput ledger compiled in but disabled
 _GP_HOT = ("charge_span", "charge_gap", "note_compile", "note_tokens",
-           "note_train_step", "publish")
+           "note_tenant_tokens", "note_train_step", "publish")
 
 
 class _NullCtx:
@@ -490,12 +490,30 @@ def main_telemetry_overhead():
     from mxnet_tpu.serving import kv_tier as _kvt
     from mxnet_tpu.serving import router as _router
 
+    from mxnet_tpu import anomaly as _anom
+
     saved_hooks = {(_slo.SLOEngine, "tick"): _slo.SLOEngine.tick,
                    (_router.FleetRouter, "_note_result"):
-                       _router.FleetRouter._note_result}
+                       _router.FleetRouter._note_result,
+                   # the anomaly engine rides the router step loop the
+                   # same way the SLO engine does — tick is its only
+                   # hot entry, and the baseline observers are the
+                   # only per-sample work inside it
+                   (_anom.AnomalyEngine, "tick"):
+                       _anom.AnomalyEngine.tick,
+                   (_anom.BaselineStore, "observe_counter"):
+                       _anom.BaselineStore.observe_counter,
+                   (_anom.BaselineStore, "observe_histogram"):
+                       _anom.BaselineStore.observe_histogram}
     hook_noops = {(_slo.SLOEngine, "tick"):
                       lambda self, now=None: None,
                   (_router.FleetRouter, "_note_result"):
+                      lambda self, *a, **k: None,
+                  (_anom.AnomalyEngine, "tick"):
+                      lambda self, now=None: None,
+                  (_anom.BaselineStore, "observe_counter"):
+                      lambda self, *a, **k: None,
+                  (_anom.BaselineStore, "observe_histogram"):
                       lambda self, *a, **k: None}
     # the KV-tier telemetry funnels (spill/restore/stream/persist
     # accounting) ride the same contract — no-op them on the B side

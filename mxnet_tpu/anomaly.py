@@ -1,0 +1,873 @@
+"""Anomaly detection over learned baselines + canary analysis
+(SURVEY §12: the self-watching fleet).
+
+The fleet already *publishes* everything — merged registries on every
+heartbeat, SLO burn rates, goodput ledgers — but every alert so far is
+a hand-set threshold, and a replica that is slow-but-alive beats its
+heartbeat and evades all of them.  This module learns what "normal"
+looks like and flags departures:
+
+``BaselineStore``
+    Rolling statistical baselines fed straight from telemetry state:
+    EWMA mean/variance over counter *rates* (so spike/drop is a
+    z-score, not a magic number) and per-log2-bucket occupancy EWMAs
+    for histograms (so quantile drift is exact bucket arithmetic, no
+    interpolation).  ``state_dict()``/``restore_state()`` follow the
+    goodput convention and ride the checkpoint manifest ``extra``
+    blob, so a restarted controller keeps its learned history instead
+    of re-warming from scratch.
+
+``AnomalyEngine``
+    Ticked from ``FleetRouter.step()`` like the SLOEngine.  Runs
+    edge-triggered detectors with hysteresis (N anomalous ticks to
+    fire, M clean ticks to clear — no flapping on noise):
+
+    * ``rate:<metric>``          counter-rate z-score spike/drop
+    * ``drift:<metric>``         histogram quantile drift in buckets
+    * ``recompile_storm``        post-warmup compile on a stable
+                                 signature (tracing.cache_stats()
+                                 deltas + per-replica heartbeat
+                                 compile counts)
+    * ``outlier:<replica>``      MAD score of a replica's latency
+                                 quantiles vs the fleet peer median —
+                                 catches degraded-but-alive
+    * ``clock_jitter:<replica>`` heartbeat clock-offset jitter
+
+    Every firing publishes ``anomaly_score``/``anomaly_firing``
+    gauges and bumps ``anomaly_alerts_total``; the engine speaks the
+    telemetry health-source protocol so firings surface on
+    ``/healthz``; ``FleetRouter.attach_anomaly`` wires ``on_alert``
+    to ``collect_flight_bundle``.
+
+``CanarySpec`` / ``CanaryAnalysis``
+    The gate behind ``rolling_restart(canary=CanarySpec(...))``: the
+    restarted replica re-enters rotation at a small routing weight
+    and its metric distributions (deltas since canary start) are
+    compared bucket-exactly against the merged fleet peers over a
+    minimum-sample window.  Pass → full weight; fail → drain +
+    rollback + ``flight-bundle-canary_fail``.
+
+Cost contract: ``AnomalyEngine.tick`` is free when telemetry is
+disabled (single flag check) and all metric emission is gated — the
+telemetry AST lint walks this file.
+"""
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import telemetry as _tm
+from . import flight as _fl
+
+__all__ = ["BaselineStore", "AnomalyEngine", "CanarySpec",
+           "CanaryAnalysis", "percentile_exp", "ZERO_EXP"]
+
+#: Sentinel bucket exponent for the zeros bucket — sorts below every
+#: real log2 exponent so quantile walks treat zero observations as
+#: "smaller than everything".
+ZERO_EXP = -(1 << 20)
+
+
+# --------------------------------------------------------------------------
+# Exact bucket arithmetic
+# --------------------------------------------------------------------------
+
+def percentile_exp(buckets: Dict[int, float], count: float,
+                   zeros: float, q: float = 0.95) -> Optional[int]:
+    """The log2 bucket exponent at quantile ``q`` over exact bucket
+    counts (telemetry histograms: bucket ``e`` holds observations in
+    ``(2^(e-1), 2^e]``; ``zeros`` sits below every exponent).  Returns
+    ``ZERO_EXP`` when the quantile lands in the zeros bucket, ``None``
+    with no samples."""
+    total = float(count)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = float(zeros)
+    if cum >= target - 1e-9:
+        return ZERO_EXP
+    for e in sorted(buckets):
+        cum += float(buckets[e])
+        if cum >= target - 1e-9:
+            return int(e)
+    return max((int(e) for e in buckets), default=ZERO_EXP)
+
+
+def _frac_percentile(frac: Dict[int, float], q: float) -> Optional[int]:
+    """Quantile exponent over a learned occupancy-fraction profile
+    (the BaselineStore's EWMA view of a histogram)."""
+    total = sum(frac.values())
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0.0
+    for e in sorted(frac):
+        cum += frac[e]
+        if cum >= target - 1e-12:
+            return int(e)
+    return max(int(e) for e in frac)
+
+
+def family_counter(fam) -> float:
+    """Sum a registry counter family's children into one value."""
+    return float(sum(ch.value for ch in fam.children.values()))
+
+
+def family_hist(fam) -> Tuple[Dict[int, float], float, float]:
+    """Sum a registry histogram family's children into one
+    ``(buckets, count, zeros)`` triple."""
+    buckets: Dict[int, float] = {}
+    count = zeros = 0.0
+    for ch in fam.children.values():
+        count += float(ch.count)
+        zeros += float(ch.zeros)
+        for e, n in ch.buckets.items():
+            buckets[int(e)] = buckets.get(int(e), 0.0) + float(n)
+    return buckets, count, zeros
+
+
+def blob_hist(blob_fam: dict) -> Tuple[Dict[int, float], float, float]:
+    """Same triple from a raw heartbeat ``tm_state`` family blob
+    (``{"k": "histogram", "c": [[labels, state], ...]}``) — the
+    per-replica view the merged registry cannot give back."""
+    buckets: Dict[int, float] = {}
+    count = zeros = 0.0
+    for _labels, st in blob_fam.get("c", []):
+        if not isinstance(st, dict):
+            continue
+        count += float(st.get("c", 0))
+        zeros += float(st.get("z", 0))
+        for e, n in (st.get("b") or {}).items():
+            buckets[int(e)] = buckets.get(int(e), 0.0) + float(n)
+    return buckets, count, zeros
+
+
+def merge_hists(triples) -> Tuple[Dict[int, float], float, float]:
+    """Merge several ``(buckets, count, zeros)`` triples (peer fleet
+    view for canary comparison)."""
+    buckets: Dict[int, float] = {}
+    count = zeros = 0.0
+    for b, c, z in triples:
+        count += float(c)
+        zeros += float(z)
+        for e, n in b.items():
+            buckets[int(e)] = buckets.get(int(e), 0.0) + float(n)
+    return buckets, count, zeros
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+# --------------------------------------------------------------------------
+# BaselineStore
+# --------------------------------------------------------------------------
+
+class _RateBaseline:
+    __slots__ = ("mean", "var", "n", "last_value", "last_t")
+
+    def __init__(self):
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+        self.last_value: Optional[float] = None
+        self.last_t: Optional[float] = None
+
+
+class BaselineStore:
+    """Learned per-metric baselines: EWMA mean/variance over counter
+    rates, per-log2-bucket occupancy EWMAs over histogram deltas.
+
+    ``alpha`` is the EWMA smoothing factor; no baseline emits a
+    verdict before ``min_samples`` observations (warmup).  Counter
+    resets (a restarted worker re-ships a smaller cumulative value)
+    re-anchor silently instead of producing a negative rate.
+
+    ``state_dict()``/``restore_state()`` round-trip the learned
+    statistics but deliberately drop the last-sample anchors: a
+    restored store takes fresh deltas against the new process's
+    counters while keeping its history (no re-warmup).  Embed the
+    blob in a checkpoint manifest via
+    ``Checkpointer.save(..., extra={"anomaly": engine.state_dict()})``.
+    """
+
+    def __init__(self, *, alpha: float = 0.2, min_samples: int = 8,
+                 rate_floor: float = 0.1):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        #: std-dev floor as a fraction of the mean rate — a perfectly
+        #: steady counter must not turn float jitter into huge z
+        self.rate_floor = float(rate_floor)
+        self._rates: Dict[str, _RateBaseline] = {}
+        self._hists: Dict[str, dict] = {}
+
+    # -- counters ---------------------------------------------------------
+
+    def observe_counter(self, key: str, value: float, now: float, *,
+                        freeze: Optional[float] = None
+                        ) -> Optional[float]:
+        """Feed one cumulative counter sample; returns the z-score of
+        the newest rate against the learned baseline (``None`` while
+        warming up, on the first sample, or across a counter reset).
+
+        ``freeze``: samples scoring beyond this |z| are *not* absorbed
+        into the baseline — a sustained regression keeps scoring
+        against the healthy history instead of teaching the store that
+        the anomaly is the new normal (which would reset the detector's
+        hysteresis streak after a single tick)."""
+        b = self._rates.get(key)
+        if b is None:
+            b = self._rates[key] = _RateBaseline()
+        if b.last_t is None or b.last_value is None:
+            b.last_value, b.last_t = float(value), float(now)
+            return None
+        dt = float(now) - b.last_t
+        if dt <= 0:
+            return None
+        delta = float(value) - b.last_value
+        b.last_value, b.last_t = float(value), float(now)
+        if delta < 0:  # counter reset (worker restart): re-anchor
+            return None
+        rate = delta / dt
+        z: Optional[float] = None
+        if b.n >= self.min_samples:
+            sd = math.sqrt(max(b.var, 0.0))
+            sd = max(sd, self.rate_floor * abs(b.mean), 1e-9)
+            z = (rate - b.mean) / sd
+            if freeze is not None and abs(z) > freeze:
+                return z  # anomalous: keep the baseline clean
+        if b.n == 0:
+            b.mean = rate
+        else:
+            d = rate - b.mean
+            b.mean += self.alpha * d
+            b.var = (1.0 - self.alpha) * (b.var + self.alpha * d * d)
+        b.n += 1
+        return z
+
+    # -- histograms -------------------------------------------------------
+
+    def observe_histogram(self, key: str, buckets: Dict[int, float],
+                          count: float, zeros: float, *,
+                          q: float = 0.95,
+                          freeze: Optional[int] = None) -> Optional[int]:
+        """Feed cumulative bucket state; returns the drift (in whole
+        log2 buckets) of the newest delta's quantile ``q`` against the
+        learned occupancy baseline, ``None`` while warming up / no new
+        samples / across a reset.  ``freeze`` mirrors
+        :meth:`observe_counter`: deltas drifting beyond it are not
+        absorbed into the occupancy EWMA."""
+        st = self._hists.get(key)
+        if st is None:
+            self._hists[key] = {"frac": {}, "n": 0,
+                                "last": (dict(buckets), float(count),
+                                         float(zeros))}
+            return None
+        b0, c0, z0 = st["last"]
+        dc = float(count) - c0
+        dz = float(zeros) - z0
+        st["last"] = (dict(buckets), float(count), float(zeros))
+        if dc < 0 or dz < 0:  # histogram reset: re-anchor
+            return None
+        db: Dict[int, float] = {}
+        for e, n in buckets.items():
+            d = float(n) - float(b0.get(e, 0))
+            if d > 0:
+                db[int(e)] = d
+        drift: Optional[int] = None
+        if st["n"] >= self.min_samples and dc > 0:
+            base = _frac_percentile(st["frac"], q)
+            cur = percentile_exp(db, dc, dz, q)
+            if base is not None and cur is not None:
+                drift = int(cur) - int(base)
+                if freeze is not None and abs(drift) > freeze:
+                    return drift  # anomalous: keep the baseline clean
+        if dc > 0:
+            fr = {ZERO_EXP: dz / dc}
+            for e, n in db.items():
+                fr[int(e)] = n / dc
+            a = self.alpha
+            for e in set(st["frac"]) | set(fr):
+                st["frac"][e] = ((1.0 - a) * st["frac"].get(e, 0.0)
+                                 + a * fr.get(e, 0.0))
+            st["n"] += 1
+        return drift
+
+    # -- persistence (checkpoint-manifest pattern) ------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "schema": 1,
+            "alpha": self.alpha,
+            "rates": {k: [b.mean, b.var, b.n]
+                      for k, b in self._rates.items()},
+            "hists": {k: {"frac": {str(e): f
+                                   for e, f in st["frac"].items()},
+                          "n": st["n"]}
+                      for k, st in self._hists.items()},
+        }
+
+    def restore_state(self, state: dict) -> None:
+        if not isinstance(state, dict):
+            return
+        for k, triple in (state.get("rates") or {}).items():
+            b = self._rates.get(k)
+            if b is None:
+                b = self._rates[k] = _RateBaseline()
+            b.mean, b.var = float(triple[0]), float(triple[1])
+            b.n = int(triple[2])
+        for k, st in (state.get("hists") or {}).items():
+            cur = self._hists.get(k)
+            if cur is None:
+                cur = self._hists[k] = {"frac": {}, "n": 0,
+                                        "last": ({}, 0.0, 0.0)}
+            cur["frac"] = {int(e): float(f)
+                           for e, f in (st.get("frac") or {}).items()}
+            cur["n"] = int(st.get("n", 0))
+
+
+# --------------------------------------------------------------------------
+# AnomalyEngine
+# --------------------------------------------------------------------------
+
+class _Det:
+    __slots__ = ("score", "streak", "clear_streak", "firing", "since_t",
+                 "info")
+
+    def __init__(self):
+        self.score = 0.0
+        self.streak = 0
+        self.clear_streak = 0
+        self.firing = False
+        self.since_t: Optional[float] = None
+        self.info: dict = {}
+
+
+class AnomalyEngine:
+    """Edge-triggered anomaly detectors over learned baselines.
+
+    ``source`` returns the merged telemetry registry (defaults to the
+    in-process one); ``replica_source`` returns a list of per-replica
+    snapshot dicts — ``FleetRouter._replica_snapshot`` provides
+    ``{"name", "state", "detail", "tm", "clock_offset", "last_seen"}``
+    per replica; ``compile_source`` returns ``tracing.cache_stats()``
+    style dicts for the in-process recompile-storm leg.
+
+    Hysteresis: a detector must be anomalous for ``hysteresis_on``
+    consecutive ticks to fire and clean for ``hysteresis_off`` ticks
+    to clear (``recompile_storm`` fires on the first post-warmup
+    compile — any retrace on a stable signature is the anomaly).
+    ``on_alert(name, info)`` / ``on_clear(name)`` run on the edges
+    only, exceptions swallowed like the SLOEngine's.
+    """
+
+    def __init__(self, *, baselines: Optional[BaselineStore] = None,
+                 source: Optional[Callable[[], dict]] = None,
+                 replica_source: Optional[Callable[[], list]] = None,
+                 compile_source: Optional[Callable[[], dict]] = None,
+                 rate_metrics=("serving_tokens_total",
+                               "serve_requests_total"),
+                 hist_metrics=("serving_ttft_seconds",
+                               "serving_tick_seconds"),
+                 outlier_metrics=("serving_ttft_seconds",
+                                  "serving_tpot_seconds",
+                                  "serving_tick_seconds"),
+                 z_threshold: float = 6.0,
+                 drift_buckets: int = 2,
+                 quantile: float = 0.95,
+                 outlier_threshold: float = 4.0,
+                 outlier_min_peers: int = 3,
+                 outlier_min_count: int = 4,
+                 outlier_window_s: float = 10.0,
+                 jitter_s: float = 0.25,
+                 warm_ticks: int = 5,
+                 hysteresis_on: int = 2,
+                 hysteresis_off: int = 5,
+                 tick_interval_s: float = 0.25,
+                 on_alert: Optional[Callable[[str, dict], None]] = None,
+                 on_clear: Optional[Callable[[str], None]] = None):
+        self.baselines = baselines or BaselineStore()
+        self._source = source or (lambda: _tm._REGISTRY)
+        self._replica_source = replica_source
+        self._compile_source = compile_source
+        self.rate_metrics = tuple(rate_metrics)
+        self.hist_metrics = tuple(hist_metrics)
+        self.outlier_metrics = tuple(outlier_metrics)
+        self.z_threshold = float(z_threshold)
+        self.drift_buckets = int(drift_buckets)
+        self.quantile = float(quantile)
+        self.outlier_threshold = float(outlier_threshold)
+        self.outlier_min_peers = int(outlier_min_peers)
+        self.outlier_min_count = int(outlier_min_count)
+        self.outlier_window_s = float(outlier_window_s)
+        self.jitter_s = float(jitter_s)
+        self.warm_ticks = int(warm_ticks)
+        self.hysteresis_on = int(hysteresis_on)
+        self.hysteresis_off = int(hysteresis_off)
+        self.tick_interval_s = float(tick_interval_s)
+        self.on_alert = on_alert
+        self.on_clear = on_clear
+        self.alerts_total = 0
+        self._det: Dict[str, _Det] = {}
+        self._compile_state: Dict[str, dict] = {}
+        self._clock: Dict[str, dict] = {}
+        self._rep_rings: Dict[Tuple[str, str], deque] = {}
+        self._last_tick: Optional[float] = None
+        self._last_result: Optional[dict] = None
+
+    # -- main loop --------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[dict]:
+        """Run every detector once.  Free (single flag check) while
+        telemetry is disabled; throttled to ``tick_interval_s``."""
+        if not _tm._ENABLED:
+            return None
+        t = time.monotonic() if now is None else float(now)
+        if (self._last_tick is not None
+                and t - self._last_tick < self.tick_interval_s):
+            return self._last_result
+        self._last_tick = t
+        obs: Dict[str, Tuple[bool, float, dict]] = {}
+        reg = self._source() or {}
+        reps = list(self._replica_source() or []) \
+            if self._replica_source is not None else []
+        self._scan_rates(reg, t, obs)
+        self._scan_hists(reg, obs)
+        self._scan_recompile(reps, obs)
+        self._scan_outliers(reps, t, obs)
+        self._scan_clock(reps, obs)
+        self._last_result = self._settle(obs, t)
+        return self._last_result
+
+    # -- detectors (no telemetry emission here — lint-clean) --------------
+
+    def _scan_rates(self, reg, t, obs):
+        for m in self.rate_metrics:
+            fam = reg.get(m)
+            if fam is None or getattr(fam, "kind", None) != "counter":
+                continue
+            z = self.baselines.observe_counter(m, family_counter(fam), t,
+                                               freeze=self.z_threshold)
+            if z is None:
+                continue
+            obs["rate:" + m] = (
+                abs(z) >= self.z_threshold,
+                abs(z) / self.z_threshold,
+                {"metric": m, "z": round(z, 3),
+                 "direction": "spike" if z > 0 else "drop"})
+
+    def _scan_hists(self, reg, obs):
+        for m in self.hist_metrics:
+            fam = reg.get(m)
+            if fam is None or getattr(fam, "kind", None) != "histogram":
+                continue
+            buckets, count, zeros = family_hist(fam)
+            drift = self.baselines.observe_histogram(
+                m, buckets, count, zeros, q=self.quantile,
+                freeze=self.drift_buckets)
+            if drift is None:
+                continue
+            obs["drift:" + m] = (
+                drift >= self.drift_buckets,
+                max(0.0, drift / max(self.drift_buckets, 1)),
+                {"metric": m, "drift_buckets": drift,
+                 "quantile": self.quantile})
+
+    def _compile_counts(self, reps) -> Dict[str, float]:
+        counts: Dict[str, float] = {}
+        cs = None
+        try:
+            if self._compile_source is not None:
+                cs = self._compile_source()
+            else:
+                from . import tracing as _tr
+                cs = _tr.cache_stats()
+        except Exception:
+            cs = None
+        if isinstance(cs, dict):
+            per = cs.get("per_block")
+            if isinstance(per, dict) and per:
+                for blk, st in per.items():
+                    v = st.get("compiles", 0) if isinstance(st, dict) else st
+                    counts["local:" + str(blk)] = float(v)
+            elif "compiles" in cs:
+                counts["local"] = float(cs["compiles"])
+        for rep in reps:
+            comp = (rep.get("detail") or {}).get("compile")
+            if not isinstance(comp, dict):
+                continue
+            for k, v in comp.items():
+                if str(k).endswith("_compiles"):
+                    counts[f"{rep.get('name')}:{k}"] = float(v)
+        return counts
+
+    def _scan_recompile(self, reps, obs):
+        counts = self._compile_counts(reps)
+        storms = []
+        for key, v in counts.items():
+            st = self._compile_state.get(key)
+            if st is None:
+                self._compile_state[key] = {"count": v, "stable": 0,
+                                            "warm": False}
+                continue
+            if v > st["count"]:
+                if st["warm"]:
+                    storms.append((key, v - st["count"]))
+                st["stable"] = 0
+            else:
+                st["stable"] += 1
+                if st["stable"] >= self.warm_ticks:
+                    st["warm"] = True
+            st["count"] = v
+        if any(st["warm"] for st in self._compile_state.values()):
+            new = float(sum(d for _, d in storms))
+            obs["recompile_storm"] = (
+                bool(storms), new,
+                {"sources": sorted(k for k, _ in storms)} if storms
+                else {})
+
+    def _rep_quantile(self, rep_name, metric, fam_blob, t) -> Optional[int]:
+        """Windowed per-replica quantile exponent: diff the newest
+        heartbeat histogram state against a ring of past snapshots so
+        a long-lived replica's history doesn't dilute fresh
+        degradation."""
+        b, c, z = blob_hist(fam_blob)
+        ring = self._rep_rings.setdefault((rep_name, metric), deque())
+        ring.append((t, b, c, z))
+        while len(ring) > 1 and t - ring[0][0] > self.outlier_window_s:
+            ring.popleft()
+        t0, b0, c0, z0 = ring[0]
+        dc = c - c0
+        if dc >= self.outlier_min_count:
+            db = {e: b.get(e, 0.0) - b0.get(e, 0.0)
+                  for e in b if b.get(e, 0.0) > b0.get(e, 0.0)}
+            return percentile_exp(db, dc, z - z0, self.quantile)
+        if c >= self.outlier_min_count:
+            return percentile_exp(b, c, z, self.quantile)
+        return None
+
+    def _scan_outliers(self, reps, t, obs):
+        merged: Dict[str, Tuple[bool, float, dict]] = {}
+        for metric in self.outlier_metrics:
+            per: Dict[str, int] = {}
+            for rep in reps:
+                tm_blob = rep.get("tm") or {}
+                fam = tm_blob.get(metric)
+                if not isinstance(fam, dict):
+                    continue
+                exp = self._rep_quantile(str(rep.get("name")), metric,
+                                         fam, t)
+                if exp is not None:
+                    per[str(rep.get("name"))] = int(exp)
+            if len(per) < self.outlier_min_peers:
+                continue
+            xs = [float(v) for v in per.values()]
+            med = _median(xs)
+            mad = _median([abs(x - med) for x in xs])
+            denom = max(mad, 0.5)
+            for rname, x in per.items():
+                score = (float(x) - med) / denom  # one-sided: slower
+                name = "outlier:" + rname
+                prev = merged.get(name)
+                if prev is None or score > prev[1]:
+                    merged[name] = (
+                        score >= self.outlier_threshold or
+                        (prev is not None and prev[0]),
+                        max(score, 0.0),
+                        {"replica": rname, "metric": metric,
+                         "exp": int(x), "peer_median_exp": med})
+        obs.update(merged)
+
+    def _scan_clock(self, reps, obs):
+        for rep in reps:
+            off = rep.get("clock_offset")
+            if off is None:
+                continue
+            name = str(rep.get("name"))
+            st = self._clock.get(name)
+            if st is None:
+                self._clock[name] = {"mean": float(off), "n": 1}
+                continue
+            jitter = abs(float(off) - st["mean"])
+            st["mean"] += 0.2 * (float(off) - st["mean"])
+            st["n"] += 1
+            if st["n"] <= self.warm_ticks:
+                continue
+            obs["clock_jitter:" + name] = (
+                jitter >= self.jitter_s,
+                jitter / max(self.jitter_s, 1e-9),
+                {"replica": name, "jitter_s": round(jitter, 4)})
+
+    def forget_replica(self, name: str) -> None:
+        """Drop every per-replica learned anchor for `name` — compile
+        counters, outlier rings, clock offset — and re-arm their
+        warmups. The router calls this after a *deliberate* restart:
+        the rebuilt worker recompiles its signatures and re-anchors
+        its clock by design, and treating that as a recompile storm
+        or clock jitter would page on every rolling restart."""
+        prefix = f"{name}:"
+        for key in [k for k in self._compile_state
+                    if k.startswith(prefix)]:
+            del self._compile_state[key]
+        for key in [k for k in self._rep_rings if k[0] == name]:
+            del self._rep_rings[key]
+        self._clock.pop(name, None)
+        # retire the replica-scoped detectors outright so a firing
+        # from the OLD incarnation doesn't hold /healthz down while
+        # the fresh one waits out hysteresis_off
+        for det in (f"outlier:{name}", f"clock_jitter:{name}"):
+            self._det.pop(det, None)
+
+    # -- edge-triggered settlement + publication --------------------------
+
+    def _settle(self, obs, t):
+        if not _tm._ENABLED:
+            return None
+        for name, (anom, score, info) in obs.items():
+            st = self._det.get(name)
+            if st is None:
+                st = self._det[name] = _Det()
+            st.score = float(score)
+            st.info = info
+            if anom:
+                st.streak += 1
+                st.clear_streak = 0
+            else:
+                st.clear_streak += 1
+                st.streak = 0
+            on_n = 1 if name == "recompile_storm" else self.hysteresis_on
+            if not st.firing and st.streak >= on_n:
+                st.firing = True
+                st.since_t = t
+                self.alerts_total += 1
+                _tm.inc("anomaly_alerts_total", 1, detector=name)
+                if _fl._ENABLED:
+                    _fl.record("anomaly", name, score=round(score, 3),
+                               **{k: v for k, v in info.items()
+                                  if isinstance(v, (int, float, str))})
+                if self.on_alert is not None:
+                    try:
+                        self.on_alert(name, {"score": score, **info})
+                    except Exception:
+                        pass
+            elif st.firing and st.clear_streak >= self.hysteresis_off:
+                st.firing = False
+                if self.on_clear is not None:
+                    try:
+                        self.on_clear(name)
+                    except Exception:
+                        pass
+        for name, st in self._det.items():
+            if name in obs:
+                continue
+            # unobserved this tick (replica gone, metric idle): decay
+            st.score = 0.0
+            st.streak = 0
+            st.clear_streak += 1
+            if st.firing and st.clear_streak >= self.hysteresis_off:
+                st.firing = False
+                if self.on_clear is not None:
+                    try:
+                        self.on_clear(name)
+                    except Exception:
+                        pass
+        self._publish()
+        return {"firing": sorted(n for n, s in self._det.items()
+                                 if s.firing),
+                "scores": {n: s.score for n, s in self._det.items()}}
+
+    def _publish(self):
+        if not _tm._ENABLED:
+            return
+        for name, st in self._det.items():
+            _tm.set_gauge("anomaly_score", st.score, detector=name)
+            _tm.set_gauge("anomaly_firing", 1.0 if st.firing else 0.0,
+                          detector=name)
+        _tm.set_gauge("anomaly_detectors", float(len(self._det)))
+
+    # -- health-source protocol (telemetry /healthz) ----------------------
+
+    def firing(self) -> List[str]:
+        return sorted(n for n, st in self._det.items() if st.firing)
+
+    def health(self) -> Tuple[bool, str]:
+        f = self.firing()
+        if f:
+            return False, "anomaly: " + ", ".join(f)
+        return True, "ok"
+
+    def health_detail(self) -> dict:
+        return {"kind": "anomaly",
+                "alerts_total": self.alerts_total,
+                "detectors": {n: {"score": round(st.score, 4),
+                                  "firing": st.firing}
+                              for n, st in sorted(self._det.items())}}
+
+    # -- persistence ------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"schema": 1, "alerts_total": self.alerts_total,
+                "baselines": self.baselines.state_dict()}
+
+    def restore_state(self, state: dict) -> None:
+        if not isinstance(state, dict):
+            return
+        self.alerts_total = int(state.get("alerts_total",
+                                          self.alerts_total))
+        b = state.get("baselines")
+        if b is not None:
+            self.baselines.restore_state(b)
+
+
+# --------------------------------------------------------------------------
+# Canary gating
+# --------------------------------------------------------------------------
+
+class CanarySpec:
+    """Policy for a canaried rolling restart.
+
+    ``weight``        fraction of eligible picks routed to the canary
+                      while under analysis (stride-scheduled, so a
+                      0.25 weight admits every 4th offered pick)
+    ``min_samples``   observations a metric needs (delta since canary
+                      start) before its verdict counts
+    ``window_s``      analysis deadline; an undecided canary resolves
+                      to ``on_timeout`` ("promote" or "rollback")
+    ``drift_buckets`` allowed p-quantile excess, in whole log2
+                      buckets, over the merged fleet peers (1 bucket
+                      = 2x latency)
+    ``metrics``       histogram families compared (first one also
+                      drives the reported sample count)
+    """
+
+    __slots__ = ("weight", "min_samples", "window_s", "drift_buckets",
+                 "metrics", "quantile", "on_timeout")
+
+    def __init__(self, weight: float = 0.25, min_samples: int = 16,
+                 window_s: float = 60.0, drift_buckets: int = 2,
+                 metrics=("serving_ttft_seconds",),
+                 quantile: float = 0.95, on_timeout: str = "promote"):
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"weight must be in (0, 1], got {weight}")
+        if on_timeout not in ("promote", "rollback"):
+            raise ValueError("on_timeout must be 'promote' or "
+                             f"'rollback', got {on_timeout!r}")
+        self.weight = float(weight)
+        self.min_samples = int(min_samples)
+        self.window_s = float(window_s)
+        self.drift_buckets = int(drift_buckets)
+        self.metrics = tuple(metrics)
+        self.quantile = float(quantile)
+        self.on_timeout = on_timeout
+
+
+class CanaryAnalysis:
+    """Bucket-exact canary-vs-fleet comparison.
+
+    Call ``start`` with the canary's and the merged peers' current
+    histogram states (``{metric: (buckets, count, zeros)}``) to anchor
+    the deltas, then ``evaluate`` with fresh states each tick.  The
+    verdict is ``"promoted"`` once every metric with enough canary
+    samples sits within ``drift_buckets`` of the peers' quantile,
+    ``"rolled_back"`` the moment any such metric exceeds it, and the
+    ``on_timeout`` policy after ``window_s`` undecided seconds.
+    """
+
+    def __init__(self, spec: CanarySpec, now: Optional[float] = None):
+        self.spec = spec
+        self.t0 = time.monotonic() if now is None else float(now)
+        self._c0: Optional[dict] = None
+        self._p0: Optional[dict] = None
+        self.samples = 0
+        self.verdict: Optional[str] = None
+        self.report: dict = {}
+
+    def start(self, canary_state: dict, peer_state: dict,
+              now: Optional[float] = None) -> None:
+        self._c0 = {m: (dict(b), float(c), float(z))
+                    for m, (b, c, z) in canary_state.items()}
+        self._p0 = {m: (dict(b), float(c), float(z))
+                    for m, (b, c, z) in peer_state.items()}
+        if now is not None:
+            self.t0 = float(now)
+
+    @staticmethod
+    def _delta(cur, base):
+        b0, c0, z0 = base if base is not None else ({}, 0.0, 0.0)
+        b, c, z = cur
+        db = {}
+        for e, n in b.items():
+            d = float(n) - float(b0.get(e, 0))
+            if d > 0:
+                db[int(e)] = d
+        return db, max(0.0, float(c) - c0), max(0.0, float(z) - z0)
+
+    def evaluate(self, canary_state: dict, peer_state: dict,
+                 now: Optional[float] = None) -> Optional[str]:
+        if self.verdict is not None:
+            return self.verdict
+        if self._c0 is None:
+            self.start(canary_state, peer_state, now)
+            return None
+        t = time.monotonic() if now is None else float(now)
+        sp = self.spec
+        per_metric: dict = {}
+        passed: List[str] = []
+        pending = 0
+        for m in sp.metrics:
+            cur = canary_state.get(m)
+            if cur is None:
+                pending += 1
+                continue
+            db, dc, dz = self._delta(cur, self._c0.get(m))
+            peer = peer_state.get(m)
+            pb, pc, pz = (self._delta(peer, self._p0.get(m))
+                          if peer is not None else ({}, 0.0, 0.0))
+            per_metric[m] = {"canary_samples": int(dc),
+                             "peer_samples": int(pc)}
+            if m == sp.metrics[0]:
+                self.samples = int(dc)
+            if dc < sp.min_samples or pc <= 0:
+                pending += 1
+                continue
+            c_exp = percentile_exp(db, dc, dz, sp.quantile)
+            p_exp = percentile_exp(pb, pc, pz, sp.quantile)
+            if c_exp is None or p_exp is None:
+                pending += 1
+                continue
+            drift = int(c_exp) - int(p_exp)
+            per_metric[m]["drift_buckets"] = drift
+            per_metric[m]["canary_exp"] = int(c_exp)
+            per_metric[m]["peer_exp"] = int(p_exp)
+            if drift > sp.drift_buckets:
+                self.verdict = "rolled_back"
+                self.report = {
+                    "reason": (f"{m} p{int(sp.quantile * 100)} drifted "
+                               f"{drift} buckets "
+                               f"(allowance {sp.drift_buckets})"),
+                    "metrics": per_metric,
+                    "elapsed_s": round(t - self.t0, 3)}
+                return self.verdict
+            passed.append(m)
+        if passed and pending == 0:
+            self.verdict = "promoted"
+            self.report = {"reason": "within drift on "
+                                     + ",".join(passed),
+                           "metrics": per_metric,
+                           "elapsed_s": round(t - self.t0, 3)}
+            return self.verdict
+        if t - self.t0 >= sp.window_s:
+            self.verdict = ("promoted" if sp.on_timeout == "promote"
+                            else "rolled_back")
+            self.report = {"reason": f"window expired ({sp.on_timeout})",
+                           "metrics": per_metric,
+                           "elapsed_s": round(t - self.t0, 3)}
+            return self.verdict
+        return None

@@ -50,6 +50,14 @@ site                   fires at
                         goes stale); in-process, the handle skips
                         ``ticks`` drive ticks (health stays ok, progress
                         stops — the hedging case, not the failover case)
+``replica.degrade``     same sites — inflates per-tick latency on a
+                        LIVE worker (short ``ms`` sleep, default 50,
+                        after each productive tick) so heartbeats keep
+                        flowing; in-process, the handle sleeps
+                        ``ms`` per drive tick (payload ``replica=i``
+                        picks it).  The degraded-but-alive adversary
+                        for the anomaly outlier detector and the
+                        canary gate
 ``router.drop``         ``FleetRouter`` result intake — discards a
                         completed attempt's result as if the reply got
                         lost, exercising the retry + idempotency path
@@ -110,7 +118,8 @@ __all__ = ["SITES", "FaultInjected", "FaultTimeout",
 #: the named injection sites instrumented across the stack
 SITES = ("checkpoint.truncate", "collective.timeout", "grad.nonfinite",
          "step.kill", "host.slow", "serving.stall", "multihost.break",
-         "replica.kill", "replica.stall", "router.drop",
+         "replica.kill", "replica.stall", "replica.degrade",
+         "router.drop",
          "kv.spill_corrupt", "kv.restore_slow")
 
 

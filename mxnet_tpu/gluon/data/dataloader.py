@@ -265,6 +265,7 @@ class DataLoader:
             pass
 
     def _iter_process(self):
+        import multiprocessing as mp
         from collections import deque
 
         pool = self._get_pool()
@@ -282,17 +283,28 @@ class DataLoader:
         for _ in range(self._prefetch):
             if not submit():
                 break
+        batch_idx = 0
         while window:  # ordered: results yielded in submission order
             res = window.popleft()
-            if _tm._ENABLED:
-                _tm.set_gauge("dataloader_queue_depth", len(window) + 1)
-                t0 = time.perf_counter()
-                out = res.get(self._timeout)
-                _tm.observe("dataloader_worker_wait_seconds",
-                            time.perf_counter() - t0)
-            else:
-                out = res.get(self._timeout)  # worker errors re-raise here
+            try:
+                if _tm._ENABLED:
+                    _tm.set_gauge("dataloader_queue_depth",
+                                  len(window) + 1)
+                    t0 = time.perf_counter()
+                    out = res.get(self._timeout)
+                    _tm.observe("dataloader_worker_wait_seconds",
+                                time.perf_counter() - t0)
+                else:
+                    out = res.get(self._timeout)  # worker errors
+                    #                               re-raise here
+            except mp.TimeoutError:
+                raise TimeoutError(
+                    f"DataLoader process worker timed out after "
+                    f"{self._timeout}s waiting for batch {batch_idx} "
+                    f"— a stalled/dead worker, or raise `timeout`"
+                ) from None
             submit()
+            batch_idx += 1
             yield _tree_to_nd(out)
 
     def _iter_impl(self):

@@ -233,9 +233,12 @@ class Estimator:
                 return None
             return [m] if isinstance(m, _metric.EvalMetric) else list(m)
 
-        self.train_metrics = as_list(train_metrics) \
-            or [_metric.Accuracy()]
-        self.val_metrics = as_list(val_metrics) or []
+        # None means "default"; an explicit [] means "no metrics" —
+        # a falsy `or` here would silently re-add Accuracy
+        tm = as_list(train_metrics)
+        self.train_metrics = [_metric.Accuracy()] if tm is None else tm
+        vm = as_list(val_metrics)
+        self.val_metrics = [] if vm is None else vm
         self.trainer = trainer or Trainer(
             net.collect_params(), optimizer, optimizer_params
             or {"learning_rate": 0.01})

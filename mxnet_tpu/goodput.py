@@ -81,6 +81,8 @@ __all__ = [
     "charge_gap",
     "note_compile",
     "note_tokens",
+    "note_tenant_tokens",
+    "usage_report",
     "note_train_step",
     "note_hbm_watermark",
     "publish",
@@ -253,6 +255,9 @@ class GoodputLedger:
 _ENABLED = False
 _LEDGER: Optional[GoodputLedger] = None
 _TOKENS: Dict[str, int] = {"train": 0, "serve": 0}
+#: tenant-attributed serve tokens — the usage meter's raw material
+#: (conservation-checked against serving_tenant_tokens_total)
+_TENANT_TOKENS: Dict[str, int] = {}
 _MODEL_FLOPS = 0.0
 _HW_FLOPS = 0.0
 _LAST_MFU: Optional[float] = None
@@ -295,6 +300,7 @@ def reset() -> None:
     _LEDGER = None
     _TOKENS.clear()
     _TOKENS.update(train=0, serve=0)
+    _TENANT_TOKENS.clear()
     _MODEL_FLOPS = 0.0
     _HW_FLOPS = 0.0
     _LAST_MFU = None
@@ -360,6 +366,17 @@ def note_tokens(kind: str, n: int) -> None:
     if not _ENABLED or n <= 0:
         return
     _TOKENS[kind] = _TOKENS.get(kind, 0) + int(n)
+
+
+def note_tenant_tokens(tenant: Optional[str], n: int) -> None:
+    """Tenant-attributed serve tokens for the usage meter (same cost
+    contract as note_tokens — one flag check when disabled). The
+    serving layer feeds this next to the tenant-labeled telemetry
+    counter, so the two stay conservation-equal."""
+    if not _ENABLED or n <= 0:
+        return
+    t = str(tenant) if tenant else "anonymous"
+    _TENANT_TOKENS[t] = _TENANT_TOKENS.get(t, 0) + int(n)
 
 
 def _chips() -> int:
@@ -499,6 +516,12 @@ def publish() -> None:
             if d > 0:
                 _tm.inc("goodput_seconds_total", d, category=c)
                 _LAST_PUB[c] = v
+        for t, tok in _TENANT_TOKENS.items():
+            k = f"tenant::{t}"
+            d = tok - _LAST_PUB.get(k, 0.0)
+            if d > 0:
+                _tm.inc("goodput_tenant_tokens_total", d, tenant=t)
+                _LAST_PUB[k] = float(tok)
     el = _LEDGER.elapsed()
     if el <= 0:
         return
@@ -510,6 +533,48 @@ def publish() -> None:
         if tok:
             _tm.set_gauge(f"goodput_{kind}_tokens_per_sec_per_chip",
                           tok / (el * chips))
+
+
+def usage_report() -> dict:
+    """Billing-grade per-tenant usage: tokens + chip-seconds.
+
+    Chip-seconds distribute the ledger's SETTLED productive seconds
+    (times the local chip count) across tenants in proportion to
+    their attributed serve tokens, so the per-tenant column plus the
+    ``unattributed`` remainder always sums exactly to the ledger's
+    productive chip-seconds — conservation by construction, checked in
+    tests against both the ledger and the tenant-labeled
+    ``serving_tenant_tokens_total`` counters."""
+    if _LEDGER is None:
+        secs, settled_el = {c: 0.0 for c in CATEGORIES}, 0.0
+    else:
+        secs, settled_el = _LEDGER.settled()
+    chips = _chips()
+    prod_chip_s = secs.get("productive", 0.0) * chips
+    serve_tok = _TOKENS.get("serve", 0)
+    attr_tok = sum(_TENANT_TOKENS.values())
+    # attribution base: every serve token the ledger saw; tenant-less
+    # traffic lands in the unattributed bucket. A tenant total larger
+    # than the serve total (possible only if a caller fed the meter
+    # directly) still conserves: shares normalize over the larger sum.
+    base = max(serve_tok, attr_tok)
+    tenants = {}
+    for t in sorted(_TENANT_TOKENS):
+        tok = _TENANT_TOKENS[t]
+        share = tok / base if base > 0 else 0.0
+        tenants[t] = {"tokens": tok, "token_share": share,
+                      "chip_seconds": share * prod_chip_s}
+    unattr_tok = max(0, base - attr_tok)
+    unattr_share = unattr_tok / base if base > 0 else 1.0
+    return {"schema": 1,
+            "chips": chips,
+            "settled_elapsed_s": settled_el,
+            "productive_chip_seconds": prod_chip_s,
+            "serve_tokens": serve_tok,
+            "tenants": tenants,
+            "unattributed": {"tokens": unattr_tok,
+                             "token_share": unattr_share,
+                             "chip_seconds": unattr_share * prod_chip_s}}
 
 
 def snapshot() -> dict:
@@ -526,6 +591,7 @@ def state_dict() -> dict:
         return {}
     st = _LEDGER.state_dict()
     st["tokens"] = dict(_TOKENS)
+    st["tenant_tokens"] = dict(_TENANT_TOKENS)
     return st
 
 
@@ -535,6 +601,8 @@ def restore_state(st: dict) -> None:
     _LEDGER.restore_state(st)
     for k, v in (st.get("tokens") or {}).items():
         _TOKENS[k] = _TOKENS.get(k, 0) + int(v)
+    for k, v in (st.get("tenant_tokens") or {}).items():
+        _TENANT_TOKENS[k] = _TENANT_TOKENS.get(k, 0) + int(v)
 
 
 # -- human-facing summary ---------------------------------------------
@@ -690,6 +758,11 @@ _DIRECTION_OVERRIDES = {
     "bench_lora_extra_compiles": True,            # 0 is the contract
     "bench_tenant_victim_slo_attainment": False,  # fraction inside SLO
     "bench_tenant_victim_shed_total": True,       # victim sheds = harm
+    "bench_canary_pass": False,                   # 1 = acceptance held
+    "bench_canary_rollbacks": False,  # degrade leg MUST roll back (>=1)
+    "bench_canary_clean_alerts": True,            # clean leg: 0 alerts
+    "bench_canary_clean_rollbacks": True,         # clean leg: 0
+    "bench_canary_bundle_sources": False,         # >=2 sources required
 }
 
 

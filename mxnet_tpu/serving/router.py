@@ -51,8 +51,11 @@ scan), with two backends:
 Fault sites (armed via `MXNET_TPU_FAULTS`, see `mxnet_tpu.faults`):
 ``replica.kill`` (worker dies after a productive tick — in-process,
 the handle is marked dead), ``replica.stall`` (worker sleeps ``ms`` /
-handle skips ``ticks``), ``router.drop`` (a completed attempt's
-result is discarded, exercising retry + idempotency).
+handle skips ``ticks``), ``replica.degrade`` (short ``ms`` sleep per
+productive tick — latency inflates but heartbeats keep flowing, the
+degraded-but-alive adversary for the anomaly outlier detector and the
+canary gate), ``router.drop`` (a completed attempt's result is
+discarded, exercising retry + idempotency).
 
 Worker side: `run_fleet_worker(channel, name, ...)` drives one server
 against the channel protocol; ``python -m mxnet_tpu.serving.router
@@ -375,6 +378,9 @@ class LocalReplica:
         self.dead = False
         self.restarts = 0
         self._stall_ticks_left = 0
+        #: `replica.degrade` arm: sleep this long before every drive
+        #: tick — latency inflates, health/probes keep answering
+        self._degrade_ms = 0.0
         self._dropped = set()           # sub ids with discarded results
 
     def probe(self, now: float) -> Optional[dict]:
@@ -444,6 +450,8 @@ class LocalReplica:
             self._stall_ticks_left -= 1
             return 0
         if self.server.queue or self.server._active.any():
+            if self._degrade_ms > 0:
+                time.sleep(self._degrade_ms / 1e3)
             return self.server.step()
         return 0
 
@@ -481,6 +489,7 @@ class LocalReplica:
         self.server = self.factory()
         self.dead = False
         self._stall_ticks_left = 0
+        self._degrade_ms = 0.0
         self._dropped.clear()
         self.restarts += 1
 
@@ -613,6 +622,19 @@ class _Rep:
         self.hb_seq = None              # last heartbeat seq applied
 
 
+class _CanaryState:
+    """One replica under canary analysis after a gated restart:
+    the spec, the running `CanaryAnalysis`, and the stride counter
+    that meters the replica's routing weight."""
+    __slots__ = ("spec", "analysis", "bundle_dir", "tokens")
+
+    def __init__(self, spec, analysis, bundle_dir=None):
+        self.spec = spec
+        self.analysis = analysis
+        self.bundle_dir = bundle_dir
+        self.tokens = 0.0
+
+
 # -- the router --------------------------------------------------------------
 
 class FleetRouter:
@@ -714,8 +736,13 @@ class FleetRouter:
         self.n_prefill_exports = 0
         self.n_stream_dispatches = 0
         self.n_disagg_fallbacks = 0
+        self.n_canary_rollbacks = 0
+        self.n_canary_promotions = 0
         self._pick_how = "least_loaded"     # last routing decision
         self._slo = None                    # attach_slo() sets this
+        self._anomaly = None                # attach_anomaly() sets this
+        #: replica name -> _CanaryState while under canary analysis
+        self._canaries: Dict[str, _CanaryState] = {}
         self._bundle_seq = 0
         self.last_bundle_path: Optional[str] = None
         telemetry.register_fleet_trace_source(self)
@@ -799,6 +826,12 @@ class FleetRouter:
                                % len(self._reps)].handle
                 if hasattr(h, "_stall_ticks_left"):
                     h._stall_ticks_left = int(sp.get("ticks", 1 << 30))
+            sp = _ft.fire("replica.degrade")
+            if sp is not None:
+                h = self._reps[int(sp.get("replica", 0))
+                               % len(self._reps)].handle
+                if hasattr(h, "_degrade_ms"):
+                    h._degrade_ms = float(sp.get("ms", 50))
         self._refresh(now)
         progress = self._failover_dead(now)
         self._expire(now)
@@ -812,6 +845,10 @@ class FleetRouter:
         self._note_progress(progress, now)
         if self._slo is not None and telemetry._ENABLED:
             self._slo.tick()
+        if self._anomaly is not None and telemetry._ENABLED:
+            self._anomaly.tick()
+        if self._canaries:
+            self._canary_tick(now)
         return progress
 
     def run(self, max_ticks: Optional[int] = None,
@@ -1008,6 +1045,24 @@ class FleetRouter:
                 if rep not in exclude and self._eligible(rep, now)]
         if not elig:
             return None
+        if self._canaries:
+            # canary weight gate: a replica under analysis is offered
+            # only a `spec.weight` fraction of picks (stride
+            # scheduling — a 0.25 weight admits every 4th offer); when
+            # nothing else is eligible, availability wins and the gate
+            # drops
+            gated = []
+            for rep in elig:
+                cs = self._canaries.get(rep.name)
+                if cs is None:
+                    gated.append(rep)
+                    continue
+                cs.tokens += cs.spec.weight
+                if cs.tokens >= 1.0:
+                    cs.tokens -= 1.0
+                    gated.append(rep)
+            if gated:
+                elig = gated
         if role is not None:
             match = [rep for rep in elig if self._role(rep) == role]
             if match:
@@ -1445,13 +1500,36 @@ class FleetRouter:
     # -- fleet lifecycle -----------------------------------------------------
 
     def rolling_restart(self, drain_timeout_s: float = 60.0,
-                        restart_timeout_s: float = 60.0):
+                        restart_timeout_s: float = 60.0,
+                        canary=None,
+                        canary_timeout_s: Optional[float] = None,
+                        bundle_dir: Optional[str] = None,
+                        replicas=None) -> List[dict]:
         """Drain-aware rolling restart, one replica at a time: flip it
         to draining (its health source reports not-ready, so dispatch
         stops), keep stepping the fleet until its work finishes, then
         restart it and wait until it probes healthy again. Admission
-        to the OTHER replicas continues throughout."""
-        for rep in self._reps:
+        to the OTHER replicas continues throughout.
+
+        With ``canary=CanarySpec(...)`` (see `mxnet_tpu.anomaly`) each
+        restarted replica re-enters rotation at ``spec.weight``
+        routing weight while a `CanaryAnalysis` compares its fresh
+        metric distributions bucket-exactly against the merged fleet
+        peers: promotion restores full weight
+        (`router_canary_promotions_total`); failure drains it back out
+        of rotation, collects ``flight-bundle-canary_fail`` and bumps
+        `router_canary_rollbacks_total` (the replica is left draining
+        for the operator — `end_drain()` re-admits it). The analysis
+        reads the heartbeat-shipped registry snapshots, so it needs
+        worker-side telemetry; with no data the window expires into
+        ``spec.on_timeout``. ``replicas`` restricts the rollout to the
+        named subset (default: all). Returns one record per restarted
+        replica: ``{"replica", "canary": None | "promoted" |
+        "rolled_back", "report"}``."""
+        results = []
+        targets = [rep for rep in self._reps
+                   if replicas is None or rep.name in set(replicas)]
+        for rep in targets:
             if _fl._ENABLED:
                 _fl.record("route", "router.drain", replica=rep.name)
             try:
@@ -1474,6 +1552,10 @@ class FleetRouter:
                                          rep.breaker.cooldown_s)
             rep.detail = None
             rep.last_seen = time.time()
+            if self._anomaly is not None:
+                # the rebuilt worker recompiles and re-anchors its
+                # clock by design — not a storm, not jitter
+                self._anomaly.forget_replica(rep.name)
             if _fl._ENABLED:
                 _fl.record("route", "router.restart", replica=rep.name)
             t0 = time.time()
@@ -1482,6 +1564,105 @@ class FleetRouter:
                 if rep.state == HEALTHY:
                     break
                 time.sleep(self.poll_s)
+            rec = {"replica": rep.name, "canary": None, "report": None}
+            if canary is not None:
+                cs = self._start_canary(rep, canary, bundle_dir)
+                limit = canary_timeout_s if canary_timeout_s is not None \
+                    else canary.window_s + 30.0
+                t0 = time.time()
+                while rep.name in self._canaries \
+                        and time.time() - t0 < limit:
+                    if not self.step():
+                        time.sleep(self.poll_s)
+                self._canaries.pop(rep.name, None)
+                rec["canary"] = cs.analysis.verdict
+                rec["report"] = cs.analysis.report
+            results.append(rec)
+        return results
+
+    # -- canary-gated rollout ------------------------------------------------
+
+    def _rep_hist_state(self, rep: _Rep, metrics) -> dict:
+        """``{metric: (buckets, count, zeros)}`` from one replica's
+        heartbeat-shipped registry blob — the per-replica histogram
+        view the merged registry cannot give back."""
+        from .. import anomaly as _anom
+        out = {}
+        for m in metrics:
+            fam = rep.tm_state.get(m)
+            if isinstance(fam, dict):
+                out[m] = _anom.blob_hist(fam)
+        return out
+
+    def _peer_hist_state(self, canary_rep: _Rep, metrics) -> dict:
+        """The same view merged over every live non-canary peer — the
+        fleet baseline the canary is compared against."""
+        from .. import anomaly as _anom
+        per: Dict[str, list] = {m: [] for m in metrics}
+        for rep in self._reps:
+            if rep is canary_rep or rep.state == DEAD \
+                    or rep.name in self._canaries:
+                continue
+            for m in metrics:
+                fam = rep.tm_state.get(m)
+                if isinstance(fam, dict):
+                    per[m].append(_anom.blob_hist(fam))
+        return {m: _anom.merge_hists(ts) for m, ts in per.items() if ts}
+
+    def _start_canary(self, rep: _Rep, spec,
+                      bundle_dir: Optional[str] = None) -> _CanaryState:
+        from .. import anomaly as _anom
+        analysis = _anom.CanaryAnalysis(spec)
+        analysis.start(self._rep_hist_state(rep, spec.metrics),
+                       self._peer_hist_state(rep, spec.metrics))
+        cs = _CanaryState(spec, analysis, bundle_dir)
+        self._canaries[rep.name] = cs
+        if _fl._ENABLED:
+            _fl.record("route", "router.canary_start",
+                       replica=rep.name, weight=spec.weight)
+        return cs
+
+    def _canary_tick(self, now: float):
+        for name, cs in list(self._canaries.items()):
+            rep = next((r for r in self._reps if r.name == name), None)
+            if rep is None or rep.state == DEAD:
+                cs.analysis.verdict = "rolled_back"
+                cs.analysis.report = {"reason":
+                                      "replica died under canary"}
+                verdict = "rolled_back"
+            else:
+                verdict = cs.analysis.evaluate(
+                    self._rep_hist_state(rep, cs.spec.metrics),
+                    self._peer_hist_state(rep, cs.spec.metrics))
+            if verdict is None:
+                continue
+            del self._canaries[name]
+            reason = cs.analysis.report.get("reason")
+            if verdict == "promoted":
+                self.n_canary_promotions += 1
+                if telemetry._ENABLED:
+                    telemetry.inc("router_canary_promotions_total")
+                if _fl._ENABLED:
+                    _fl.record("route", "router.canary_promote",
+                               replica=name, reason=reason)
+                continue
+            self.n_canary_rollbacks += 1
+            if telemetry._ENABLED:
+                telemetry.inc("router_canary_rollbacks_total")
+            if _fl._ENABLED:
+                _fl.record("route", "router.canary_rollback",
+                           replica=name, reason=reason)
+            if rep is not None and rep.state != DEAD:
+                try:
+                    rep.handle.begin_drain()
+                except Exception:
+                    pass
+            path = None if cs.bundle_dir is None else os.path.join(
+                cs.bundle_dir, "flight-bundle-canary_fail")
+            try:
+                self.collect_flight_bundle("canary_fail", path=path)
+            except Exception:
+                pass
 
     def stop_fleet(self, timeout_ms: int = 10_000) -> dict:
         """Send stop to every ProcReplica and collect their closing
@@ -1516,6 +1697,9 @@ class FleetRouter:
                 "prefill_exports": self.n_prefill_exports,
                 "stream_dispatches": self.n_stream_dispatches,
                 "disagg_fallbacks": self.n_disagg_fallbacks,
+                "canary_rollbacks": self.n_canary_rollbacks,
+                "canary_promotions": self.n_canary_promotions,
+                "canaries": sorted(self._canaries),
                 "replicas": {rep.name: {
                     "state": _STATE_NAMES[rep.state],
                     "breaker": rep.breaker.state,
@@ -1690,6 +1874,60 @@ class FleetRouter:
         telemetry.register_health_source(engine)
         self._slo = engine
         return engine
+
+    # -- anomaly engine ------------------------------------------------------
+
+    def attach_anomaly(self, engine=None, *,
+                       bundle_on_alert: bool = True,
+                       bundle_dir: Optional[str] = None,
+                       bundle_timeout_s: float = 5.0, **engine_kw):
+        """Wire an `mxnet_tpu.anomaly.AnomalyEngine` to this fleet:
+        detectors sample the fleet-merged registry plus the
+        per-replica heartbeat state (`_replica_snapshot` — histogram
+        blobs, compile stats, clock anchors), tick from `step()`
+        behind the telemetry gate, register as a /healthz source (a
+        firing detector answers 503), and — on each alert's rising
+        edge — collect a cross-process flight bundle
+        (``flight-bundle-anomaly-<detector>/``). Pass an engine to
+        reuse one (e.g. with restored baselines), or kwargs for a
+        default engine. Returns the engine."""
+        from .. import anomaly as _anom
+        if engine is None:
+            engine = _anom.AnomalyEngine(
+                source=self.fleet_registry,
+                replica_source=self._replica_snapshot, **engine_kw)
+        user_alert = engine.on_alert
+
+        def _on_alert(name, info):
+            if bundle_on_alert:
+                safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                               for c in name)
+                path = None if bundle_dir is None else os.path.join(
+                    bundle_dir, f"flight-bundle-anomaly-{safe}")
+                try:
+                    self.collect_flight_bundle(
+                        f"anomaly-{name}", path=path,
+                        timeout_s=bundle_timeout_s)
+                except Exception:
+                    pass
+            if user_alert is not None:
+                user_alert(name, info)
+
+        engine.on_alert = _on_alert
+        telemetry.register_health_source(engine)
+        self._anomaly = engine
+        return engine
+
+    def _replica_snapshot(self) -> List[dict]:
+        """Per-replica view for the anomaly detectors: name, health
+        state, last heartbeat detail (incl. compile stats), the
+        heartbeat-shipped registry blob, and the clock-anchor
+        offset."""
+        return [{"name": rep.name, "state": rep.state,
+                 "detail": rep.detail, "tm": rep.tm_state,
+                 "clock_offset": rep.clock_offset,
+                 "last_seen": rep.last_seen}
+                for rep in self._reps]
 
     # -- cross-process flight correlation ------------------------------------
 
@@ -1932,6 +2170,12 @@ def run_fleet_worker(channel, name: str,
             sp = _ft.fire("replica.stall")
             if sp is not None:
                 time.sleep(float(sp.get("ms", 500)) / 1e3)
+            sp = _ft.fire("replica.degrade")
+            if sp is not None:
+                # latency inflation, NOT a stall: the sleep is short
+                # relative to hb_interval_s, so heartbeats keep
+                # flowing — the degraded-but-alive adversary
+                time.sleep(float(sp.get("ms", 50)) / 1e3)
         for tok, req in list(live.items()):
             if req.state == "finished":
                 payload = {"status": req.status,

@@ -1089,6 +1089,12 @@ class InferenceServer:
                 lbl = self._tenant_label(req.tenant)
                 _lora._note_finish(lbl, status)
                 _lora._note_tokens(lbl, len(req.output_tokens))
+        if _gp._ENABLED and req.tenant is not None:
+            # same count, same label as serving_tenant_tokens_total —
+            # the usage meter stays conservation-equal to the
+            # tenant-labeled counter by construction
+            _gp.note_tenant_tokens(self._tenant_label(req.tenant),
+                                   len(req.output_tokens))
         if _fl._ENABLED:
             _fl.record("sched", "serving.finish", request=req.id,
                        reason=reason, status=status)

@@ -45,7 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from . import telemetry as _tm
 
 __all__ = ["Objective", "GoodputObjective", "SLOEngine",
-           "default_objectives"]
+           "default_objectives", "bucket_exp"]
 
 
 def _bucket_exp(threshold: float) -> int:
@@ -56,6 +56,11 @@ def _bucket_exp(threshold: float) -> int:
     if m == 0.5:
         e -= 1
     return e
+
+
+#: public alias — the anomaly/canary layer converts seconds thresholds
+#: to bucket exponents with the exact same rounding the SLO engine uses
+bucket_exp = _bucket_exp
 
 
 class Objective:

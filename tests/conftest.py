@@ -31,7 +31,7 @@ def pytest_configure(config):
 # least the floor) must pass at least this many tests. Single-file and
 # -k subset runs collect fewer and are exempt. Raise this when the
 # suite grows — never lower it.
-TIER1_PASSED_FLOOR = 1042
+TIER1_PASSED_FLOOR = 1109
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -1,0 +1,1010 @@
+"""Self-watching fleet (mxnet_tpu.anomaly): learned baselines
+(EWMA rate + log2-bucket occupancy), edge-triggered detectors with
+hysteresis (rate spike/drop, quantile drift, recompile storm,
+per-replica MAD outlier, clock jitter), baseline persistence through
+the checkpoint-manifest pattern, canary-gated rolling restarts
+(bucket-exact canary-vs-fleet comparison, stride routing weight,
+rollback accounting), and per-tenant usage metering conservation
+against the goodput ledger and the tenant-labeled serving counters."""
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, goodput, telemetry
+from mxnet_tpu.anomaly import (
+    ZERO_EXP, AnomalyEngine, BaselineStore, CanaryAnalysis, CanarySpec,
+    blob_hist, merge_hists, percentile_exp)
+from mxnet_tpu.serving import InferenceServer
+from mxnet_tpu.serving.router import FleetRouter
+
+from test_router import FakeReplica, _fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+    goodput.disable()
+    goodput.reset()
+    yield
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+    goodput.disable()
+    goodput.reset()
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    n = mx.models.get_model("llama_tiny")
+    n.initialize()
+    n(mx.nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize
+    return n
+
+
+def _bucket(v):
+    m, e = math.frexp(v)
+    return e - 1 if m == 0.5 else e
+
+
+# -- quantile helpers --------------------------------------------------------
+
+def test_percentile_exp_edges():
+    assert percentile_exp({}, 0, 0) is None
+    assert percentile_exp({}, 5, 5) == ZERO_EXP      # all zeros
+    assert percentile_exp({3: 100}, 100, 0) == 3
+    # q=0.5 over two equal buckets lands in the lower one
+    assert percentile_exp({1: 5, 8: 5}, 10, 0, q=0.5) == 1
+    assert percentile_exp({1: 5, 8: 5}, 10, 0, q=0.95) == 8
+
+
+def test_merge_and_blob_hist_roundtrip():
+    telemetry.enable()
+    h = telemetry.histogram("serving_ttft_seconds").labels()
+    for v in (0.1, 0.2, 4.0, 0.0):
+        h.observe(v)
+    blob = json.loads(json.dumps(telemetry._registry_state()))
+    telemetry.reset()
+    b, c, z = blob_hist(blob["serving_ttft_seconds"])
+    assert c == 4 and z == 1
+    assert sum(b.values()) == 3
+    for v in (0.1, 0.2, 4.0):
+        assert b.get(_bucket(v), 0) >= 1
+    mb, mc, mz = merge_hists([(b, c, z), (b, c, z)])
+    assert mc == 8 and mz == 2 and sum(mb.values()) == 6
+
+
+# -- BaselineStore: counter rates --------------------------------------------
+
+def test_rate_baseline_steady_scores_near_zero():
+    bs = BaselineStore(min_samples=5)
+    v, z = 0.0, None
+    for i in range(20):
+        v += 100.0
+        z = bs.observe_counter("tok", v, float(i))
+    assert z is not None and abs(z) < 1.0
+
+
+def test_rate_baseline_spike_drop_and_freeze():
+    bs = BaselineStore(min_samples=5)
+    v = 0.0
+    for i in range(10):
+        v += 100.0
+        bs.observe_counter("tok", v, float(i))
+    # sustained 15x spike: with freeze the anomalous samples are NOT
+    # absorbed, so every spike tick keeps scoring against the healthy
+    # baseline (hysteresis streaks survive)
+    zs = []
+    for i in range(10, 14):
+        v += 1500.0
+        zs.append(bs.observe_counter("tok", v, float(i), freeze=6.0))
+    assert all(z > 6.0 for z in zs)
+    assert zs[-1] == pytest.approx(zs[0], rel=0.5)
+    # back to steady: the baseline is still the healthy one
+    for i in range(14, 18):
+        v += 100.0
+        z = bs.observe_counter("tok", v, float(i), freeze=6.0)
+    assert abs(z) < 1.0
+    # full stop from the clean baseline scores as a hard drop
+    z = bs.observe_counter("tok", v, 18.0, freeze=6.0)
+    assert z < -6.0
+
+
+def test_rate_baseline_counter_reset_reanchors():
+    bs = BaselineStore(min_samples=3)
+    v = 0.0
+    for i in range(8):
+        v += 50.0
+        bs.observe_counter("tok", v, float(i))
+    assert bs.observe_counter("tok", 10.0, 8.0) is None  # restart
+    z = bs.observe_counter("tok", 60.0, 9.0)             # rate 50 again
+    assert z is not None and abs(z) < 1.0
+
+
+# -- BaselineStore: histogram occupancy --------------------------------------
+
+def test_histogram_baseline_drift_and_freeze():
+    bs = BaselineStore(min_samples=5)
+    b, c = {}, 0.0
+    fast = _bucket(0.005)
+    for i in range(10):
+        b[fast] = b.get(fast, 0) + 20
+        c += 20
+        d = bs.observe_histogram("lat", dict(b), c, 0.0)
+    assert d == 0
+    # 32x latency shift: ~5 log2 buckets of drift, and with freeze the
+    # polluted deltas never teach the baseline the new normal
+    slow = _bucket(0.16)
+    drifts = []
+    for i in range(4):
+        b[slow] = b.get(slow, 0) + 20
+        c += 20
+        drifts.append(bs.observe_histogram("lat", dict(b), c, 0.0,
+                                           freeze=2))
+    assert all(d >= 4 for d in drifts)
+    assert drifts[-1] == drifts[0]
+
+
+def test_histogram_baseline_reset_reanchors():
+    bs = BaselineStore(min_samples=3)
+    b, c = {3: 0.0}, 0.0
+    for i in range(6):
+        b[3] += 10
+        c += 10
+        bs.observe_histogram("lat", dict(b), c, 0.0)
+    # worker restart: cumulative state goes backwards -> re-anchor
+    assert bs.observe_histogram("lat", {3: 5.0}, 5.0, 0.0) is None
+    assert bs.observe_histogram("lat", {3: 15.0}, 15.0, 0.0) == 0
+
+
+def test_baseline_state_roundtrip_keeps_history():
+    bs = BaselineStore(min_samples=5)
+    v, b, c = 0.0, {}, 0.0
+    fast = _bucket(0.005)
+    for i in range(10):
+        v += 100.0
+        b[fast] = b.get(fast, 0) + 20
+        c += 20
+        bs.observe_counter("tok", v, float(i))
+        bs.observe_histogram("lat", dict(b), c, 0.0)
+    state = json.loads(json.dumps(bs.state_dict()))  # manifest-safe
+    bs2 = BaselineStore(min_samples=5)
+    bs2.restore_state(state)
+    # the restored store anchors fresh deltas (new process, new
+    # counters) but needs NO re-warmup: the very next delta scores
+    assert bs2.observe_counter("tok", 100.0, 100.0) is None  # anchor
+    z = bs2.observe_counter("tok", 1600.0, 101.0)
+    assert z is not None and z > 6.0
+    # the restored hist baseline anchors at zero, so even the FIRST
+    # post-restore delta already scores against the learned occupancy
+    assert bs2.observe_histogram("lat", {fast: 5.0}, 5.0, 0.0) == 0
+    slow = _bucket(0.16)
+    d = bs2.observe_histogram("lat", {fast: 5.0, slow: 20.0}, 25.0, 0.0)
+    assert d is not None and d >= 4
+
+
+# -- AnomalyEngine: detectors + hysteresis -----------------------------------
+
+def _mk_engine(**kw):
+    alerts, clears = [], []
+    kw.setdefault("baselines", BaselineStore(min_samples=5))
+    kw.setdefault("rate_metrics", ("my_tokens_total",))
+    kw.setdefault("hist_metrics", ("my_lat_seconds",))
+    kw.setdefault("tick_interval_s", 0.0)
+    kw.setdefault("hysteresis_on", 2)
+    kw.setdefault("hysteresis_off", 3)
+    eng = AnomalyEngine(
+        on_alert=lambda n, i: alerts.append((n, i)),
+        on_clear=clears.append, **kw)
+    return eng, alerts, clears
+
+
+def test_engine_disabled_telemetry_is_a_noop():
+    eng, alerts, _ = _mk_engine()
+    assert eng.tick(now=1.0) is None
+    assert eng.alerts_total == 0 and not alerts
+    assert telemetry._REGISTRY == {}
+
+
+def test_engine_rate_spike_fires_once_then_clears():
+    telemetry.enable()
+    eng, alerts, clears = _mk_engine()
+    t = 0.0
+    for _ in range(10):
+        telemetry.inc("my_tokens_total", 100)
+        t += 1.0
+        r = eng.tick(now=t)
+    assert r["firing"] == [] and not alerts
+    # one anomalous tick is not enough (hysteresis_on=2)
+    telemetry.inc("my_tokens_total", 1500)
+    t += 1.0
+    assert eng.tick(now=t)["firing"] == []
+    telemetry.inc("my_tokens_total", 1500)
+    t += 1.0
+    r = eng.tick(now=t)
+    assert r["firing"] == ["rate:my_tokens_total"]
+    assert [a[0] for a in alerts] == ["rate:my_tokens_total"]
+    assert alerts[0][1]["direction"] == "spike"
+    assert alerts[0][1]["z"] > 6
+    # still firing: the edge does not re-alert
+    telemetry.inc("my_tokens_total", 1500)
+    t += 1.0
+    eng.tick(now=t)
+    assert eng.alerts_total == 1
+    ok, reason = eng.health()
+    assert not ok and "rate:my_tokens_total" in reason
+    # recovery: hysteresis_off clean ticks clear the detector
+    for _ in range(4):
+        telemetry.inc("my_tokens_total", 100)
+        t += 1.0
+        r = eng.tick(now=t)
+    assert r["firing"] == [] and clears == ["rate:my_tokens_total"]
+    assert eng.health() == (True, "ok")
+    # the alert edge is counted in the registry too
+    fam = telemetry._REGISTRY["anomaly_alerts_total"]
+    assert any(dict(k).get("detector") == "rate:my_tokens_total"
+               for k in fam.children)
+
+
+def test_engine_no_flap_under_noise():
+    telemetry.enable()
+    eng, alerts, _ = _mk_engine()
+    rs = np.random.RandomState(7)
+    t = 0.0
+    for _ in range(60):
+        telemetry.inc("my_tokens_total", int(100 * (1 + 0.1 *
+                                                    rs.randn())))
+        for _ in range(10):
+            telemetry.observe("my_lat_seconds",
+                              0.005 * (1 + 0.2 * abs(rs.randn())))
+        t += 1.0
+        r = eng.tick(now=t)
+        assert r["firing"] == []
+    assert eng.alerts_total == 0 and not alerts
+
+
+def test_engine_histogram_drift_fires():
+    telemetry.enable()
+    eng, alerts, _ = _mk_engine(rate_metrics=())
+    t = 0.0
+    for _ in range(10):
+        for _ in range(20):
+            telemetry.observe("my_lat_seconds", 0.005)
+        t += 1.0
+        eng.tick(now=t)
+    for _ in range(3):
+        for _ in range(20):
+            telemetry.observe("my_lat_seconds", 0.16)
+        t += 1.0
+        r = eng.tick(now=t)
+    assert "drift:my_lat_seconds" in r["firing"]
+    assert alerts and alerts[0][1]["drift_buckets"] >= 4
+
+
+def test_engine_recompile_storm_post_warmup_only():
+    telemetry.enable()
+    counts = {"prefill": 3, "decode": 2}
+    eng, alerts, _ = _mk_engine(
+        rate_metrics=(), hist_metrics=(), warm_ticks=3,
+        compile_source=lambda: {"compiles": sum(counts.values()),
+                                "per_block": dict(counts)})
+    t = 0.0
+    # compiles during warmup (the fuzz-grid case: shapes churn early,
+    # then the signature set stabilizes) never fire
+    for _ in range(2):
+        counts["prefill"] += 1
+        t += 1.0
+        assert eng.tick(now=t)["firing"] == []
+    for _ in range(6):
+        t += 1.0
+        assert eng.tick(now=t)["firing"] == []
+    # ANY post-warmup compile is the anomaly: fires on one tick
+    counts["decode"] += 1
+    t += 1.0
+    r = eng.tick(now=t)
+    assert r["firing"] == ["recompile_storm"]
+    assert alerts[0][0] == "recompile_storm"
+    assert alerts[0][1]["sources"] == ["local:decode"]
+
+
+def test_engine_recompile_storm_from_replica_heartbeats():
+    telemetry.enable()
+    detail = {"compile": {"prefill_compiles": 4, "decode_compiles": 3}}
+    reps = [{"name": "w0", "state": "HEALTHY", "detail": detail,
+             "tm": {}, "clock_offset": None}]
+    eng, alerts, _ = _mk_engine(rate_metrics=(), hist_metrics=(),
+                                warm_ticks=2,
+                                compile_source=lambda: {},
+                                replica_source=lambda: reps)
+    t = 0.0
+    for _ in range(5):
+        t += 1.0
+        assert eng.tick(now=t)["firing"] == []
+    detail["compile"]["decode_compiles"] += 2
+    t += 1.0
+    r = eng.tick(now=t)
+    assert r["firing"] == ["recompile_storm"]
+    assert alerts[0][1]["sources"] == ["w0:decode_compiles"]
+
+
+def test_recompile_storm_silent_over_serving_fuzz_then_fires(net):
+    """The acceptance claim both ways on REAL `tracing.cache_stats()`:
+    a warmed server sweeping the request fuzz space (prompt lengths,
+    new-token counts, greedy vs sampled, tenants) never retraces — the
+    storm detector stays silent — while an intentionally
+    retrace-inducing geometry change (new executable signatures)
+    fires it."""
+    telemetry.enable()
+    rs = np.random.RandomState(7)
+
+    def sweep(srv):
+        for i in range(6):
+            T = int(rs.randint(1, 9))
+            srv.submit(rs.randint(1, 200, T).astype(np.int32),
+                       int(rs.randint(1, 4)),
+                       temperature=float(0.8 if i % 2 else 0.0),
+                       seed=i, tenant=f"t{i % 3}")
+        srv.run()
+
+    srv = InferenceServer(net, batch_slots=2, max_len=32, block_size=4,
+                          max_prompt_len=8)
+    sweep(srv)                       # warm: compiles land here
+    eng, alerts, _ = _mk_engine(rate_metrics=(), hist_metrics=(),
+                                warm_ticks=3, replica_source=lambda: [])
+    t = 0.0
+    for _ in range(5):               # anchor + warm every local source
+        t += 1.0
+        eng.tick(now=t)
+    assert any(st["warm"] for st in eng._compile_state.values())
+    for _ in range(3):               # the fuzz grid: silent on a
+        sweep(srv)                   # warmed server
+        t += 1.0
+        assert eng.tick(now=t)["firing"] == []
+    assert not alerts
+    # a new pool geometry builds fresh executables under the same
+    # program names: a genuine post-warmup retrace — the storm fires
+    srv2 = InferenceServer(net, batch_slots=2, max_len=64,
+                           block_size=8, max_prompt_len=16)
+    sweep(srv2)
+    t += 1.0
+    assert eng.tick(now=t)["firing"] == ["recompile_storm"]
+    assert alerts and alerts[0][0] == "recompile_storm"
+
+
+def test_engine_forget_replica_rearms_warmups():
+    """A deliberate restart (rolling_restart calls this) must not read
+    as a recompile storm: forgetting the replica drops its compile
+    anchors, so the rebuilt worker's recompiles re-enter warmup
+    instead of firing on a warm source."""
+    telemetry.enable()
+    detail = {"compile": {"decode_compiles": 3}}
+    reps = [{"name": "w0", "state": "HEALTHY", "detail": detail,
+             "tm": {}, "clock_offset": 0.01}]
+    eng, alerts, _ = _mk_engine(rate_metrics=(), hist_metrics=(),
+                                warm_ticks=2,
+                                compile_source=lambda: {},
+                                replica_source=lambda: reps)
+    t = 0.0
+    for _ in range(5):          # warm the w0:decode_compiles source
+        t += 1.0
+        eng.tick(now=t)
+    assert eng._compile_state["w0:decode_compiles"]["warm"]
+    eng.forget_replica("w0")
+    assert "w0:decode_compiles" not in eng._compile_state
+    assert "w0" not in eng._clock
+    # the restart's recompiles land while the source re-warms: silent
+    detail["compile"]["decode_compiles"] += 4
+    for _ in range(2):
+        t += 1.0
+        assert eng.tick(now=t)["firing"] == []
+    assert not alerts
+    # but a storm AFTER the source re-warms still fires
+    for _ in range(3):
+        t += 1.0
+        eng.tick(now=t)
+    detail["compile"]["decode_compiles"] += 1
+    t += 1.0
+    assert eng.tick(now=t)["firing"] == ["recompile_storm"]
+
+
+def _hist_blob(values, metric="serving_ttft_seconds"):
+    telemetry.enable()
+    telemetry.reset()
+    h = telemetry.histogram(metric).labels()
+    for v in values:
+        h.observe(v)
+    blob = json.loads(json.dumps(telemetry._registry_state()))
+    telemetry.reset()
+    return blob
+
+
+def test_engine_replica_outlier_mad():
+    telemetry.enable()
+    fast = _hist_blob([0.004, 0.005, 0.006, 0.005])
+    slow = _hist_blob([1.3, 1.1, 1.4, 1.2])
+    reps = [{"name": f"w{i}", "state": "HEALTHY", "detail": {},
+             "tm": fast, "clock_offset": None} for i in range(3)]
+    reps.append({"name": "w3", "state": "HEALTHY", "detail": {},
+                 "tm": slow, "clock_offset": None})
+    eng, alerts, _ = _mk_engine(
+        rate_metrics=(), hist_metrics=(),
+        outlier_metrics=("serving_ttft_seconds",),
+        replica_source=lambda: reps)
+    t = 0.0
+    for _ in range(3):
+        t += 1.0
+        r = eng.tick(now=t)
+    assert r["firing"] == ["outlier:w3"]
+    assert alerts[0][1]["replica"] == "w3"
+    assert alerts[0][1]["peer_median_exp"] == _bucket(0.005)
+
+
+def test_engine_clock_jitter():
+    telemetry.enable()
+    rep = {"name": "w0", "state": "HEALTHY", "detail": {}, "tm": {},
+           "clock_offset": 0.01}
+    eng, alerts, _ = _mk_engine(rate_metrics=(), hist_metrics=(),
+                                warm_ticks=2, jitter_s=0.25,
+                                replica_source=lambda: [rep])
+    t = 0.0
+    for _ in range(6):
+        t += 1.0
+        r = eng.tick(now=t)
+    assert r["firing"] == []
+    rep["clock_offset"] = 5.0        # NTP step / paused VM
+    for _ in range(2):
+        t += 1.0
+        r = eng.tick(now=t)
+    assert r["firing"] == ["clock_jitter:w0"]
+    assert alerts[0][1]["jitter_s"] > 0.25
+
+
+def test_engine_publishes_gauges_and_health_detail():
+    telemetry.enable()
+    eng, _, _ = _mk_engine()
+    t = 0.0
+    for _ in range(3):
+        telemetry.inc("my_tokens_total", 100)
+        t += 1.0
+        eng.tick(now=t)
+    fam = telemetry._REGISTRY.get("anomaly_detectors")
+    assert fam is not None and fam.children[()].value >= 0
+    d = eng.health_detail()
+    assert d["kind"] == "anomaly" and d["alerts_total"] == 0
+    # once a detector exists its score + firing gauges are exported
+    for _ in range(6):
+        telemetry.inc("my_tokens_total", 100)
+        t += 1.0
+        eng.tick(now=t)
+    score = telemetry._REGISTRY["anomaly_score"]
+    firing = telemetry._REGISTRY["anomaly_firing"]
+    key = (("detector", "rate:my_tokens_total"),)
+    assert key in score.children and key in firing.children
+    assert firing.children[key].value == 0.0
+
+
+def test_engine_tick_throttles_on_interval():
+    telemetry.enable()
+    eng, _, _ = _mk_engine(tick_interval_s=10.0,
+                           baselines=BaselineStore(min_samples=1))
+    telemetry.inc("my_tokens_total", 100)
+    r1 = eng.tick(now=0.0)
+    telemetry.inc("my_tokens_total", 100)
+    assert eng.tick(now=1.0) is r1          # throttled: cached result
+    assert eng.tick(now=11.0) is not r1
+
+
+def test_engine_state_roundtrip_via_manifest():
+    telemetry.enable()
+    eng, _, _ = _mk_engine(hysteresis_on=1)
+    t = 0.0
+    for _ in range(10):
+        telemetry.inc("my_tokens_total", 100)
+        t += 1.0
+        eng.tick(now=t)
+    state = json.loads(json.dumps(eng.state_dict()))
+    telemetry.reset()
+    eng2, alerts2, _ = _mk_engine(hysteresis_on=1)
+    eng2.restore_state(state)
+    # restored baselines: anchor tick, then an immediate spike fires
+    # with no re-warmup
+    telemetry.inc("my_tokens_total", 100)
+    eng2.tick(now=100.0)
+    telemetry.inc("my_tokens_total", 1600)
+    r = eng2.tick(now=101.0)
+    assert r["firing"] == ["rate:my_tokens_total"]
+    assert alerts2
+
+
+# -- CanarySpec / CanaryAnalysis ---------------------------------------------
+
+def test_canary_spec_validation():
+    with pytest.raises(ValueError):
+        CanarySpec(weight=0.0)
+    with pytest.raises(ValueError):
+        CanarySpec(weight=1.5)
+    with pytest.raises(ValueError):
+        CanarySpec(on_timeout="explode")
+
+
+def _hstate(values):
+    b = {}
+    zeros = 0
+    for v in values:
+        if v <= 0:
+            zeros += 1
+        else:
+            e = _bucket(v)
+            b[e] = b.get(e, 0) + 1
+    return {"serving_ttft_seconds": (b, float(len(values)),
+                                     float(zeros))}
+
+
+def test_canary_analysis_promotes_within_drift():
+    spec = CanarySpec(min_samples=8, window_s=60.0, drift_buckets=2)
+    an = CanaryAnalysis(spec, now=0.0)
+    an.start(_hstate([0.01] * 4), _hstate([0.01] * 50), now=0.0)
+    # not enough canary samples yet: undecided
+    assert an.evaluate(_hstate([0.01] * 8),
+                       _hstate([0.01] * 60), now=1.0) is None
+    v = an.evaluate(_hstate([0.01] * 4 + [0.012] * 10),
+                    _hstate([0.01] * 80), now=2.0)
+    assert v == "promoted" and an.verdict == "promoted"
+    assert "within drift" in an.report["reason"]
+    assert an.samples >= spec.min_samples
+    # verdict is sticky
+    assert an.evaluate(_hstate([9.0] * 99),
+                       _hstate([0.01] * 99), now=3.0) == "promoted"
+
+
+def test_canary_analysis_rolls_back_on_drift():
+    spec = CanarySpec(min_samples=8, window_s=60.0, drift_buckets=2)
+    an = CanaryAnalysis(spec, now=0.0)
+    an.start(_hstate([0.01] * 4), _hstate([0.01] * 50), now=0.0)
+    v = an.evaluate(_hstate([0.01] * 4 + [0.32] * 10),  # 32x slower
+                    _hstate([0.01] * 80), now=5.0)
+    assert v == "rolled_back"
+    assert "drifted" in an.report["reason"]
+    m = an.report["metrics"]["serving_ttft_seconds"]
+    assert m["drift_buckets"] > 2
+
+
+def test_canary_analysis_window_timeout_policies():
+    for policy, verdict in (("promote", "promoted"),
+                            ("rollback", "rolled_back")):
+        spec = CanarySpec(min_samples=50, window_s=10.0,
+                          on_timeout=policy)
+        an = CanaryAnalysis(spec, now=0.0)
+        an.start(_hstate([0.01]), _hstate([0.01] * 5), now=0.0)
+        assert an.evaluate(_hstate([0.01] * 2),
+                           _hstate([0.01] * 6), now=5.0) is None
+        v = an.evaluate(_hstate([0.01] * 3),
+                        _hstate([0.01] * 7), now=10.5)
+        assert v == verdict
+        assert "window expired" in an.report["reason"]
+
+
+# -- router integration: canary gate + rollback ------------------------------
+
+def _set_tm(rep, values):
+    rep.tm_state = _hist_blob(values)
+
+
+def test_router_canary_weight_gate_strides_picks():
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    fleet = _fleet([w0, w1])
+    # peer busy, canary idle: the canary wins every pick it is
+    # admitted to — weight 0.5 admits every 2nd offer
+    w1._subs = [type("S", (), {"ticks_left": 3, "cancelled": False})()
+                for _ in range(3)]
+    now = time.time()
+    fleet._refresh(now)
+    fr = fleet.submit(np.arange(1, 5, dtype=np.int32), 4)
+    fleet._queue.clear()                 # drive _pick by hand
+    fleet._start_canary(fleet._reps[0], CanarySpec(weight=0.5))
+    picks = [fleet._pick(fr, now).name for _ in range(6)]
+    assert picks == ["w1", "w0", "w1", "w0", "w1", "w0"]
+    # the gate never blocks availability: canary as the only
+    # eligible replica is offered regardless of weight
+    picks = [fleet._pick(fr, now, exclude=(fleet._reps[1],)).name
+             for _ in range(4)]
+    assert picks == ["w0"] * 4
+
+
+def test_router_canary_rollback_drains_and_counts(tmp_path):
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    fleet = _fleet([w0, w1])
+    now = time.time()
+    fleet._refresh(now)
+    rep0, rep1 = fleet._reps
+    _set_tm(rep0, [0.005] * 8)
+    _set_tm(rep1, [0.005] * 50)
+    spec = CanarySpec(weight=0.5, min_samples=8, window_s=60.0,
+                      drift_buckets=2)
+    fleet._start_canary(rep0, spec, bundle_dir=str(tmp_path))
+    assert "w0" in fleet.stats()["canaries"]
+    # fresh canary traffic comes back 32x slower than the fleet
+    _set_tm(rep0, [0.005] * 8 + [0.16] * 12)
+    _set_tm(rep1, [0.005] * 90)
+    fleet._canary_tick(time.time())
+    assert fleet.n_canary_rollbacks == 1
+    assert fleet.stats()["canary_rollbacks"] == 1
+    assert "w0" not in fleet._canaries
+    assert w0.draining          # drained back out for the operator
+    assert not w1.draining
+    fam = telemetry._REGISTRY["router_canary_rollbacks_total"]
+    assert fam.children[()].value == 1
+    # the failure evidence bundle was collected
+    manifest = json.loads(
+        (tmp_path / "flight-bundle-canary_fail"
+         / "manifest.json").read_text())
+    assert manifest["reason"] == "canary_fail"
+
+
+def test_router_canary_promote_restores_full_weight():
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    fleet = _fleet([w0, w1])
+    fleet._refresh(time.time())
+    rep0, rep1 = fleet._reps
+    _set_tm(rep0, [0.005] * 8)
+    _set_tm(rep1, [0.005] * 50)
+    spec = CanarySpec(weight=0.25, min_samples=8, window_s=60.0)
+    fleet._start_canary(rep0, spec)
+    _set_tm(rep0, [0.005] * 8 + [0.006] * 12)
+    _set_tm(rep1, [0.005] * 90)
+    fleet._canary_tick(time.time())
+    assert fleet.n_canary_promotions == 1
+    assert fleet.n_canary_rollbacks == 0
+    assert fleet._canaries == {}         # full routing weight again
+    assert not w0.draining
+    fam = telemetry._REGISTRY["router_canary_promotions_total"]
+    assert fam.children[()].value == 1
+
+
+def test_router_canary_dead_replica_forces_rollback():
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    fleet = _fleet([w0, w1], heartbeat_timeout_s=0.01)
+    fleet._refresh(time.time())
+    rep0 = fleet._reps[0]
+    _set_tm(rep0, [0.005] * 8)
+    _set_tm(fleet._reps[1], [0.005] * 50)
+    fleet._start_canary(rep0, CanarySpec(min_samples=4))
+    w0.dead = True
+    time.sleep(0.03)
+    fleet._refresh(time.time())
+    fleet._canary_tick(time.time())
+    assert fleet.n_canary_rollbacks == 1
+    rec = fleet.stats()
+    assert rec["canary_rollbacks"] == 1 and rec["canaries"] == []
+
+
+def test_rolling_restart_canary_timeout_policy_end_to_end():
+    """rolling_restart(canary=...) with no heartbeat telemetry: the
+    analysis window expires into the spec's on_timeout policy and the
+    per-replica record carries the verdict + report."""
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    fleet = _fleet([w0, w1])
+    res = fleet.rolling_restart(
+        drain_timeout_s=2.0, restart_timeout_s=2.0,
+        replicas=["w0"],
+        canary=CanarySpec(min_samples=4, window_s=0.15,
+                          on_timeout="promote"),
+        canary_timeout_s=5.0)
+    assert [r["replica"] for r in res] == ["w0"]
+    assert res[0]["canary"] == "promoted"
+    assert "window expired" in res[0]["report"]["reason"]
+    assert w0.restarts == 1 and w1.restarts == 0
+    assert fleet.n_canary_promotions == 1
+    assert fleet._canaries == {}
+
+
+def test_attach_anomaly_registers_health_and_ticks():
+    telemetry.enable()
+    fleet = _fleet([FakeReplica("w0"), FakeReplica("w1")])
+    eng = fleet.attach_anomaly(
+        baselines=BaselineStore(min_samples=3),
+        rate_metrics=("serve_requests_total",),
+        hist_metrics=(), outlier_metrics=(),
+        tick_interval_s=0.0, hysteresis_on=1, warm_ticks=2)
+    assert fleet._anomaly is eng
+    # the engine is a /healthz source now
+    report = telemetry.health_report()
+    assert report["ok"]
+    assert any(s.get("kind") == "anomaly" for s in report["sources"])
+    # step() drives the engine: feed a steady counter, then spike it
+    for _ in range(8):
+        telemetry.inc("serve_requests_total", 10, status="ok")
+        fleet.step()
+        time.sleep(0.005)
+    for _ in range(2):
+        telemetry.inc("serve_requests_total", 500, status="ok")
+        fleet.step()
+        time.sleep(0.005)
+    assert eng.alerts_total >= 1
+    ok, reason = telemetry.health()
+    assert not ok and "anomaly" in reason
+
+
+def test_attach_anomaly_alert_collects_flight_bundle(tmp_path):
+    from mxnet_tpu import flight
+    telemetry.enable()
+    flight.enable()
+    flight.clear()
+    try:
+        fleet = _fleet([FakeReplica("w0")])
+        eng = fleet.attach_anomaly(
+            baselines=BaselineStore(min_samples=3),
+            rate_metrics=("serve_requests_total",),
+            hist_metrics=(), outlier_metrics=(),
+            tick_interval_s=0.0, hysteresis_on=1, warm_ticks=2,
+            bundle_dir=str(tmp_path))
+        t = 0.0
+        for _ in range(6):
+            telemetry.inc("serve_requests_total", 10, status="ok")
+            t += 1.0
+            eng.tick(now=t)
+        telemetry.inc("serve_requests_total", 900, status="ok")
+        eng.tick(now=t + 1.0)
+        assert eng.alerts_total == 1
+        bundles = list(tmp_path.glob("flight-bundle-anomaly-*"))
+        assert bundles, "alert did not collect a flight bundle"
+        manifest = json.loads(
+            (bundles[0] / "manifest.json").read_text())
+        assert manifest["reason"].startswith("anomaly-rate:")
+    finally:
+        flight.disable()
+        flight.clear()
+
+
+# -- per-tenant usage metering -----------------------------------------------
+
+def test_note_tenant_tokens_gating_and_labels():
+    goodput.note_tenant_tokens("t0", 5)      # disabled: dropped
+    assert goodput._TENANT_TOKENS == {}
+    goodput.enable()
+    goodput.note_tenant_tokens("t0", 5)
+    goodput.note_tenant_tokens("t0", 3)
+    goodput.note_tenant_tokens(None, 7)      # falsy tenant bucket
+    goodput.note_tenant_tokens("", 2)
+    goodput.note_tenant_tokens("t1", 0)      # n<=0: dropped
+    assert goodput._TENANT_TOKENS == {"t0": 8, "anonymous": 9}
+
+
+def test_usage_report_conserves_ledger_chip_seconds():
+    goodput.enable()
+    t0 = time.perf_counter()
+    goodput.charge_span("productive", 2.0, end=t0 + 2.0)
+    goodput.charge_span("compile", 1.0, end=t0 + 3.0)
+    goodput.note_tokens("serve", 1000)
+    goodput.note_tenant_tokens("alpha", 600)
+    goodput.note_tenant_tokens("beta", 150)
+    rep = goodput.usage_report()
+    secs, _el = goodput.ledger().settled()
+    assert rep["productive_chip_seconds"] == pytest.approx(
+        secs["productive"] * rep["chips"])
+    total = sum(t["chip_seconds"] for t in rep["tenants"].values()) \
+        + rep["unattributed"]["chip_seconds"]
+    assert total == pytest.approx(rep["productive_chip_seconds"])
+    assert rep["tenants"]["alpha"]["token_share"] == pytest.approx(0.6)
+    assert rep["unattributed"]["tokens"] == 250
+    shares = sum(t["token_share"] for t in rep["tenants"].values()) \
+        + rep["unattributed"]["token_share"]
+    assert shares == pytest.approx(1.0)
+
+
+def test_usage_report_meter_fed_directly_still_conserves():
+    goodput.enable()
+    t0 = time.perf_counter()
+    goodput.charge_span("productive", 1.0, end=t0 + 1.0)
+    # a caller feeding the meter without note_tokens("serve", ...):
+    # shares normalize over the larger sum, nothing over-bills
+    goodput.note_tenant_tokens("solo", 40)
+    rep = goodput.usage_report()
+    assert rep["serve_tokens"] == 0
+    assert rep["tenants"]["solo"]["token_share"] == pytest.approx(1.0)
+    assert rep["unattributed"]["chip_seconds"] == pytest.approx(0.0)
+    total = sum(t["chip_seconds"] for t in rep["tenants"].values()) \
+        + rep["unattributed"]["chip_seconds"]
+    assert total == pytest.approx(rep["productive_chip_seconds"])
+
+
+def test_goodput_publish_exports_tenant_counters():
+    telemetry.enable()
+    goodput.enable()
+    goodput.note_tenant_tokens("alpha", 100)
+    goodput.publish()
+    fam = telemetry._REGISTRY["goodput_tenant_tokens_total"]
+    assert fam.children[(("tenant", "alpha"),)].value == 100.0
+    goodput.note_tenant_tokens("alpha", 50)
+    goodput.publish()                        # delta export, no double
+    assert fam.children[(("tenant", "alpha"),)].value == 150.0
+    goodput.publish()
+    assert fam.children[(("tenant", "alpha"),)].value == 150.0
+
+
+def test_tenant_state_rides_the_goodput_manifest():
+    goodput.enable()
+    goodput.note_tenant_tokens("alpha", 42)
+    st = json.loads(json.dumps(goodput.state_dict()))
+    goodput.reset()
+    goodput.enable()
+    goodput.note_tenant_tokens("alpha", 8)
+    goodput.restore_state(st)
+    assert goodput._TENANT_TOKENS["alpha"] == 50
+
+
+def test_server_usage_meter_matches_tenant_counter(net):
+    """The serving layer feeds the usage meter at the same site, with
+    the same label and count, as `serving_tenant_tokens_total` — the
+    two stay conservation-equal through a real serve run."""
+    telemetry.enable()
+    goodput.enable()
+    server = InferenceServer(net, batch_slots=2, max_len=32,
+                             block_size=4, max_prompt_len=8)
+    rs = np.random.RandomState(3)
+    for tenant in ("alpha", "alpha", "beta"):
+        server.submit(rs.randint(1, 200, 5).astype(np.int32), 4,
+                      tenant=tenant)
+    server.submit(rs.randint(1, 200, 5).astype(np.int32), 4)  # no tenant
+    server.run()
+    fam = telemetry._REGISTRY["serving_tenant_tokens_total"]
+    counter = {dict(k)["tenant"]: ch.value
+               for k, ch in fam.children.items()}
+    assert counter and set(counter) == {"alpha", "beta"}
+    assert goodput._TENANT_TOKENS == {
+        t: int(v) for t, v in counter.items()}
+    rep = goodput.usage_report()
+    assert rep["tenants"]["alpha"]["tokens"] == int(counter["alpha"])
+    assert rep["tenants"]["beta"]["tokens"] == int(counter["beta"])
+    total = sum(t["chip_seconds"] for t in rep["tenants"].values()) \
+        + rep["unattributed"]["chip_seconds"]
+    assert total == pytest.approx(rep["productive_chip_seconds"])
+
+
+def test_subprocess_canary_rollback_on_degraded_worker(tmp_path):
+    """The acceptance leg end to end: a 2-subprocess fleet over FileKV,
+    worker telemetry + flight shipped via heartbeats, `replica.degrade`
+    armed in w0's environment. A canaried rolling restart of w0 routes
+    it a weighted slice of live traffic, the analysis catches its
+    inter-token latency drifting whole log2 buckets past the fleet
+    peer, rolls it back out of rotation, and collects a
+    flight-bundle-canary_fail with evidence from >= 2 processes —
+    while every request still completes on the healthy peer."""
+    import os
+    import subprocess
+    import sys
+
+    from mxnet_tpu import flight
+    from mxnet_tpu.serving.router import FileKV, ProcReplica
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path)
+    kv = FileKV(d)
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_TPU_FAULTS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MXNET_TPU_TELEMETRY"] = "1"
+        env["MXNET_TPU_FLIGHT"] = "1"
+        env["MXNET_TPU_FLIGHT_DIR"] = d
+        if i == 0:
+            # latency inflation, not a stall: w0 stays live and
+            # heartbeating, just ~30x slower between decode ticks
+            env["MXNET_TPU_FAULTS"] = "replica.degrade:ms=300"
+        log = open(os.path.join(d, f"w{i}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", "-m", "mxnet_tpu.serving.router",
+             "--dir", d, "--name", f"w{i}", "--model", "llama_tiny",
+             "--max-prompt", "12", "--max-wall-s", "300"],
+            stdout=log, stderr=log, env=env, cwd=repo))
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 180:
+            if all(kv.get(f"fleet/w{i}/hb") is not None
+                   for i in range(2)):
+                break
+            for i, p in enumerate(procs):
+                assert p.poll() is None, (
+                    f"worker w{i} died during warmup rc={p.returncode}"
+                    f" — see {d}/w{i}.log")
+            time.sleep(0.05)
+        else:
+            pytest.fail("fleet workers never became healthy")
+
+        telemetry.enable()
+        flight.enable()
+        flight.clear()
+        fleet = FleetRouter([ProcReplica(kv, "w0"),
+                             ProcReplica(kv, "w1")],
+                            affinity_blocks=0, backoff_base_s=0.01,
+                            heartbeat_timeout_s=5.0,
+                            hedge_after_s=30.0)
+        rs = np.random.RandomState(5)
+        # enough queued work to outlast the canary window: the
+        # analysis needs live traffic through BOTH the canary and the
+        # peer after the restart
+        frs = [fleet.submit(rs.randint(1, 200, 6).astype(np.int32), 6)
+               for _ in range(80)]
+        res = fleet.rolling_restart(
+            drain_timeout_s=90.0, restart_timeout_s=90.0,
+            replicas=["w0"],
+            canary=CanarySpec(weight=0.5, min_samples=4,
+                              window_s=60.0, drift_buckets=2,
+                              metrics=("serving_tpot_seconds",)),
+            canary_timeout_s=120.0, bundle_dir=d)
+        assert [r["replica"] for r in res] == ["w0"]
+        assert res[0]["canary"] == "rolled_back", res[0]
+        assert "drifted" in res[0]["report"]["reason"]
+        assert fleet.n_canary_rollbacks >= 1
+        fam = telemetry._REGISTRY["router_canary_rollbacks_total"]
+        assert fam.children[()].value >= 1
+        # the evidence bundle spans the router and >= 1 live worker
+        manifest = json.loads(
+            (tmp_path / "flight-bundle-canary_fail"
+             / "manifest.json").read_text())
+        assert manifest["reason"] == "canary_fail"
+        assert len(manifest["sources"]) >= 2, manifest
+        # the degraded replica is OUT of rotation (draining), and the
+        # healthy peer still finishes the whole workload
+        fleet.run(timeout_s=240)
+        ok = sum(1 for fr in frs if fr.status == "ok")
+        assert ok == len(frs), fleet.stats()
+        fleet.stop_fleet(timeout_ms=30_000)
+    finally:
+        flight.disable()
+        flight.clear()
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except Exception:
+                p.kill()
+
+
+# -- replica.degrade fault site ----------------------------------------------
+
+def test_degrade_fault_inflates_local_drive_latency():
+    telemetry.enable()
+    w0, w1 = FakeReplica("w0"), FakeReplica("w1")
+    for h in (w0, w1):
+        h._degrade_ms = 0.0          # LocalReplica carries this slot
+    fleet = _fleet([w0, w1])
+    faults.inject("replica.degrade", at=2, ms=30, replica=1)
+    fleet.step()
+    assert w1._degrade_ms == 0.0
+    fleet.step()                     # trips at tick 2
+    assert w1._degrade_ms == 30.0
+    assert w0._degrade_ms == 0.0
+
+
+def test_degrade_fault_local_replica_sleeps_and_restart_clears(net):
+    from mxnet_tpu.serving.router import LocalReplica
+    def factory():
+        return InferenceServer(net, batch_slots=1, max_len=32,
+                               block_size=4, max_prompt_len=8)
+    rep = LocalReplica(factory(), factory=factory, name="r0")
+    fr = type("FR", (), {})()
+    fr.prompt = np.array([1, 2, 3], np.int32)
+    fr.max_new_tokens = 2
+    fr.id = "q1"
+    fr.params = {"temperature": 0.0, "top_k": 0, "top_p": 1.0,
+                 "eos_id": None, "seed": 0}
+    rep.submit(fr, "q1:0", None)
+    rep._degrade_ms = 25.0
+    t0 = time.perf_counter()
+    rep.drive()
+    assert time.perf_counter() - t0 >= 0.025
+    rep.restart()
+    assert rep._degrade_ms == 0.0

@@ -96,3 +96,24 @@ def test_process_worker_error_surfaces():
 def test_worker_type_validated():
     with pytest.raises(ValueError, match="worker_type"):
         DataLoader(_dataset(8), batch_size=4, worker_type="greenlet")
+
+
+class _StallDataset:
+    """Module-level (spawn workers must pickle the dataset)."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        import time
+        time.sleep(60)
+        return np.zeros(3, np.float32), 0
+
+
+def test_process_worker_timeout_names_batch_and_limit():
+    """A stalled worker surfaces as TimeoutError naming the batch it
+    was blocked on and the configured timeout — not the bare
+    multiprocessing.TimeoutError with no message."""
+    with pytest.raises(TimeoutError, match=r"after 1s.*batch 0"):
+        list(DataLoader(_StallDataset(), batch_size=4, num_workers=1,
+                        worker_type="process", timeout=1))

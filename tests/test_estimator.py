@@ -106,3 +106,20 @@ def test_evaluate():
     e.fit(data, val_data=data, epochs=2)
     name, acc = e.val_metrics[0].get()
     assert 0.0 <= acc <= 1.0
+
+
+def test_explicit_empty_metrics_are_kept():
+    """train_metrics=[] means "no metrics" — it must not silently
+    fall back to the Accuracy default (None still does)."""
+    net = _net()
+    loss = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    e = est.Estimator(net, loss, train_metrics=[], val_metrics=[])
+    assert e.train_metrics == [] and e.val_metrics == []
+    e.fit(_data(n=16), epochs=1)
+    assert e.train_metrics == []            # fit added nothing back
+    d = est.Estimator(net, loss)
+    assert len(d.train_metrics) == 1
+    assert d.train_metrics[0].get()[0] == "accuracy"
+    # a single bare metric is still wrapped into a list
+    s = est.Estimator(net, loss, train_metrics=mx.metric.Accuracy())
+    assert len(s.train_metrics) == 1

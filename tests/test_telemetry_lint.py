@@ -38,7 +38,8 @@ FAMILY = {"inc", "observe", "set_gauge", "mark_phase", "step_done",
           "record", "dump",
           # goodput's hot feeders ride the same cost contract
           "charge_span", "charge_gap", "note_compile", "note_tokens",
-          "note_train_step", "note_hbm_watermark", "publish"}
+          "note_tenant_tokens", "note_train_step",
+          "note_hbm_watermark", "publish"}
 
 #: substrings that make an `if` test (or a flag-variable initializer)
 #: count as the module-flag gate
@@ -269,6 +270,17 @@ def test_speculative_module_is_scanned_and_clean():
     path = os.path.join(PKG, "serving", "speculative.py")
     assert path in _module_files(), \
         "speculative.py missing from lint walk"
+    assert _violations(path) == []
+
+
+def test_anomaly_module_is_scanned_and_clean():
+    """The anomaly engine ticks inside the router step loop; every
+    alert counter / score gauge / flight record it emits is confined
+    to `_settle`/`_publish` behind their own `_tm._ENABLED` early
+    returns, and the detectors themselves emit nothing. The module
+    must be inside the lint's walk and free of ungated sites."""
+    path = os.path.join(PKG, "anomaly.py")
+    assert path in _module_files(), "anomaly.py missing from lint walk"
     assert _violations(path) == []
 
 
