@@ -1610,6 +1610,241 @@ def lora_phase(on_tpu, guard, num_requests=16, seed=0):
     guard.emit()
 
 
+def autoscale_phase(on_tpu, guard, seed=0):
+    """--autoscale: the self-scaling-fleet bench. One diurnal Poisson
+    arrival curve (burst -> trough -> burst) replayed through three
+    fleets of in-process LocalReplica servers sharing one net (and so
+    one executable cache — respawns warm-compile against jit's own
+    shape-keyed cache):
+
+    - autoscale leg: one warm replica + FleetAutoscaler with
+      min_replicas=0. Queue-age scale-out (sized by tokens/sec) grows
+      the fleet under each burst, load-driven scale-in drains it back,
+      and the fleet parks to ZERO through the trough — scale-from-zero
+      revives it for the second burst. A burn-rate SLOEngine rides the
+      leg and must stay SILENT (this is the clean leg).
+    - static N=min(=1) and static N=max legs: the same curve on fixed
+      fleets; their chip-seconds are N x wall by definition.
+
+    Pass = zero requests lost, >=1 scale-out AND >=1 scale-in, zero
+    SLO alerts, and the autoscaler's own chip-seconds ledger BEATING
+    both static fleets (the trough is where a fixed fleet burns chips
+    for nothing). A flood leg then maxes a max_replicas=1 fleet until
+    the admission floor rises to shed_below="standard": only
+    batch-class requests are shed at the door while every interactive
+    request completes inside its SLO (attainment 1.0)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import InferenceServer, LocalProvisioner
+    from mxnet_tpu.serving.router import FleetRouter, LocalReplica
+    from mxnet_tpu.slo import Objective
+
+    cfg, net = _build_net(on_tpu, serve=True)
+    if on_tpu:
+        slots, max_len, block, mpl, new = 8, 256, 16, 32, 16
+        ttft_slo, rate, nb, trough_s, tps0 = 2.0, 40.0, 16, 6.0, 400.0
+    else:
+        slots, max_len, block, mpl, new = 4, 64, 8, 16, 8
+        ttft_slo, rate, nb, trough_s, tps0 = 10.0, 20.0, 12, 6.0, 60.0
+    n_max = 3
+
+    # one deterministic diurnal curve, replayed identically per leg
+    rs = np.random.RandomState(seed)
+
+    def burst(t0):
+        ts = t0 + np.cumsum(rs.exponential(1.0 / rate, nb))
+        reqs = []
+        for t in ts:
+            T = int(rs.randint(4, mpl + 1))
+            p = rs.randint(0, cfg.vocab_size, T).astype(np.int32)
+            reqs.append((float(t), p, new))
+        return reqs, float(ts[-1])
+
+    b1, t_end1 = burst(0.0)
+    b2, _ = burst(t_end1 + trough_s)
+    curve = b1 + b2
+
+    def factory():
+        return InferenceServer(net, batch_slots=slots, max_len=max_len,
+                               block_size=block, max_prompt_len=mpl)
+
+    def drive(fleet):
+        frs, pending = [], list(curve)
+        t0 = time.perf_counter()
+        while pending or fleet._queue or fleet._inflight:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, p, n = pending.pop(0)
+                frs.append(fleet.submit(p, n))
+            if fleet.step() == 0:
+                time.sleep(0.002)
+        return frs, time.perf_counter() - t0
+
+    # -- autoscale leg (the clean SLO leg) --
+    telemetry.enable()
+    seed_srv = factory()
+    seed_srv.warmup()
+    fleet = FleetRouter([LocalReplica(seed_srv, factory=factory,
+                                      name="r0")], affinity_blocks=0)
+    engine = fleet.attach_slo(
+        objectives=[Objective("autoscale_ttft",
+                              metric="serving_ttft_seconds",
+                              target=0.7, threshold_s=ttft_slo)],
+        fast_window_s=2.0, slow_window_s=8.0, burn_threshold=1.0,
+        tick_interval_s=0.1)
+    asc = fleet.attach_autoscale(
+        provisioner=LocalProvisioner(factory),
+        min_replicas=0, max_replicas=n_max,
+        queue_age_out_s=0.25, drain_target_s=1.0,
+        default_tokens_per_s=tps0, scale_in_load=0.5,
+        scale_in_hold_s=0.5, cooldown_out_s=1.0, cooldown_in_s=0.4,
+        tick_interval_s=0.05)
+    frsA, wallA = drive(fleet)
+    chip_auto = asc.chip_seconds()
+    lostA = sum(1 for fr in frsA if fr.status != "ok")
+    scale_outs, scale_ins = asc.n_scale_out, asc.n_scale_in
+    clean_alerts = engine.alerts_total
+    usageA = asc.usage()
+    telemetry.unregister_health_source(engine)
+    telemetry.set_fleet_metrics_provider(None)
+    telemetry.disable()
+    telemetry.reset()
+
+    # -- static legs: chip-seconds are N x wall by definition --
+    def static_leg(n):
+        srvs = [factory() for _ in range(n)]
+        for s in srvs:
+            s.warmup()
+        f = FleetRouter([LocalReplica(s, factory=factory, name=f"s{i}")
+                         for i, s in enumerate(srvs)],
+                        affinity_blocks=0)
+        frs, wall = drive(f)
+        return wall, sum(1 for fr in frs if fr.status != "ok")
+
+    wall1, lost1 = static_leg(1)
+    wallM, lostM = static_leg(n_max)
+    chip_min, chip_max = 1 * wall1, n_max * wallM
+    savings = (chip_min - chip_auto) / chip_min if chip_min else 0.0
+    lost_total = lostA + lost1 + lostM
+    autoscale_pass = bool(lostA == 0 and scale_outs >= 1
+                          and scale_ins >= 1 and clean_alerts == 0
+                          and chip_auto < chip_min
+                          and chip_auto < chip_max)
+
+    # -- flood leg: maxed fleet raises the class-aware admission floor
+    flood_res = {}
+    if guard.remaining() > 30.0:
+        telemetry.enable()
+        fsrv = factory()
+        fsrv.warmup()
+        ffleet = FleetRouter([LocalReplica(fsrv, factory=factory,
+                                           name="f0")],
+                             affinity_blocks=0)
+        fasc = ffleet.attach_autoscale(
+            provisioner=LocalProvisioner(factory),
+            min_replicas=1, max_replicas=1,
+            queue_age_out_s=0.1, shed_below="standard",
+            overload_hold_s=0.1, scale_in_hold_s=1e9,
+            cooldown_in_s=1e9, tick_interval_s=0.02)
+        rsF = np.random.RandomState(seed + 1)
+        batch_frs, inter_frs, floor_seen = [], [], False
+        # prime: an up-front flood deep enough that queue-age p95
+        # crosses the trigger and holds — the floor must rise before
+        # the measured rounds below
+        for _ in range(30):
+            p = rsF.randint(0, cfg.vocab_size, 8).astype(np.int32)
+            batch_frs.append(ffleet.submit(p, 2 * new,
+                                           priority="batch"))
+        t_r = time.perf_counter()
+        while time.perf_counter() - t_r < 0.5:
+            if ffleet.step() == 0:
+                time.sleep(0.002)
+            floor_seen |= ffleet.admission_floor is not None
+        for _ in range(8):
+            for _ in range(4):
+                p = rsF.randint(0, cfg.vocab_size, 8).astype(np.int32)
+                batch_frs.append(ffleet.submit(p, new,
+                                               priority="batch"))
+            p = rsF.randint(0, cfg.vocab_size, 8).astype(np.int32)
+            inter_frs.append(ffleet.submit(p, new,
+                                           priority="interactive"))
+            t_r = time.perf_counter()
+            while time.perf_counter() - t_r < 0.25:
+                if ffleet.step() == 0:
+                    time.sleep(0.002)
+                floor_seen |= ffleet.admission_floor is not None
+        while ffleet._queue or ffleet._inflight:
+            if ffleet.step() == 0:
+                time.sleep(0.002)
+        batch_shed = sum(1 for fr in batch_frs
+                         if fr.status == "rejected")
+        inter_shed = sum(1 for fr in inter_frs
+                         if fr.status == "rejected")
+        inter_ok = sum(1 for fr in inter_frs if fr.status == "ok")
+        ttfts = [fr.ttft_s for fr in inter_frs
+                 if fr.ttft_s is not None]
+        slo_ok = sum(1 for t in ttfts if t <= ttft_slo)
+        attainment = (slo_ok / len(inter_frs)) if inter_frs else 0.0
+        fam = telemetry._REGISTRY.get("serve_shed_total")
+        by_class = {dict(k).get("class"): c.value
+                    for k, c in (fam.children.items() if fam else ())
+                    if k and dict(k).get("class")}
+        class_ordered = ("interactive" not in by_class
+                         and by_class.get("batch", 0) == batch_shed)
+        flood_res = {
+            "flood_floor_engaged": floor_seen,
+            "flood_batch_shed": batch_shed,
+            "flood_interactive_shed": inter_shed,
+            "flood_interactive_ok": inter_ok,
+            "flood_interactive_slo_attainment": round(attainment, 4),
+            "flood_shed_by_class": {k: int(v)
+                                    for k, v in by_class.items()},
+            "flood_pass": bool(floor_seen and batch_shed > 0
+                               and inter_shed == 0
+                               and inter_ok == len(inter_frs)
+                               and class_ordered
+                               and attainment == 1.0),
+        }
+        telemetry.disable()
+        telemetry.reset()
+
+    attain = flood_res.get("flood_interactive_slo_attainment", 0.0)
+    guard.best.update(flood_res)
+    guard.best.update({
+        "value": round(chip_auto, 3),
+        "phase": "autoscale",
+        "autoscale_pass": autoscale_pass,
+        "bench_autoscale_chip_seconds": round(chip_auto, 3),
+        "bench_autoscale_chip_savings_frac": round(savings, 4),
+        "bench_autoscale_slo_attainment": attain,
+        "bench_autoscale_scale_outs": scale_outs,
+        "bench_autoscale_scale_ins": scale_ins,
+        "bench_autoscale_lost": lost_total,
+        "bench_autoscale_clean_alerts": clean_alerts,
+        "static_min_chip_seconds": round(chip_min, 3),
+        "static_max_chip_seconds": round(chip_max, 3),
+        "autoscale_wall_s": round(wallA, 3),
+        "static_min_wall_s": round(wall1, 3),
+        "static_max_wall_s": round(wallM, 3),
+        "autoscale_spawned": usageA["spawned"],
+        "autoscale_reaped": usageA["reaped"],
+        "requests_per_leg": len(curve),
+        "trough_s": trough_s,
+    })
+    telemetry.enable()
+    for k in ("bench_autoscale_chip_seconds",
+              "bench_autoscale_chip_savings_frac",
+              "bench_autoscale_slo_attainment",
+              "bench_autoscale_scale_outs",
+              "bench_autoscale_scale_ins",
+              "bench_autoscale_lost",
+              "bench_autoscale_clean_alerts"):
+        telemetry.set_gauge(k, float(guard.best[k]),
+                            bench="decode_autoscale")
+    guard.emit()
+    telemetry.disable()
+    telemetry.reset()
+
+
 def main():
     global _guard
     ap = argparse.ArgumentParser()
@@ -1654,6 +1889,13 @@ def main():
                          "with a cross-process evidence bundle; a "
                          "clean restart must promote with zero "
                          "anomaly alerts and zero rollbacks")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="self-scaling fleet bench: a diurnal arrival "
+                         "curve where the autoscaled fleet (incl. "
+                         "scale-to-zero through the trough) must beat "
+                         "BOTH static N=min and N=max on chip-seconds "
+                         "with zero lost requests and a silent SLO, "
+                         "plus a flood leg shedding only batch class")
     ap.add_argument("--slo", action="store_true",
                     help="with --fleet: add SLO legs — a clean leg "
                          "where the burn-rate alert must stay silent "
@@ -1669,6 +1911,8 @@ def main():
         metric, unit = "paged_decode_bytes_ratio", "x"
     elif args.canary:
         metric, unit = "bench_canary_pass", "bool"
+    elif args.autoscale:
+        metric, unit = "bench_autoscale_chip_seconds", "chip-s"
     elif args.tenants:
         metric, unit = "bench_tenant_victim_ttft_p95_ms", "ms"
     elif args.lora:
@@ -1698,6 +1942,8 @@ def main():
         paged_kernel_phase(on_tpu, guard)
     elif args.canary:
         canary_phase(on_tpu, guard, seed=args.seed)
+    elif args.autoscale:
+        autoscale_phase(on_tpu, guard, seed=args.seed)
     elif args.tenants:
         tenants_phase(on_tpu, guard, num_requests=args.requests,
                       seed=args.seed)

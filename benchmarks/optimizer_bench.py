@@ -487,12 +487,18 @@ def main_telemetry_overhead():
     # no-ops the SLO engine tick and the router's trace-propagation
     # hook too, so the measured gap covers them compiled in but idle
     from mxnet_tpu import slo as _slo
+    from mxnet_tpu.serving import autoscale as _asc
     from mxnet_tpu.serving import kv_tier as _kvt
     from mxnet_tpu.serving import router as _router
 
     from mxnet_tpu import anomaly as _anom
 
     saved_hooks = {(_slo.SLOEngine, "tick"): _slo.SLOEngine.tick,
+                   # the autoscaler tick rides every router step (it is
+                   # deliberately UNgated — capacity control, not
+                   # observability), so the overhead gate must cover it
+                   (_asc.FleetAutoscaler, "tick"):
+                       _asc.FleetAutoscaler.tick,
                    (_router.FleetRouter, "_note_result"):
                        _router.FleetRouter._note_result,
                    # the anomaly engine rides the router step loop the
@@ -506,6 +512,8 @@ def main_telemetry_overhead():
                    (_anom.BaselineStore, "observe_histogram"):
                        _anom.BaselineStore.observe_histogram}
     hook_noops = {(_slo.SLOEngine, "tick"):
+                      lambda self, now=None: None,
+                  (_asc.FleetAutoscaler, "tick"):
                       lambda self, now=None: None,
                   (_router.FleetRouter, "_note_result"):
                       lambda self, *a, **k: None,

@@ -11,8 +11,11 @@ merged-weights generate() and weighted-fair tenant accounting on
 top. A second pass serves the base requests with chunked prefill +
 self-drafting speculative decoding (the counting language is
 maximally predictable, so n-gram drafts are mostly accepted) and
-re-checks greedy parity. Ends with the same model behind a
-2-replica mx.serving.FleetRouter (the resilient-fleet front door).
+re-checks greedy parity. Then the same model goes behind a
+2-replica mx.serving.FleetRouter (the resilient-fleet front door),
+and ends self-scaling: a 1-replica fleet + FleetAutoscaler grows
+under a burst (warm standby promotes first), shrinks back, and the
+goodput ledger attributes the standby's warm-up to COMPILE time.
 
 Usage: python examples/llama_serve.py [--cpu] [--steps 200]
                                       [--requests 8]
@@ -20,6 +23,7 @@ Usage: python examples/llama_serve.py [--cpu] [--steps 200]
 import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -249,6 +253,69 @@ def main():
               "free, "
               + (f"exhaustion forecast in {eta:.1f}s"
                  if eta is not None else "no exhaustion in sight"))
+
+    # -- self-scaling fleet: one replica + a FleetAutoscaler ----------
+    # A burst of requests ages the fleet queue past the scale-out
+    # trigger, the autoscaler grows the fleet (a warm standby promotes
+    # first — zero compile stall at promotion time), then load-driven
+    # scale-in drains it back to one replica. The standby's warm-up
+    # compile lands in the goodput ledger's COMPILE category, not
+    # productive time — the ledger shows scaling's true overhead.
+    compile_s0 = mx.goodput.snapshot()["seconds"]["compile"]
+
+    def spare():
+        # a shape this process has never compiled, so the standby
+        # warm-up is a REAL compile the goodput ledger can attribute
+        return mx.serving.InferenceServer(net, batch_slots=3,
+                                          max_len=48, block_size=8,
+                                          max_prompt_len=16)
+
+    afleet = mx.serving.FleetRouter(
+        [mx.serving.LocalReplica(
+            mx.serving.InferenceServer(net, batch_slots=4, max_len=64,
+                                       block_size=8, max_prompt_len=16),
+            name="a0")],
+        affinity_blocks=0)
+    asc = afleet.attach_autoscale(
+        provisioner=mx.serving.LocalProvisioner(spare),
+        min_replicas=1, max_replicas=3, warm_standbys=1,
+        queue_age_out_s=0.05, scale_in_load=0.8, scale_in_hold_s=0.3,
+        cooldown_out_s=0.2, cooldown_in_s=0.2, tick_interval_s=0.02)
+    afleet.step()                    # first tick spawns the standby
+    afrs = []
+    for i in range(args.requests * 8):
+        start = int(rs.randint(0, 50))
+        prompt = ((start + np.arange(5)) % 50).astype(np.int32)
+        afrs.append(afleet.submit(prompt, 12))
+    peak, t0 = 1, time.time()
+    while any(not fr.terminal for fr in afrs):
+        if afleet.step() == 0:
+            time.sleep(0.002)
+        peak = max(peak, asc.stats()["active"])
+        if time.time() - t0 > 180:
+            raise SystemExit("autoscale burst never finished")
+    t0 = time.time()
+    while (asc.stats()["active"] > 1 or asc.stats()["draining"]) \
+            and time.time() - t0 < 60:
+        if afleet.step() == 0:
+            time.sleep(0.002)
+    warm_compile_s = mx.goodput.snapshot()["seconds"]["compile"] \
+        - compile_s0
+    ast = asc.stats()
+    print(f"autoscale: peak {peak} replicas over "
+          f"{len(afrs)} burst requests, scale_outs={ast['scale_out']} "
+          f"scale_ins={ast['scale_in']} "
+          f"chip_seconds={ast['chip_seconds']}")
+    print(f"autoscale: standby warm-up charged "
+          f"{warm_compile_s:.2f}s to the goodput COMPILE category "
+          "(scaling never counts as productive time)")
+    if peak < 2 or ast["scale_in"] < 1 or asc.stats()["active"] != 1:
+        raise SystemExit("autoscaler failed to grow and shrink")
+    if any(fr.status != "ok" for fr in afrs):
+        raise SystemExit("autoscale burst lost a request")
+    if warm_compile_s <= 0:
+        raise SystemExit("standby warm-up missing from the compile "
+                         "ledger")
 
 
 if __name__ == "__main__":

@@ -608,10 +608,12 @@ class AnomalyEngine:
     def forget_replica(self, name: str) -> None:
         """Drop every per-replica learned anchor for `name` — compile
         counters, outlier rings, clock offset — and re-arm their
-        warmups. The router calls this after a *deliberate* restart:
-        the rebuilt worker recompiles its signatures and re-anchors
-        its clock by design, and treating that as a recompile storm
-        or clock jitter would page on every rolling restart."""
+        warmups. The router calls this on every *planned* replica
+        transition — a rolling restart, and the autoscaler's
+        add/drain/remove churn: a rebuilt or freshly spawned worker
+        recompiles its signatures and re-anchors its clock by design,
+        and treating that as a recompile storm or clock jitter would
+        page on every rolling restart and every scale event."""
         prefix = f"{name}:"
         for key in [k for k in self._compile_state
                     if k.startswith(prefix)]:

@@ -58,6 +58,15 @@ site                   fires at
                         picks it).  The degraded-but-alive adversary
                         for the anomaly outlier detector and the
                         canary gate
+``replica.spot_preempt`` same sites, SPOT replicas only — the cloud
+                        reclaiming preemptible capacity: the worker
+                        publishes one parting ``goodbye`` heartbeat
+                        and exits (the router fails its in-flight work
+                        over instantly; an attached autoscaler
+                        backfills the capacity); in-process, the
+                        spot-marked handle is just marked dead
+                        (payload ``replica=i`` picks among the spot
+                        handles)
 ``router.drop``         ``FleetRouter`` result intake — discards a
                         completed attempt's result as if the reply got
                         lost, exercising the retry + idempotency path
@@ -119,6 +128,7 @@ __all__ = ["SITES", "FaultInjected", "FaultTimeout",
 SITES = ("checkpoint.truncate", "collective.timeout", "grad.nonfinite",
          "step.kill", "host.slow", "serving.stall", "multihost.break",
          "replica.kill", "replica.stall", "replica.degrade",
+         "replica.spot_preempt",
          "router.drop",
          "kv.spill_corrupt", "kv.restore_slow")
 

@@ -763,6 +763,15 @@ _DIRECTION_OVERRIDES = {
     "bench_canary_clean_alerts": True,            # clean leg: 0 alerts
     "bench_canary_clean_rollbacks": True,         # clean leg: 0
     "bench_canary_bundle_sources": False,         # >=2 sources required
+    # autoscale leg: chip-seconds are the currency being minimized;
+    # attainment / scale-event counts must not be misread as latency
+    "bench_autoscale_chip_seconds": True,         # the bill itself
+    "bench_autoscale_chip_savings_frac": False,   # saved vs best static
+    "bench_autoscale_slo_attainment": False,      # interactive holds 1.0
+    "bench_autoscale_scale_outs": False,          # >=1 required
+    "bench_autoscale_scale_ins": False,           # >=1 required
+    "bench_autoscale_lost": True,                 # zero-loss contract
+    "bench_autoscale_clean_alerts": True,         # clean leg: 0 alerts
 }
 
 

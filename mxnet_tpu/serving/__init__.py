@@ -34,6 +34,13 @@ request scheduler instead of one-shot `generate()` calls.
   for speculative decoding (`InferenceServer(speculative=k)` verifies
   k drafts per tick in one dispatch; chunked prefill rides
   `prefill_chunk_tokens=C` — both tail-latency levers in one tick).
+- `autoscale.FleetAutoscaler` — the self-scaling fleet: SLO-burn /
+  queue-age driven scale-out sized by the goodput ledger's
+  tokens/sec/chip, load-driven scale-in with hysteresis, warm
+  standbys that pre-compile before entering rotation, preemptible
+  spot replicas with zero-loss backfill, and a class-aware admission
+  floor for the overloaded-at-max case
+  (`router.attach_autoscale(provisioner=..., policy=...)`).
 - `lora.AdapterPool` / `lora.WeightedFairScheduler` /
   `lora.TenantSpec` — batched multi-LoRA serving + tenant QoS: a
   device-resident stacked adapter table whose per-slot indices are
@@ -53,6 +60,9 @@ from . import speculative
 from . import lora
 from . import server
 from . import router
+from . import autoscale
+from .autoscale import (AutoscalePolicy, FleetAutoscaler,
+                        LocalProvisioner, ReplicaProvisioner)
 from .kv_cache import PagedKVCache
 from .kv_tier import KVTierManager, PrefixStore
 from .lora import (AdapterPool, WeightedFairScheduler, TenantSpec,
@@ -71,5 +81,7 @@ __all__ = ["PagedKVCache", "KVTierManager", "PrefixStore",
            "FleetRouter", "FleetRequest", "LocalReplica", "ProcReplica",
            "CircuitBreaker", "FileKV", "CoordKV", "RouterStalledError",
            "run_fleet_worker",
+           "AutoscalePolicy", "FleetAutoscaler", "LocalProvisioner",
+           "ReplicaProvisioner",
            "kv_cache", "kv_tier", "sampling", "executables", "server",
-           "router", "speculative", "lora"]
+           "router", "speculative", "lora", "autoscale"]

@@ -92,6 +92,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal as _signal_mod
 import time
 import uuid
 from collections import OrderedDict, deque
@@ -364,7 +365,8 @@ class LocalReplica:
     def __init__(self, server: Optional[InferenceServer] = None,
                  factory: Optional[Callable[[], InferenceServer]] = None,
                  name: Optional[str] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 spot: bool = False):
         if server is None:
             if factory is None:
                 raise ValueError("need a server or a factory")
@@ -375,6 +377,10 @@ class LocalReplica:
         #: disaggregated serving role ("prefill" | "decode" | None =
         #: combined); the router's `disaggregate` flow keys off this
         self.role = role
+        #: preemptible capacity: `replica.spot_preempt` reclaims only
+        #: spot-marked replicas, and the autoscaler prefers them as
+        #: scale-in victims
+        self.spot = spot
         self.dead = False
         self.restarts = 0
         self._stall_ticks_left = 0
@@ -509,10 +515,12 @@ class ProcReplica:
     """
 
     def __init__(self, channel, name: str,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 spot: bool = False):
         self.channel = channel
         self.name = name
         self.role = role
+        self.spot = spot                # preemptible capacity
         self.ns = f"fleet/{name}"
         self.dead = False               # router marks on staleness
         self._cmd_seq = 0
@@ -693,6 +701,8 @@ class FleetRouter:
         if not replicas:
             raise ValueError("need at least one replica")
         now = time.time()
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
         self._reps = [_Rep(h, CircuitBreaker(breaker_threshold,
                                              breaker_cooldown_s), now)
                       for h in replicas]
@@ -741,6 +751,12 @@ class FleetRouter:
         self._pick_how = "least_loaded"     # last routing decision
         self._slo = None                    # attach_slo() sets this
         self._anomaly = None                # attach_anomaly() sets this
+        self._autoscaler = None             # attach_autoscale() sets this
+        #: priority-class admission floor (None = open door): submits
+        #: whose declared class ranks BELOW this class are shed on
+        #: arrival — the autoscaler raises it when even max_replicas
+        #: can't hold the SLO, so overload costs batch, not interactive
+        self.admission_floor: Optional[str] = None
         #: replica name -> _CanaryState while under canary analysis
         self._canaries: Dict[str, _CanaryState] = {}
         self._bundle_seq = 0
@@ -784,14 +800,22 @@ class FleetRouter:
         otherwise the newcomer itself is shed. Either way the shed
         request is returned/left already terminal with status
         ``rejected`` — shedding never raises, so drivers can count
-        rejections like any other outcome. ``tenant`` / ``priority`` /
-        ``adapter`` forward to the serving replica (tenant QoS +
+        rejections like any other outcome. When `admission_floor`
+        is set (the autoscaler's maxed-and-still-burning response),
+        requests whose class ranks below the floor are shed at the
+        door before consuming a queue slot. ``tenant`` / ``priority``
+        / ``adapter`` forward to the serving replica (tenant QoS +
         batched LoRA); the adapter must be hot-loaded on the replicas
         that will serve it."""
         fr = FleetRequest(prompt_ids, max_new_tokens, temperature,
                           top_k, top_p, eos_id, seed, deadline_s,
                           tenant=tenant, priority=priority,
                           adapter=adapter)
+        if self.admission_floor is not None \
+                and priority_rank(priority) \
+                < priority_rank(self.admission_floor):
+            self._shed(fr)              # class-aware overload: at the
+            return fr                   # door, before any queue slot
         if len(self._queue) >= self.max_fleet_queue:
             rank = priority_rank(priority)
             victim = None
@@ -816,7 +840,7 @@ class FleetRouter:
         dispatch, drive local replicas, collect results, hedge.
         Returns a progress count (dispatches + tokens + deliveries)."""
         now = time.time()
-        if _ft._ACTIVE:
+        if _ft._ACTIVE and self._reps:
             sp = _ft.fire("replica.kill")
             if sp is not None:
                 self._kill_replica(int(sp.get("replica", 0)))
@@ -832,6 +856,9 @@ class FleetRouter:
                                % len(self._reps)].handle
                 if hasattr(h, "_degrade_ms"):
                     h._degrade_ms = float(sp.get("ms", 50))
+            sp = _ft.fire("replica.spot_preempt")
+            if sp is not None:
+                self._spot_preempt(int(sp.get("replica", 0)))
         self._refresh(now)
         progress = self._failover_dead(now)
         self._expire(now)
@@ -847,6 +874,10 @@ class FleetRouter:
             self._slo.tick()
         if self._anomaly is not None and telemetry._ENABLED:
             self._anomaly.tick()
+        if self._autoscaler is not None:
+            # NOT telemetry-gated: the autoscaler drives real capacity
+            # (its own emissions are gated internally)
+            self._autoscaler.tick(now)
         if self._canaries:
             self._canary_tick(now)
         return progress
@@ -903,6 +934,12 @@ class FleetRouter:
                 # only stalled (a never-seen worker is "starting", not
                 # dead). LocalReplica.dead stays sticky until restart.
                 h.dead = now - rep.last_seen > self.heartbeat_timeout_s
+                if rep.detail.get("goodbye"):
+                    # the worker's parting beat (spot preemption /
+                    # SIGTERM): it told us it is gone — don't wait out
+                    # heartbeat staleness, and don't let the fresh
+                    # stamp revive it
+                    h.dead = True
             if getattr(h, "dead", False):
                 state = DEAD
             elif rep.detail is None:
@@ -947,6 +984,21 @@ class FleetRouter:
         separate process to SIGKILL) — failover rescues its work."""
         rep = self._reps[idx % len(self._reps)]
         rep.handle.dead = True
+
+    def _spot_preempt(self, idx: int):
+        """In-process `replica.spot_preempt`: reclaim one SPOT replica
+        (``idx`` picks among the spot-marked handles) — it dies like a
+        preemption, failover rescues its in-flight work, and an
+        attached autoscaler backfills the capacity."""
+        spots = [rep for rep in self._reps
+                 if getattr(rep.handle, "spot", False)
+                 and rep.state != DEAD]
+        if not spots:
+            return
+        rep = spots[idx % len(spots)]
+        rep.handle.dead = True
+        if _fl._ENABLED:
+            _fl.record("route", "router.spot_preempt", replica=rep.name)
 
     def _failover_dead(self, now: float) -> int:
         """Resubmit every in-flight request held by a dead replica
@@ -1499,6 +1551,69 @@ class FleetRouter:
 
     # -- fleet lifecycle -----------------------------------------------------
 
+    def add_replica(self, handle) -> str:
+        """Dynamically add one replica to the fleet (the autoscaler's
+        scale-out primitive, usable standalone). The handle enters as
+        UNHEALTHY until its first good probe; if an anomaly engine is
+        attached its per-replica state for this name is forgotten —
+        a fresh incarnation recompiling and re-anchoring its clock is
+        planned churn, not an incident. Returns the replica name."""
+        if any(r.name == handle.name for r in self._reps):
+            raise ValueError(f"replica name {handle.name!r} already "
+                             "in the fleet")
+        rep = _Rep(handle, CircuitBreaker(self.breaker_threshold,
+                                          self.breaker_cooldown_s),
+                   time.time())
+        self._reps.append(rep)
+        if self._anomaly is not None:
+            self._anomaly.forget_replica(rep.name)
+        if _fl._ENABLED:
+            _fl.record("route", "router.add_replica", replica=rep.name)
+        return rep.name
+
+    def remove_replica(self, name: str, *,
+                       allow_empty: bool = False) -> bool:
+        """Remove one replica from the fleet (the scale-in primitive).
+        Any in-flight attempts it still holds are failed over first —
+        a planned removal loses nothing — then every trace of the
+        replica is swept: its prefix-affinity entries, its
+        heartbeat-shipped registry contribution (so the fleet-merged
+        ``replica=<name>`` series disappear from /metrics instead of
+        freezing), its ``router_replica_*`` gauge rows, and its
+        anomaly-engine state. Refuses to empty the fleet unless
+        ``allow_empty`` (the autoscaler passes it for scale-to-zero).
+        Returns False when no such replica exists."""
+        rep = next((r for r in self._reps if r.name == name), None)
+        if rep is None:
+            return False
+        if len(self._reps) == 1 and not allow_empty:
+            raise ValueError("refusing to remove the last replica "
+                             "(allow_empty=False)")
+        now = time.time()
+        for fr, att in list(rep.attempts.values()):
+            self._drop_attempt(fr, att, cancel=True, outcome="failover")
+            self.n_failovers += 1
+            if telemetry._ENABLED:
+                telemetry.inc("serve_failovers_total")
+            if _fl._ENABLED:
+                _fl.record("route", "router.failover",
+                           token=fr.token, replica=rep.name)
+            self._retry(fr, now, f"replica {rep.name} removed")
+        self._reps.remove(rep)
+        for key in [k for k, v in self._affinity.items() if v is rep]:
+            del self._affinity[key]
+        rep.tm_state.clear()
+        if telemetry._ENABLED:
+            telemetry.remove_series("router_replica_health",
+                                    replica=name)
+            telemetry.remove_series("router_replica_inflight",
+                                    replica=name)
+        if self._anomaly is not None:
+            self._anomaly.forget_replica(name)
+        if _fl._ENABLED:
+            _fl.record("route", "router.remove_replica", replica=name)
+        return True
+
     def rolling_restart(self, drain_timeout_s: float = 60.0,
                         restart_timeout_s: float = 60.0,
                         canary=None,
@@ -1700,6 +1815,9 @@ class FleetRouter:
                 "canary_rollbacks": self.n_canary_rollbacks,
                 "canary_promotions": self.n_canary_promotions,
                 "canaries": sorted(self._canaries),
+                "admission_floor": self.admission_floor,
+                "autoscale": None if self._autoscaler is None
+                else self._autoscaler.stats(),
                 "replicas": {rep.name: {
                     "state": _STATE_NAMES[rep.state],
                     "breaker": rep.breaker.state,
@@ -1918,6 +2036,26 @@ class FleetRouter:
         self._anomaly = engine
         return engine
 
+    # -- autoscaler ----------------------------------------------------------
+
+    def attach_autoscale(self, autoscaler=None, *, provisioner=None,
+                         policy=None, **policy_kw):
+        """Wire a `mxnet_tpu.serving.autoscale.FleetAutoscaler` to
+        this fleet: it adopts the current replicas, then ticks from
+        `step()` — UNgated (capacity control must run with telemetry
+        off; its emissions gate themselves) — spawning and draining
+        replicas through ``provisioner`` against the policy. Pass an
+        autoscaler to reuse one, or a provisioner plus a policy /
+        policy kwargs for a fresh one. Returns the autoscaler."""
+        from . import autoscale as _as
+        if autoscaler is None:
+            if provisioner is None:
+                raise ValueError("need an autoscaler or a provisioner")
+            autoscaler = _as.FleetAutoscaler(self, provisioner,
+                                             policy=policy, **policy_kw)
+        self._autoscaler = autoscaler
+        return autoscaler
+
     def _replica_snapshot(self) -> List[dict]:
         """Per-replica view for the anomaly detectors: name, health
         state, last heartbeat detail (incl. compile stats), the
@@ -1998,7 +2136,8 @@ def run_fleet_worker(channel, name: str,
                      hb_interval_s: float = 0.1,
                      idle_sleep_s: float = 0.002,
                      max_wall_s: Optional[float] = None,
-                     warmup: bool = True):
+                     warmup: bool = True,
+                     spot: bool = False):
     """Drive one `InferenceServer` as a fleet replica against the kv
     channel protocol (the counterpart of `ProcReplica`): consume the
     ``cmd/<seq>`` stream in order, tick the server, publish per-attempt
@@ -2011,7 +2150,11 @@ def run_fleet_worker(channel, name: str,
     worker's environment: ``replica.kill`` / ``replica.stall`` are hit
     once per PRODUCTIVE tick (tokens were emitted), so a kill always
     lands mid-stream with real in-flight work for the router to
-    fail over. Returns the server on a clean ``stop``."""
+    fail over. ``replica.spot_preempt`` (and a real SIGTERM — the
+    cloud's reclaim notice) triggers the spot-preemption exit: one
+    parting ``goodbye`` heartbeat so the router fails the work over
+    instantly instead of waiting out staleness, then a prompt return.
+    Returns the server on a clean ``stop``."""
     if server is None:
         if server_factory is None:
             raise ValueError("need a server or a server_factory")
@@ -2025,22 +2168,25 @@ def run_fleet_worker(channel, name: str,
     last_hb = 0.0
     t_start = time.time()
     stopping = False
+    preempted = False
     fatal: Optional[str] = None
 
+    def _on_sigterm(signum, frame):
+        nonlocal preempted
+        preempted = True                # handled at the loop top
+    try:
+        _signal_mod.signal(_signal_mod.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass                            # not the main thread
+
     if warmup:
-        # compile prefill + decode BEFORE the first heartbeat: the
-        # single-threaded worker cannot beat mid-compile, and a silent
-        # worker reads as dead — warming up front keeps the liveness
-        # signal honest. The compile discipline stays 1+1: this IS the
-        # one compile, every served request reuses it.
-        wreq = server.submit([1, 2], 2)
-        while wreq.state != "finished":
-            server.step()
-        if getattr(server, "tier", None) is not None:
-            # compile the spill/restore program pair up front too: a
-            # disaggregated decode replica must adopt streamed blocks
-            # with ZERO extra compiles after warm-up
-            server.warm_tier()
+        # compile prefill + decode (+ the tier program pair) BEFORE
+        # the first heartbeat: the single-threaded worker cannot beat
+        # mid-compile, and a silent worker reads as dead — warming up
+        # front keeps the liveness signal honest. The compile
+        # discipline stays 1+1: this IS the one compile, every served
+        # request reuses it.
+        server.warmup()
 
     # clock handshake, recorded at warm-up: perf_counter and wall clock
     # sampled together, shipped on every heartbeat so the router can
@@ -2049,10 +2195,16 @@ def run_fleet_worker(channel, name: str,
     clock_anchor = {"perf": time.perf_counter(), "unix": time.time()}
     hb_state = {"seq": 0, "tm_prev": None}
 
-    def _beat(now, reason=None):
+    def _beat(now, reason=None, goodbye=False):
         d = server.health_detail()
         d["t"] = now
         d["name"] = name
+        if spot:
+            d["spot"] = True            # preemptible, on every beat
+        if goodbye:
+            # the parting beat: tells the router this worker is GONE
+            # (dead on arrival, immune to staleness-revival)
+            d["goodbye"] = True
         d["compile"] = server.compile_stats()
         d["clock"] = clock_anchor
         hb_state["seq"] += 1
@@ -2176,6 +2328,10 @@ def run_fleet_worker(channel, name: str,
                 # relative to hb_interval_s, so heartbeats keep
                 # flowing — the degraded-but-alive adversary
                 time.sleep(float(sp.get("ms", 50)) / 1e3)
+            sp = _ft.fire("replica.spot_preempt")
+            if sp is not None:
+                preempted = True        # lands mid-stream, like a real
+                                        # reclaim notice
         for tok, req in list(live.items()):
             if req.state == "finished":
                 payload = {"status": req.status,
@@ -2204,6 +2360,13 @@ def run_fleet_worker(channel, name: str,
             done_exports[tok] = wire
             channel.set(f"{ns}/kv/{tok}", wire)
             live_exports.pop(tok)
+        if preempted:
+            # spot reclaim: finished results are already published
+            # above; whatever is still decoding is abandoned for the
+            # router to fail over (idempotency tokens make the
+            # resubmission safe). One goodbye beat, then out.
+            _beat(now, reason="spot_preempt", goodbye=True)
+            return server
         if fatal is not None:
             _beat(now, reason=f"fatal: {fatal}")
             raise RuntimeError(f"fleet worker {name}: {fatal}")
@@ -2252,6 +2415,11 @@ def _worker_main(argv=None):
                          "(implies tiering)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-wall-s", type=float, default=None)
+    ap.add_argument("--spot", action="store_true",
+                    help="mark this worker preemptible (SIGTERM / the "
+                         "replica.spot_preempt site triggers the "
+                         "goodbye-beat exit either way; --spot just "
+                         "stamps the heartbeats)")
     args = ap.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -2275,7 +2443,8 @@ def _worker_main(argv=None):
 
     run_fleet_worker(FileKV(args.dir), args.name,
                      server_factory=factory,
-                     max_wall_s=args.max_wall_s)
+                     max_wall_s=args.max_wall_s,
+                     spot=args.spot)
 
 
 if __name__ == "__main__":

@@ -1431,6 +1431,26 @@ class InferenceServer:
                 return True
         return False
 
+    # -- warm-up -------------------------------------------------------------
+
+    def warmup(self) -> float:
+        """Compile every serving executable ahead of traffic: one tiny
+        request through prefill + decode, plus the tier's
+        spill/restore pair when tiering is on. This is THE standby
+        warm-up — fleet workers run it before their first heartbeat,
+        and the autoscaler's provisioner runs it before a spawned
+        replica enters rotation, so scale-out adds capacity with zero
+        compile stall. The compile wall time lands in the goodput
+        ledger's *compile* category (via the executable build hooks),
+        not productive time. Returns the wall seconds spent."""
+        t0 = time.perf_counter()
+        req = self.submit([1, 2], 2)
+        while req.state != "finished":
+            self.step()
+        if self.tier is not None:
+            self.warm_tier()
+        return time.perf_counter() - t0
+
     # -- KV tier hierarchy ---------------------------------------------------
 
     def warm_tier(self):

@@ -325,6 +325,19 @@ class SLOEngine:
             info["effective_threshold_s"] = obj.effective_threshold
         return info
 
+    def burn_signal(self) -> float:
+        """The worst multi-window-consistent burn across objectives:
+        max over objectives of min(burn_fast, burn_slow) — the same
+        both-windows rule :meth:`tick` uses to fire, exposed as a
+        continuous signal so the autoscaler can scale out BEFORE the
+        alert threshold is crossed (a value > 1.0 means the error
+        budget is burning faster than sustainable on both windows)."""
+        worst = 0.0
+        for obj in self.objectives:
+            st = self._state[obj.name]
+            worst = max(worst, min(st.burn_fast, st.burn_slow))
+        return worst
+
     # -- health-source protocol (telemetry.register_health_source) ----------
 
     def firing(self) -> List[str]:

@@ -1,0 +1,411 @@
+"""Self-scaling fleet (mxnet_tpu.serving.autoscale): queue-age /
+SLO-burn scale-out sized by tokens-per-chip, hold-window scale-in with
+gauge-series sweep, warm-standby promotion, class-aware admission
+floor, planned-churn forget_replica, and spot preemption with
+autoscaler backfill — fast scenarios on fake replica handles, plus a
+subprocess leg that SIGTERMs a real spot worker mid-decode."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.serving import InferenceServer
+from mxnet_tpu.serving.autoscale import (AutoscalePolicy, FleetAutoscaler,
+                                         LocalProvisioner,
+                                         ReplicaProvisioner)
+from mxnet_tpu.serving.router import (FileKV, FleetRouter, LocalReplica,
+                                      ProcReplica)
+
+from test_router import FakeReplica
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    faults.clear()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _fake_provisioner(spot=False, latency_ticks=1, slots=4,
+                      reaped=None):
+    """Provisioner over FakeReplica handles (no compiles, no procs)."""
+    def spawn(name, spot_arg):
+        h = FakeReplica(name, latency_ticks=latency_ticks, slots=slots)
+        h.spot = spot or spot_arg
+        return h
+    def reap(handle):
+        if reaped is not None:
+            reaped.append(handle.name)
+    return ReplicaProvisioner(spawn, reap)
+
+
+def _drive(fleet, wall_s, sleep_s=0.01):
+    t0 = time.time()
+    peak = len(fleet._reps)
+    while time.time() - t0 < wall_s:
+        fleet.step()
+        peak = max(peak, len(fleet._reps))
+        time.sleep(sleep_s)
+    return peak
+
+
+def _burst_policy(**kw):
+    base = dict(min_replicas=1, max_replicas=3, queue_age_out_s=0.03,
+                cooldown_out_s=0.0, cooldown_in_s=0.0,
+                scale_in_hold_s=0.05, scale_in_load=0.9,
+                tick_interval_s=0.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def test_scale_out_on_queue_age_then_back_in():
+    """The full arc: a burst ages the queue past threshold -> the
+    fleet grows; the queue drains and load holds under target -> the
+    fleet drains back to min, and the reaped replicas are gone from
+    the router entirely."""
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0)
+    reaped = []
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=2, slots=2,
+                                      reaped=reaped),
+        policy=_burst_policy())
+    frs = [fleet.submit([i + 1, 2, 3], 4) for i in range(24)]
+    peak = _drive(fleet, 0.5)
+    assert asc.n_scale_out >= 1, asc.stats()
+    assert peak >= 2, asc.stats()
+    fleet.run(timeout_s=10)
+    assert all(fr.status == "ok" for fr in frs)
+    _drive(fleet, 1.0)                  # idle: hold window + drain
+    assert asc.n_scale_in >= 1, asc.stats()
+    assert len(fleet._reps) == 1, [r.name for r in fleet._reps]
+    assert reaped, "scaled-in replicas were never reaped"
+    assert asc.chip_seconds() > 0
+
+
+def test_sizing_adds_multiple_replicas_per_decision():
+    """The goodput-ledger sizing math: backlog tokens over
+    (tokens/sec/chip x drain_target_s) can add >1 replica in ONE
+    decision instead of ratcheting one per cooldown."""
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=3, slots=1)],
+                        affinity_blocks=0)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=3, slots=1),
+        policy=_burst_policy(max_replicas=4, default_tokens_per_s=10.0,
+                             drain_target_s=1.0, cooldown_out_s=60.0))
+    assert asc._size_out(35) == 4       # ceil(35 / (10 * 1.0))
+    assert asc._size_out(0) == 1
+    # live: a fat backlog + one decision (cooldown blocks a second)
+    for i in range(10):
+        fleet.submit([i + 1, 2, 3, 4], 8)   # 12 tokens each
+    time.sleep(0.06)
+    fleet.step()
+    assert asc.n_scale_out == 1
+    assert asc.target == 4, asc.stats()     # 120 tokens -> +12 capped
+    assert len(fleet._reps) == 4
+
+
+def test_scale_in_sweeps_replica_series():
+    """Satellite: a drained-and-reaped replica's router_replica_*
+    gauges disappear from the registry (PR 14 only swept DEAD), so
+    autoscale churn leaves no frozen tombstones on /metrics."""
+    telemetry.enable()
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=2, slots=2),
+        policy=_burst_policy())
+    frs = [fleet.submit([i + 1, 2, 3], 4) for i in range(24)]
+    spawned, gauge_seen = set(), set()
+    t0 = time.time()
+    while time.time() - t0 < 0.5:
+        fleet.step()
+        for r in fleet._reps:
+            if r.name != "r0":
+                spawned.add(r.name)
+                if telemetry.read_gauge("router_replica_health",
+                                        replica=r.name) is not None:
+                    gauge_seen.add(r.name)
+        time.sleep(0.01)
+    assert spawned
+    assert gauge_seen == spawned        # the series existed while live
+    fleet.run(timeout_s=10)
+    assert all(fr.status == "ok" for fr in frs)
+    _drive(fleet, 1.0)
+    assert len(fleet._reps) == 1
+    for name in spawned:
+        assert telemetry.read_gauge("router_replica_health",
+                                    replica=name) is None, name
+        assert telemetry.read_gauge("router_replica_inflight",
+                                    replica=name) is None, name
+    # the survivor's series is intact
+    assert telemetry.read_gauge("router_replica_health",
+                                replica="r0") is not None
+    # and the fleet-merged registry carries no reaped-replica children
+    merged = fleet.fleet_registry()
+    for fam in merged.values():
+        for key in getattr(fam, "children", {}):
+            for label, value in key:
+                if label == "replica":
+                    assert value not in spawned, (fam, key)
+
+
+def test_warm_standby_promoted_before_spawn():
+    """A warm standby parks drained (pre-compiled, out of rotation);
+    scale-out promotes it with one end_drain instead of spawning."""
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=2, slots=2),
+        policy=_burst_policy(warm_standbys=1, cooldown_out_s=60.0))
+    fleet.step()
+    time.sleep(0.01)
+    fleet.step()                        # standby spawned + probed
+    standbys = asc._standbys()
+    assert len(standbys) == 1
+    sb_name = standbys[0].name
+    rep = next(r for r in fleet._reps if r.name == sb_name)
+    assert rep.handle.draining          # parked out of rotation
+    for i in range(16):
+        fleet.submit([i + 1, 2, 3], 4)
+    time.sleep(0.05)
+    fleet.step()
+    assert asc.n_scale_out == 1
+    m = asc._managed[sb_name]
+    assert not m.standby and m.state == "active", m.state
+    assert not rep.handle.draining      # promoted: just an end_drain
+    fleet.run(timeout_s=10)
+
+
+def test_admission_floor_sheds_batch_keeps_interactive():
+    """Maxed out and still past threshold: the floor sheds batch-class
+    requests at the door while interactive traffic is admitted, and
+    clears once the overload signal does."""
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(),
+        policy=_burst_policy(max_replicas=1, shed_below="standard",
+                             overload_hold_s=0.0))
+    for i in range(16):
+        fleet.submit([i + 1, 2, 3], 4)
+    time.sleep(0.05)
+    fleet.step()                        # overload observed
+    time.sleep(0.02)
+    fleet.step()                        # hold elapsed: floor up
+    assert fleet.admission_floor == "standard", asc.stats()
+    shed = fleet.submit([90, 2, 3], 4, priority="batch")
+    kept = fleet.submit([91, 2, 3], 4, priority="interactive")
+    assert shed.status == "rejected" and shed.finish_reason == "shed"
+    assert kept.status is None          # admitted, not terminal
+    fleet.run(timeout_s=10)
+    _drive(fleet, 0.1)
+    assert fleet.admission_floor is None    # overload over: door open
+    ok = fleet.submit([92, 2, 3], 4, priority="batch")
+    assert ok.status != "rejected"
+    fleet.run(timeout_s=10)
+
+
+def test_planned_churn_calls_forget_replica():
+    """Every planned transition (add, drain) tells the anomaly engine
+    to forget the replica, so autoscale churn never reads as a
+    recompile storm or clock jitter incident."""
+    telemetry.enable()
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0)
+    eng = fleet.attach_anomaly(bundle_on_alert=False)
+    forgotten = []
+    orig = eng.forget_replica
+    eng.forget_replica = lambda n: (forgotten.append(n), orig(n))[1]
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=2, slots=2),
+        policy=_burst_policy())
+    frs = [fleet.submit([i + 1, 2, 3], 4) for i in range(24)]
+    _drive(fleet, 0.5)
+    assert asc.n_scale_out >= 1
+    fleet.run(timeout_s=10)
+    _drive(fleet, 1.0)
+    assert asc.n_scale_in >= 1
+    spawned = {n for n in forgotten if n != "r0"}
+    assert spawned, "add_replica never forgot the fresh incarnation"
+    assert len(forgotten) >= 3, forgotten   # add + drain + remove
+    assert all(fr.status == "ok" for fr in frs)
+
+
+def test_spot_preempt_in_process_backfill():
+    """`replica.spot_preempt` reclaims a spot-marked replica; the
+    autoscaler counts the preemption and backfills the capacity with
+    no target change and no cooldown — zero requests lost."""
+    telemetry.enable()
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=2, slots=2)],
+                        affinity_blocks=0, backoff_base_s=0.001)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(spot=True, latency_ticks=2,
+                                      slots=2),
+        policy=_burst_policy(cooldown_in_s=60.0))
+    frs = [fleet.submit([i + 1, 2, 3], 4) for i in range(24)]
+    _drive(fleet, 0.4)
+    assert asc.n_scale_out >= 1
+    n_before = len(fleet._reps)
+    spots = [r.name for r in fleet._reps
+             if getattr(r.handle, "spot", False)]
+    assert spots, "scale-out spawned no spot capacity"
+    faults.inject("replica.spot_preempt", at=1)
+    _drive(fleet, 0.3)
+    assert asc.n_spot_preemptions == 1, asc.stats()
+    # backfilled: capacity is back without a scale decision
+    assert len(fleet._reps) >= n_before, asc.stats()
+    fleet.run(timeout_s=10)
+    assert all(fr.status == "ok" for fr in frs), \
+        {fr.status for fr in frs}
+    assert asc.n_backfills >= 1
+
+
+def test_scale_to_zero_parks_and_recovers():
+    """min_replicas=0: an idle fleet parks to ZERO replicas (the
+    diurnal-trough case — no chips burning), and the first queued
+    request spawns capacity back without waiting out a cooldown."""
+    fleet = FleetRouter([FakeReplica("r0", latency_ticks=1, slots=2)],
+                        affinity_blocks=0)
+    asc = fleet.attach_autoscale(
+        provisioner=_fake_provisioner(latency_ticks=1, slots=2),
+        policy=_burst_policy(min_replicas=0))
+    frs = [fleet.submit([i + 1, 2], 3) for i in range(4)]
+    fleet.run(timeout_s=10)
+    assert all(fr.status == "ok" for fr in frs)
+    _drive(fleet, 1.0)                  # the trough
+    assert len(fleet._reps) == 0, [r.name for r in fleet._reps]
+    assert asc.target == 0
+    fr = fleet.submit([50, 2], 3)       # dawn: traffic returns
+    fleet.run(timeout_s=10)
+    assert fr.status == "ok"
+    assert len(fleet._reps) >= 1
+
+
+def test_remove_replica_fails_over_inflight_work():
+    """A planned removal with work still in flight loses nothing: the
+    attempts fail over before the replica leaves the fleet."""
+    r0 = FakeReplica("r0", latency_ticks=50, slots=4)
+    r1 = FakeReplica("r1", latency_ticks=1, slots=4)
+    fleet = FleetRouter([r0, r1], affinity_blocks=0,
+                        backoff_base_s=0.001)
+    frs = [fleet.submit([i + 1, 2], 3) for i in range(2)]
+    for _ in range(3):
+        fleet.step()
+    victim = next(r.name for r in fleet._reps if r.attempts)
+    assert fleet.remove_replica(victim)
+    assert len(fleet._reps) == 1
+    with pytest.raises(ValueError):
+        fleet.remove_replica(fleet._reps[0].name)
+    fleet.run(timeout_s=10)
+    assert all(fr.status == "ok" for fr in frs)
+    assert fleet.n_failovers >= 1
+
+
+@pytest.mark.slow
+def test_spot_preempt_subprocess_sigterm_mid_decode(tmp_path):
+    """Satellite: a real spot worker SIGTERMed mid-decode publishes
+    its goodbye beat, the router fails its in-flight work over with
+    zero lost/duplicated requests, and the autoscaler backfills the
+    capacity within the cooldown window."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path)
+    kv = FileKV(d)
+    procs = {}
+
+    def _spawn_proc(name, spot):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_TPU_FAULTS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        log = open(os.path.join(d, f"{name}.log"), "w")
+        argv = [sys.executable, "-u", "-m",
+                "mxnet_tpu.serving.router",
+                "--dir", d, "--name", name, "--model", "llama_tiny",
+                "--max-prompt", "12", "--max-wall-s", "240"]
+        if spot:
+            argv.append("--spot")
+        procs[name] = subprocess.Popen(argv, stdout=log, stderr=log,
+                                       env=env, cwd=repo)
+        return ProcReplica(kv, name, spot=spot)
+
+    try:
+        h0 = _spawn_proc("w0", False)
+        h1 = _spawn_proc("w1", True)
+        t0 = time.time()
+        while time.time() - t0 < 180:
+            if all(kv.get(f"fleet/{n}/hb") is not None
+                   for n in ("w0", "w1")):
+                break
+            for n, p in procs.items():
+                assert p.poll() is None, (
+                    f"worker {n} died during warmup rc={p.returncode}"
+                    f" — see {d}/{n}.log")
+            time.sleep(0.05)
+        else:
+            pytest.fail("fleet workers never became healthy")
+
+        fleet = FleetRouter([h0, h1], affinity_blocks=0,
+                            backoff_base_s=0.01,
+                            heartbeat_timeout_s=1.5)
+        cooldown_s = 30.0
+        asc = fleet.attach_autoscale(
+            provisioner=ReplicaProvisioner(
+                _spawn_proc, lambda h: procs[h.name].kill()),
+            policy=AutoscalePolicy(
+                min_replicas=2, max_replicas=3,
+                queue_age_out_s=1e9,        # no load scale-out: the
+                cooldown_out_s=cooldown_s,  # only spawn is backfill
+                cooldown_in_s=1e9, scale_in_hold_s=1e9,
+                tick_interval_s=0.05))
+        rs = np.random.RandomState(7)
+        frs = [fleet.submit([int(rs.randint(2, 40)) for _ in
+                             range(int(rs.randint(2, 9)))], 12)
+               for _ in range(8)]
+        # let decode start flowing (first completions prove it), then
+        # reclaim the spot worker with the rest still in flight
+        t0 = time.time()
+        while time.time() - t0 < 60 and not any(fr.terminal
+                                                for fr in frs):
+            fleet.step()
+            time.sleep(0.005)
+        procs["w1"].send_signal(signal.SIGTERM)
+        t_preempt = time.time()
+        fleet.run(timeout_s=200)
+        # run() returns the moment the last request lands, which can
+        # beat the goodbye heartbeat; keep ticking until the autoscaler
+        # has classified the death and backfilled
+        t0 = time.time()
+        while time.time() - t0 < 60 and (asc.n_spot_preemptions < 1
+                                         or asc.n_spawned < 1):
+            fleet.step()
+            time.sleep(0.01)
+
+        assert all(fr.status == "ok" for fr in frs), \
+            [(fr.status, fr.finish_reason) for fr in frs]
+        # exactly one full result per request — nothing lost, nothing
+        # duplicated (tokens() = prompt + the 12 generated)
+        assert all(len(fr.tokens()) == len(fr.prompt) + 12
+                   for fr in frs)
+        assert asc.n_spot_preemptions == 1, asc.stats()
+        # backfill: a replacement worker was spawned promptly (well
+        # inside the scale-decision cooldown — backfill needs none)
+        assert asc.n_spawned >= 1, asc.stats()
+        backfill = [n for n in procs if n.startswith("as")]
+        assert backfill, "no backfill worker spawned"
+        assert procs["w1"].wait(timeout=30) == 0   # goodbye, not crash
+        assert time.time() - t_preempt < cooldown_s + 200
+        stats = fleet.stop_fleet(timeout_ms=30_000)
+    finally:
+        for p in procs.values():
+            p.kill()

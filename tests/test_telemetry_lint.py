@@ -218,6 +218,17 @@ def test_router_module_is_scanned_and_clean():
     assert _violations(path) == []
 
 
+def test_autoscale_module_is_scanned_and_clean():
+    """The autoscaler's tick runs UNgated (it drives real capacity,
+    not observability), which makes its internal emissions the exact
+    place an ungated hot-path metric would hide — it must be inside
+    the lint's walk and free of ungated sites."""
+    path = os.path.join(PKG, "serving", "autoscale.py")
+    assert path in _module_files(), \
+        "autoscale.py missing from lint walk"
+    assert _violations(path) == []
+
+
 def test_slo_module_is_scanned_and_clean():
     """The SLO engine publishes burn-rate/budget gauges on every tick —
     it must ride the same cost contract (early-return guards on
